@@ -1,0 +1,29 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; all sharding tests run against
+``jax_num_cpu_devices=8`` (the XLA host-platform device-count trick). The
+driver separately dry-run-compiles the multi-chip path via
+``__graft_entry__.dryrun_multichip``.
+"""
+
+import os
+import sys
+
+# Make the repo root importable regardless of pytest rootdir config.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# jax may already be imported (the axon sitecustomize registers a TPU plugin
+# at interpreter boot); config updates still work until a backend is chosen.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def cpu_mesh_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

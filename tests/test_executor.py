@@ -1,0 +1,127 @@
+"""In-process executor tests: whole-graph apply, idempotency, targeted
+destroy, output reads — the contracts the reference never tested below
+shell.RunTerraform* (SURVEY.md §4)."""
+
+import pytest
+
+from triton_kubernetes_tpu.executor import LocalExecutor, PlanAction
+from triton_kubernetes_tpu.executor.engine import delete_executor_state
+from triton_kubernetes_tpu.state import StateDocument
+
+
+@pytest.fixture()
+def doc(tmp_path):
+    d = StateDocument("m1")
+    d.set_backend_config({"local": {"path": str(tmp_path / "terraform.tfstate")}})
+    d.set_manager({
+        "source": "modules/bare-metal-manager",
+        "name": "m1", "host": "192.168.1.10",
+    })
+    yield d
+    delete_executor_state(d)
+
+
+def _add_cluster_and_node(d: StateDocument):
+    ckey = d.add_cluster("bare-metal", "c1", {
+        "source": "modules/bare-metal-k8s",
+        "name": "c1",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+    })
+    nkey = d.add_node(ckey, "c1-worker-1", {
+        "source": "modules/bare-metal-k8s-host",
+        "hostname": "c1-worker-1",
+        "host": "192.168.1.11",
+        "rancher_host_labels": {"worker": True},
+        "rancher_cluster_registration_token": f"${{module.{ckey}.registration_token}}",
+        "rancher_cluster_ca_checksum": f"${{module.{ckey}.ca_checksum}}",
+    })
+    return ckey, nkey
+
+
+def test_apply_full_graph_and_outputs(doc):
+    ckey, nkey = _add_cluster_and_node(doc)
+    ex = LocalExecutor()
+    plan = ex.apply(doc)
+    assert set(plan.by_action(PlanAction.CREATE)) == {"cluster-manager", ckey, nkey}
+
+    mgr_out = ex.output(doc, "cluster-manager")
+    assert mgr_out["manager_url"].startswith("https://")
+    cl_out = ex.output(doc, ckey)
+    assert cl_out["cluster_id"].startswith("c-")
+
+    # The node actually registered into the cluster with its role.
+    cloud = ex.cloud_view(doc)
+    cluster = cloud.cluster_by_id(cl_out["cluster_id"])
+    assert cluster["nodes"]["c1-worker-1"]["roles"] == ["worker"]
+
+
+def test_reapply_is_noop(doc):
+    _add_cluster_and_node(doc)
+    ex = LocalExecutor()
+    ex.apply(doc)
+    plan2 = ex.apply(doc)
+    assert plan2.changes == 0
+
+
+def test_scale_out_only_creates_new_module(doc):
+    """create node path: whole-graph apply, existing modules no-op
+    (create/node.go:161-168 semantics)."""
+    ckey, _ = _add_cluster_and_node(doc)
+    ex = LocalExecutor()
+    ex.apply(doc)
+    doc.add_node(ckey, "c1-worker-2", {
+        "source": "modules/bare-metal-k8s-host",
+        "hostname": "c1-worker-2",
+        "host": "192.168.1.12",
+        "rancher_cluster_registration_token": f"${{module.{ckey}.registration_token}}",
+        "rancher_cluster_ca_checksum": f"${{module.{ckey}.ca_checksum}}",
+    })
+    plan = ex.apply(doc)
+    assert plan.by_action(PlanAction.CREATE) == [f"node_bare-metal_c1_c1-worker-2"]
+    assert plan.changes == 1
+
+
+def test_targeted_destroy_cluster_fanout(doc):
+    """destroy cluster: -target=module.<cluster> + nodes (destroy/cluster.go:126-143)."""
+    ckey, nkey = _add_cluster_and_node(doc)
+    ex = LocalExecutor()
+    ex.apply(doc)
+    cl_out = ex.output(doc, ckey)
+
+    ex.destroy(doc, targets=[ckey, nkey])
+    # Manager survives; cluster + node gone from executor state.
+    assert ex.output(doc, "cluster-manager")["manager_url"]
+    with pytest.raises(KeyError):
+        ex.output(doc, ckey)
+    cloud = ex.cloud_view(doc)
+    with pytest.raises(Exception):
+        cloud.cluster_by_id(cl_out["cluster_id"])
+
+
+def test_full_destroy_removes_state(doc):
+    _add_cluster_and_node(doc)
+    ex = LocalExecutor()
+    ex.apply(doc)
+    ex.destroy(doc)
+    with pytest.raises(KeyError):
+        ex.output(doc, "cluster-manager")
+
+
+def test_update_detected_on_config_change(doc):
+    ex = LocalExecutor()
+    ex.apply(doc)
+    doc.set("module.cluster-manager.host", "192.168.1.99")
+    plan = ex.plan(doc)
+    assert plan.actions["cluster-manager"] is PlanAction.UPDATE
+
+
+def test_missing_required_variable_fails(doc, tmp_path):
+    doc.add_cluster("bare-metal", "bad", {
+        "source": "modules/bare-metal-k8s",
+        # name/manager_url etc. missing
+    })
+    ex = LocalExecutor()
+    with pytest.raises(Exception, match="required variable"):
+        ex.apply(doc)

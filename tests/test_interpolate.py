@@ -1,0 +1,74 @@
+"""Interpolation-contract tests: the ``${module.x.y}`` deferred-resolution
+semantics every workflow relies on (create/cluster.go:297-300 analog)."""
+
+import pytest
+
+from triton_kubernetes_tpu.executor import (
+    InterpolationError,
+    extract_dependencies,
+    module_dependencies,
+    resolve,
+)
+from triton_kubernetes_tpu.executor.interpolate import topo_order
+
+
+def test_extract_dependencies_nested():
+    cfg = {
+        "url": "${module.cluster-manager.manager_url}",
+        "nested": {"token": "${module.cluster_gcp_x.registration_token}"},
+        "list": ["${module.cluster_gcp_x.ca_checksum}", "plain"],
+        "plain": 5,
+    }
+    assert extract_dependencies(cfg) == {"cluster-manager", "cluster_gcp_x"}
+
+
+def test_module_dependencies_restricted_to_present():
+    mods = {
+        "cluster-manager": {"name": "m"},
+        "cluster_gcp_x": {"u": "${module.cluster-manager.manager_url}",
+                          "other": "${module.not_present.y}"},
+    }
+    deps = module_dependencies(mods)
+    assert deps["cluster_gcp_x"] == {"cluster-manager"}
+    assert deps["cluster-manager"] == set()
+
+
+def test_topo_order_manager_first():
+    mods = {
+        "node_gcp_x_h1": {"t": "${module.cluster_gcp_x.registration_token}"},
+        "cluster_gcp_x": {"u": "${module.cluster-manager.manager_url}"},
+        "cluster-manager": {"name": "m"},
+    }
+    order = topo_order(mods)
+    assert order.index("cluster-manager") < order.index("cluster_gcp_x")
+    assert order.index("cluster_gcp_x") < order.index("node_gcp_x_h1")
+
+
+def test_topo_cycle_detected():
+    mods = {"a": {"x": "${module.b.o}"}, "b": {"x": "${module.a.o}"}}
+    with pytest.raises(InterpolationError, match="cycle"):
+        topo_order(mods)
+
+
+def test_resolve_exact_preserves_type():
+    outputs = {"m": {"count": 3, "names": ["a", "b"]}}
+    assert resolve("${module.m.count}", outputs) == 3
+    assert resolve("${module.m.names}", outputs) == ["a", "b"]
+
+
+def test_resolve_embedded_stringifies():
+    outputs = {"m": {"host": "1.2.3.4"}}
+    assert resolve("https://${module.m.host}:443", outputs) == "https://1.2.3.4:443"
+
+
+def test_resolve_recurses_containers():
+    outputs = {"m": {"id": "c-1"}}
+    cfg = {"a": ["${module.m.id}"], "b": {"c": "${module.m.id}"}, "d": 7}
+    assert resolve(cfg, outputs) == {"a": ["c-1"], "b": {"c": "c-1"}, "d": 7}
+
+
+def test_resolve_unknown_module_or_output_raises():
+    with pytest.raises(InterpolationError):
+        resolve("${module.nope.x}", {})
+    with pytest.raises(InterpolationError):
+        resolve("${module.m.nope}", {"m": {"x": 1}})

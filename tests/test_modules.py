@@ -1,0 +1,169 @@
+"""Module-layer tests: registry, the TPU fork, hosted clusters, backups."""
+
+import pytest
+
+from triton_kubernetes_tpu.executor import LocalExecutor
+from triton_kubernetes_tpu.executor.engine import delete_executor_state
+from triton_kubernetes_tpu.modules import ModuleError, get_module, module_name_from_source
+from triton_kubernetes_tpu.state import StateDocument
+
+
+def test_source_parsing_matches_reference_urls():
+    # Reference-style fully-qualified source with ref (create/cluster.go:20-22).
+    name = module_name_from_source(
+        "github.com/org/repo//terraform/modules/gcp-tpu-k8s?ref=main")
+    assert name == "gcp-tpu-k8s"
+    assert module_name_from_source("modules/aws-manager") == "aws-manager"
+    with pytest.raises(ModuleError):
+        module_name_from_source("not-a-module-source")
+    with pytest.raises(ModuleError):
+        get_module("modules/does-not-exist")
+
+
+@pytest.fixture()
+def tpu_doc(tmp_path):
+    d = StateDocument("mgr")
+    d.set_backend_config({"local": {"path": str(tmp_path / "tf.tfstate")}})
+    d.set_manager({
+        "source": "modules/aws-manager", "name": "mgr",
+        "aws_access_key": "ak", "aws_secret_key": "sk",
+    })
+    ckey = d.add_cluster("gcp-tpu", "ml", {
+        "source": "modules/gcp-tpu-k8s", "name": "ml",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        "gcp_path_to_credentials": "/tmp/creds.json",
+        "gcp_project_id": "proj",
+    })
+    d.add_node(ckey, "pool0", {
+        "source": "modules/gcp-tpu-nodepool",
+        "pool_name": "pool0",
+        "gke_cluster_name": "ml",
+        "cluster_id": f"${{module.{ckey}.cluster_id}}",
+        "gcp_path_to_credentials": "/tmp/creds.json",
+        "gcp_project_id": "proj",
+        "tpu_accelerator": "v5p-64",
+    })
+    yield d, ckey
+    delete_executor_state(d)
+
+
+def test_tpu_fork_end_to_end(tpu_doc):
+    """Manager on AWS + GKE TPU cluster + v5p-64 node pool (BASELINE config 5
+    shape, multi-cloud)."""
+    doc, ckey = tpu_doc
+    ex = LocalExecutor()
+    ex.apply(doc)
+
+    pool_out = ex.output(doc, f"node_gcp-tpu_ml_pool0")
+    assert pool_out["topology"] == "4x4x4"
+    assert pool_out["num_hosts"] == 16
+    assert pool_out["num_chips"] == 64
+    assert len(pool_out["node_names"]) == 16
+
+    cloud = ex.cloud_view(doc)
+    gke = cloud.get_resource("gke_cluster", "ml")
+    pool = gke["node_pools"]["pool0"]
+    assert pool["tpu_topology"] == "4x4x4"
+    assert pool["placement_policy"]["type"] == "COMPACT"
+    # Every node carries ICI coordinates.
+    for node in pool["nodes"]:
+        assert "tpu.tk8s.io/ici-x" in node["labels"]
+
+    # libtpu runtime + device plugin + health DaemonSets installed.
+    cluster_id = ex.output(doc, ckey)["cluster_id"]
+    kinds = [m["metadata"]["name"] for m in cloud.get_manifests(cluster_id, "DaemonSet")]
+    assert set(kinds) == {"tpu-jax-runtime", "tpu-device-plugin", "tpu-slice-health"}
+
+
+def test_tpu_jobset_module(tpu_doc):
+    doc, ckey = tpu_doc
+    pool_key = "node_gcp-tpu_ml_pool0"
+    doc.set("module.job_train", {
+        "source": "modules/tpu-jobset",
+        "job_name": "llama3-8b",
+        "cluster_id": f"${{module.{ckey}.cluster_id}}",
+        "tpu_accelerator": "v5p-64",
+        "slice_id": f"${{module.{pool_key}.slice_id}}",
+        "command": ["python", "-m", "triton_kubernetes_tpu.train"],
+    })
+    ex = LocalExecutor()
+    ex.apply(doc)
+    out = ex.output(doc, "job_train")
+    assert out["num_workers"] == 16
+    cloud = ex.cloud_view(doc)
+    cluster_id = ex.output(doc, ckey)["cluster_id"]
+    jobs = cloud.get_manifests(cluster_id, "Job")
+    assert jobs and jobs[0]["metadata"]["name"] == "llama3-8b"
+    svcs = cloud.get_manifests(cluster_id, "Service")
+    assert svcs and svcs[0]["spec"]["clusterIP"] == "None"
+
+
+def test_nodepool_destroy_removes_pool(tpu_doc):
+    doc, ckey = tpu_doc
+    ex = LocalExecutor()
+    ex.apply(doc)
+    pool_key = "node_gcp-tpu_ml_pool0"
+    ex.destroy(doc, targets=[pool_key])
+    cloud = ex.cloud_view(doc)
+    gke = cloud.get_resource("gke_cluster", "ml")
+    assert "pool0" not in gke["node_pools"]
+
+
+def test_backup_modules(tmp_path):
+    d = StateDocument("mgr")
+    d.set_backend_config({"local": {"path": str(tmp_path / "tf.tfstate")}})
+    d.set_manager({"source": "modules/bare-metal-manager", "name": "mgr",
+                   "host": "10.0.0.1"})
+    ckey = d.add_cluster("bare-metal", "c", {
+        "source": "modules/bare-metal-k8s", "name": "c",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+    })
+    d.add_backup(ckey, {
+        "source": "modules/k8s-backup-gcs",
+        "cluster_name": "c",
+        "cluster_id": f"${{module.{ckey}.cluster_id}}",
+        "gcp_path_to_credentials": "/tmp/creds.json",
+        "gcs_bucket": "my-bucket",
+    })
+    ex = LocalExecutor()
+    try:
+        ex.apply(d)
+        out = ex.output(d, f"backup_{ckey}")
+        assert out["backup_location"] == "gs://my-bucket/c"
+        cloud = ex.cloud_view(d)
+        cluster_id = ex.output(d, ckey)["cluster_id"]
+        deployments = cloud.get_manifests(cluster_id, "Deployment")
+        assert any(m["metadata"]["name"] == "velero" for m in deployments)
+    finally:
+        delete_executor_state(d)
+
+
+def test_azure_rke_ha_manager(tmp_path):
+    """The HA branch (azure-rke analog): N nodes, in-cluster manager."""
+    d = StateDocument("ha")
+    d.set_backend_config({"local": {"path": str(tmp_path / "tf.tfstate")}})
+    d.set_manager({
+        "source": "modules/azure-rke-manager", "name": "ha",
+        "azure_subscription_id": "s", "azure_client_id": "c",
+        "azure_client_secret": "x", "azure_tenant_id": "t",
+        "fqdn": "mgr.example.com",
+        "tls_cert_path": "/tmp/cert.pem", "tls_private_key_path": "/tmp/key.pem",
+        "node_count": 3,
+    })
+    ex = LocalExecutor()
+    try:
+        ex.apply(d)
+        out = ex.output(d, "cluster-manager")
+        assert out["manager_url"] == "https://mgr.example.com"
+        assert "kube_config_yaml" in out
+        cloud = ex.cloud_view(d)
+        # 3 VMs, all three roles each.
+        for i in range(3):
+            vm = cloud.get_resource("azure_instance", f"ha-{i}")
+            assert vm["roles"] == ["controlplane", "etcd", "worker"]
+    finally:
+        delete_executor_state(d)

@@ -1,0 +1,195 @@
+"""Regression tests for defects found in review: key-segment validation,
+dependency tainting, crash-safe state persistence, cross-provider cluster
+isolation, prune ordering, reference-name aliases."""
+
+import pytest
+
+from triton_kubernetes_tpu.executor import LocalExecutor, PlanAction
+from triton_kubernetes_tpu.executor.engine import delete_executor_state
+from triton_kubernetes_tpu.modules import get_module
+from triton_kubernetes_tpu.modules.base import DriverContext, Module, Resource, Variable
+from triton_kubernetes_tpu.modules.registry import REGISTRY, register
+from triton_kubernetes_tpu.state import ClusterKeyError, StateDocument
+
+
+def _mem_doc(name):
+    d = StateDocument(name)
+    d.set_backend_config({"memory": {"name": name}})
+    return d
+
+
+def test_dotted_hostname_rejected():
+    doc = StateDocument("m")
+    ckey = doc.add_cluster("gcp", "c1", {})
+    with pytest.raises(ClusterKeyError, match="hostname"):
+        doc.add_node(ckey, "host.dc1", {})
+    with pytest.raises(ClusterKeyError):
+        doc.add_cluster("gcp", "bad.name", {})
+    with pytest.raises(ClusterKeyError):
+        doc.add_cluster("gcp_bad", "name", {})  # provider may not contain _
+
+
+def test_dependents_tainted_when_upstream_changes():
+    doc = _mem_doc("taint")
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "taint",
+                     "host": "10.0.0.1"})
+    ckey = doc.add_cluster("bare-metal", "c", {
+        "source": "modules/bare-metal-k8s", "name": "c",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+    })
+    ex = LocalExecutor()
+    try:
+        ex.apply(doc)
+        # Change the manager host: manager UPDATEs, and the cluster — whose own
+        # config text is unchanged — must be re-applied too.
+        doc.set("module.cluster-manager.host", "10.9.9.9")
+        plan = ex.plan(doc)
+        assert plan.actions["cluster-manager"] is PlanAction.UPDATE
+        assert plan.actions[ckey] is PlanAction.UPDATE
+        applied = ex.apply(doc)
+        assert applied.actions[ckey] is PlanAction.UPDATE
+    finally:
+        delete_executor_state(doc)
+
+
+def test_midapply_failure_persists_partial_state():
+    @register
+    class Exploding(Module):
+        SOURCE = "modules/test-exploding"
+        VARIABLES = [Variable("dep", default="")]
+
+        def apply(self, config, ctx):
+            raise RuntimeError("boom")
+
+    try:
+        doc = _mem_doc("partial")
+        doc.set_manager({"source": "modules/bare-metal-manager",
+                         "name": "partial", "host": "10.0.0.1"})
+        doc.set("module.zz_bad", {"source": "modules/test-exploding",
+                                  "dep": "${module.cluster-manager.manager_url}"})
+        ex = LocalExecutor()
+        with pytest.raises(RuntimeError, match="boom"):
+            ex.apply(doc)
+        # The manager applied before the failure and must be on record.
+        assert ex.output(doc, "cluster-manager")["manager_url"]
+    finally:
+        REGISTRY.pop("test-exploding", None)
+        delete_executor_state(doc)
+
+
+def test_duplicate_cluster_name_across_providers_rejected():
+    """One manager's cluster names are unique across providers — the control
+    plane's create-or-get is keyed by name, so a same-named cluster under a
+    second provider would silently share the first one's registration."""
+    doc = _mem_doc("dual")
+    doc.add_cluster("bare-metal", "prod", {"source": "modules/bare-metal-k8s"})
+    with pytest.raises(ClusterKeyError, match="already used"):
+        doc.add_cluster("vsphere", "prod", {"source": "modules/vsphere-k8s"})
+    # Re-adding under the same provider (config update) stays legal.
+    doc.add_cluster("bare-metal", "prod", {"source": "modules/bare-metal-k8s",
+                                           "x": 1})
+
+
+def test_same_cluster_name_across_managers_destroy_isolated():
+    """Two managers each with a cluster named 'prod': destroying one must not
+    touch the other (cluster resources are keyed by id, not name)."""
+    docs, ids, keys = [], [], []
+    ex = LocalExecutor()
+    mgr_interp = {
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+    }
+    try:
+        for i in range(2):
+            d = _mem_doc(f"mgr{i}")
+            d.set_manager({"source": "modules/bare-metal-manager",
+                           "name": f"mgr{i}", "host": f"10.0.0.{i+1}"})
+            k = d.add_cluster("bare-metal", "prod", {
+                "source": "modules/bare-metal-k8s", "name": "prod", **mgr_interp})
+            ex.apply(d)
+            docs.append(d)
+            keys.append(k)
+            ids.append(ex.output(d, k)["cluster_id"])
+        assert ids[0] != ids[1]
+        ex.destroy(docs[1], targets=[keys[1]])
+        # mgr0's registration survives mgr1's destroy despite the shared name.
+        assert ex.cloud_view(docs[0]).cluster_by_id(ids[0])["name"] == "prod"
+    finally:
+        for d in docs:
+            delete_executor_state(d)
+
+
+def test_prune_on_apply_destroys_dependents_first():
+    doc = _mem_doc("prune")
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "prune",
+                     "host": "10.0.0.1"})
+    ckey = doc.add_cluster("bare-metal", "c", {
+        "source": "modules/bare-metal-k8s", "name": "c",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+    })
+    nkey = doc.add_node(ckey, "h1", {
+        "source": "modules/bare-metal-k8s-host", "hostname": "h1",
+        "host": "10.0.0.2",
+        "rancher_cluster_registration_token": f"${{module.{ckey}.registration_token}}",
+        "rancher_cluster_ca_checksum": f"${{module.{ckey}.ca_checksum}}",
+    })
+    ex = LocalExecutor()
+    order = []
+    ex.log = lambda msg: order.append(msg) if "destroy" in msg else None
+    try:
+        ex.apply(doc)
+        # Remove cluster AND node from the doc; next apply prunes both —
+        # node (dependent) must go before cluster.
+        doc.delete(f"module.{nkey}")
+        doc.delete(f"module.{ckey}")
+        ex.apply(doc)
+        destroys = [m for m in order if m.endswith("destroy")]
+        assert destroys == [f"module.{nkey}: destroy", f"module.{ckey}: destroy"]
+    finally:
+        delete_executor_state(doc)
+
+
+def test_reference_module_names_resolve():
+    for ref_name in ["triton-rancher", "aws-rancher", "gcp-rancher",
+                     "azure-rancher", "azure-rke", "bare-metal-rancher",
+                     "triton-rancher-k8s", "gke-rancher-k8s", "aks-rancher-k8s",
+                     "aws-rancher-k8s-host", "vsphere-rancher-k8s-host"]:
+        assert get_module(f"github.com/x/y//terraform/modules/{ref_name}?ref=master")
+
+
+def test_self_reference_clear_error():
+    doc = _mem_doc("selfref")
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "s",
+                     "host": "${module.cluster-manager.manager_url}"})
+    ex = LocalExecutor()
+    with pytest.raises(Exception, match="references its own output"):
+        ex.apply(doc)
+    delete_executor_state(doc)
+
+
+def test_hosted_cluster_update_applies_attrs():
+    doc = _mem_doc("upd")
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "upd",
+                     "host": "10.0.0.1"})
+    doc.add_cluster("gcp-tpu", "ml", {
+        "source": "modules/gcp-tpu-k8s", "name": "ml",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        "gcp_path_to_credentials": "/c.json", "gcp_project_id": "p",
+        "k8s_version": "1.29"})
+    ex = LocalExecutor()
+    try:
+        ex.apply(doc)
+        doc.set("module.cluster_gcp-tpu_ml.k8s_version", "1.30")
+        ex.apply(doc)
+        gke = ex.cloud_view(doc).get_resource("gke_cluster", "ml")
+        assert gke["k8s_version"] == "1.30"
+        assert "system-pool" in gke["node_pools"]  # pools preserved on update
+    finally:
+        delete_executor_state(doc)

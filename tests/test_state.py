@@ -1,0 +1,98 @@
+"""State-document tests (reference analog: state/state_test.go:1-190)."""
+
+import pytest
+
+from triton_kubernetes_tpu.state import (
+    ClusterKeyError,
+    StateDocument,
+    cluster_key,
+    node_key,
+    parse_cluster_key,
+)
+
+
+def test_get_set_paths():
+    doc = StateDocument("m1")
+    doc.set("module.cluster-manager.name", "m1")
+    assert doc.get("module.cluster-manager.name") == "m1"
+    assert doc.get("module.missing") is None
+    assert doc.get("module.missing", 42) == 42
+    assert doc.exists("module.cluster-manager")
+    assert not doc.exists("nope.nope")
+
+
+def test_set_manager_and_backend_config():
+    doc = StateDocument("m1")
+    doc.set_manager({"name": "m1", "source": "modules/triton-manager"})
+    doc.set_backend_config({"local": {"path": "/tmp/x"}})
+    assert doc.manager()["name"] == "m1"
+    assert doc.get("terraform.backend.local.path") == "/tmp/x"
+
+
+def test_add_cluster_and_key_scheme():
+    doc = StateDocument("m1")
+    key = doc.add_cluster("gcp", "prod", {"source": "modules/gcp-k8s"})
+    assert key == "cluster_gcp_prod"
+    assert doc.get(f"module.{key}.source") == "modules/gcp-k8s"
+    # Freshly-added children are visible immediately — the reference needed a
+    # re-parse workaround for this (create/cluster.go:150-154).
+    assert doc.clusters() == {"prod": "cluster_gcp_prod"}
+
+
+def test_cluster_name_may_contain_underscores():
+    assert parse_cluster_key("cluster_aws_my_cool_cluster") == ("aws", "my_cool_cluster")
+
+
+def test_malformed_cluster_key_raises():
+    doc = StateDocument("m1")
+    doc.set("module.cluster_", {})
+    with pytest.raises(ClusterKeyError):
+        doc.clusters()
+
+
+def test_nodes_scanning_scoped_to_cluster():
+    doc = StateDocument("m1")
+    c1 = doc.add_cluster("gcp", "alpha", {})
+    c2 = doc.add_cluster("gcp", "beta", {})
+    doc.add_node(c1, "alpha-node-1", {"hostname": "alpha-node-1"})
+    doc.add_node(c1, "alpha-node-2", {"hostname": "alpha-node-2"})
+    doc.add_node(c2, "beta-node-1", {"hostname": "beta-node-1"})
+    assert set(doc.nodes(c1)) == {"alpha-node-1", "alpha-node-2"}
+    assert doc.nodes(c1)["alpha-node-1"] == "node_gcp_alpha_alpha-node-1"
+    assert set(doc.nodes(c2)) == {"beta-node-1"}
+
+
+def test_backup_one_per_cluster_key():
+    doc = StateDocument("m1")
+    key = doc.add_cluster("aws", "prod", {})
+    assert doc.backup(key) is None
+    bkey = doc.add_backup(key, {"source": "modules/k8s-backup-s3"})
+    assert bkey == "backup_cluster_aws_prod"
+    assert doc.backup(key) == bkey
+
+
+def test_delete_paths():
+    doc = StateDocument("m1")
+    key = doc.add_cluster("azure", "x", {"a": 1})
+    assert doc.delete(f"module.{key}")
+    assert not doc.delete(f"module.{key}")
+    assert doc.clusters() == {}
+
+
+def test_bytes_roundtrip():
+    doc = StateDocument("m1")
+    doc.set_manager({"name": "m1"})
+    doc.add_cluster("triton", "t", {"k": [1, 2, {"x": "y"}]})
+    doc2 = StateDocument("m1", doc.to_bytes())
+    assert doc2 == doc
+
+
+def test_node_key_derivation():
+    assert node_key("cluster_gcp_prod", "host-1") == "node_gcp_prod_host-1"
+    with pytest.raises(ClusterKeyError):
+        node_key("not_a_cluster_key", "h")
+
+
+def test_cluster_key_helper():
+    assert cluster_key("gcp-tpu", "ml") == "cluster_gcp-tpu_ml"
+    assert parse_cluster_key("cluster_gcp-tpu_ml") == ("gcp-tpu", "ml")

@@ -1,0 +1,98 @@
+"""TPU topology tests: slice arithmetic, ICI labels, JobSet rendering."""
+
+import pytest
+
+from triton_kubernetes_tpu.topology import (
+    SliceSpec,
+    default_topology,
+    host_labels_for_slice,
+    parse_accelerator,
+    render_headless_service,
+    render_jobset,
+    selector_for_slice,
+)
+from triton_kubernetes_tpu.topology.slices import TPU_GENERATIONS
+
+
+def test_parse_accelerator():
+    gen, chips = parse_accelerator("v5p-64")
+    assert gen.name == "v5p" and chips == 64
+    with pytest.raises(ValueError):
+        parse_accelerator("v9-8")
+    with pytest.raises(ValueError):
+        parse_accelerator("v5p")
+    with pytest.raises(ValueError):
+        parse_accelerator("v5e-1024")  # over max
+
+
+@pytest.mark.parametrize("acc,topo,hosts", [
+    ("v5e-1", "1x1", 1),
+    ("v5e-4", "2x2", 1),
+    ("v5e-8", "2x4", 2),
+    ("v5e-256", "16x16", 64),
+    ("v5p-64", "4x4x4", 16),
+    ("v5p-256", "4x8x8", 64),
+    ("v6e-8", "2x4", 2),
+])
+def test_default_topologies(acc, topo, hosts):
+    spec = SliceSpec.from_accelerator(acc)
+    assert spec.topology == topo
+    assert spec.num_hosts == hosts
+
+
+def test_topology_chip_count_validated():
+    with pytest.raises(ValueError, match="topology"):
+        SliceSpec.from_accelerator("v5p-64", "2x2x2")
+
+
+def test_chip_coordinates_cover_torus():
+    spec = SliceSpec.from_accelerator("v5p-8")  # 2x2x2
+    coords = spec.chip_coordinates()
+    assert len(coords) == 8
+    assert len(set(coords)) == 8
+    assert all(len(c) == 3 for c in coords)
+    # Consecutive chips are ICI neighbors (last axis fastest).
+    assert coords[0] == (0, 0, 0) and coords[1] == (0, 0, 1)
+
+
+def test_host_labels_carry_ici_coordinates():
+    spec = SliceSpec.from_accelerator("v5p-64")
+    labels = host_labels_for_slice(spec, "ml-pool0")
+    assert len(labels) == 16
+    first = labels[0]
+    assert first["cloud.google.com/gke-tpu-topology"] == "4x4x4"
+    assert first["tpu.tk8s.io/worker-id"] == "0"
+    assert first["tpu.tk8s.io/slice-id"] == "ml-pool0"
+    assert {"tpu.tk8s.io/ici-x", "tpu.tk8s.io/ici-y", "tpu.tk8s.io/ici-z"} <= set(first)
+    # Worker ids are dense and unique.
+    ids = {l["tpu.tk8s.io/worker-id"] for l in labels}
+    assert ids == {str(i) for i in range(16)}
+
+
+def test_selector_pins_to_one_slice():
+    spec = SliceSpec.from_accelerator("v5e-8")
+    sel = selector_for_slice(spec, "s0")
+    assert sel["tpu.tk8s.io/slice-id"] == "s0"
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+
+
+def test_jobset_render_multihost():
+    spec = SliceSpec.from_accelerator("v5p-64")
+    job = render_jobset("train", spec, "s0", image="img", command=["python", "t.py"])
+    assert job["spec"]["completions"] == 16
+    assert job["spec"]["completionMode"] == "Indexed"
+    pod = job["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["tpu.tk8s.io/slice-id"] == "s0"
+    env = {e["name"]: e.get("value") for e in pod["containers"][0]["env"]}
+    assert env["NUM_TPU_WORKERS"] == "16"
+    assert "train-0.train.default.svc" in env["JAX_COORDINATOR_ADDRESS"]
+    assert pod["containers"][0]["resources"]["limits"]["google.com/tpu"] == "4"
+
+    svc = render_headless_service("train")
+    assert svc["spec"]["clusterIP"] is None or svc["spec"]["clusterIP"] == "None"
+
+
+def test_peak_flops_table_sane():
+    for gen in TPU_GENERATIONS.values():
+        assert gen.peak_bf16_tflops > 100
+        assert gen.chips_per_host in (4, 8)

@@ -1,0 +1,32 @@
+"""triton_kubernetes_tpu — a TPU-native, multi-cloud Kubernetes cluster-manager framework.
+
+A from-scratch rebuild of the capability set of ``gadkins/triton-kubernetes``
+(reference: /root/reference, a ~11k-LoC Go CLI that provisions Rancher-based
+Kubernetes clusters across 8 cloud providers by generating a Terraform JSON
+document), re-designed TPU-first:
+
+* the GCP provider path provisions **GKE TPU pod slices** (v5e/v5p/v6e node
+  pools with ``tpu_topology`` placement) instead of CUDA GPU node pools;
+* host bootstrap is a libtpu + JAX/XLA DaemonSet instead of docker/nvidia
+  startup scripts;
+* ICI mesh coordinates are surfaced as Kubernetes node labels so multi-host
+  JAX (pjit/shard_map) jobs schedule slice-contiguously;
+* a bundled MaxText-class workload stack (``models/``, ``ops/``, ``parallel/``,
+  ``train/``) is the acceptance test for the provisioned infrastructure
+  (BASELINE.md: Llama-3-8B >=40% MFU on a v5p-64 slice).
+
+Layering mirrors the reference's five layers (SURVEY.md §1):
+
+    L5  cli/        cobra/viper analog           (reference: cmd/)
+    L4  workflows/  create/destroy/get flows     (reference: create/ destroy/ get/)
+    L3  state/ + backends/  declarative doc      (reference: state/ backend/)
+    L2  executor/   plan/apply engine            (reference: shell/)
+    L1  modules/    provider resource graphs     (reference: terraform/modules/)
+
+plus the new TPU-native layers with no reference analog:
+
+    topology/   TPU slice topologies, ICI mesh labels, JobSet rendering
+    models/ ops/ parallel/ train/   the bundled JAX workload stack
+"""
+
+__version__ = "0.1.0"

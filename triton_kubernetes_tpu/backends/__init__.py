@@ -1,0 +1,26 @@
+"""L3 persistence: pluggable backends for the state document.
+
+Reference analog: ``backend/backend.go:7-27`` (interface with
+State/DeleteState/PersistState/States/StateTerraformConfig), with a local-dir
+implementation (backend/local/backend.go) and a Manta object-store
+implementation (backend/manta/backend.go). This rebuild adds what the
+reference left as a TODO (backend/manta/backend.go:33): **locking** — the
+local backend uses an OS-level advisory lock around persist, and the
+object-store backend uses generation-match preconditions (the GCS-era
+equivalent of compare-and-swap).
+"""
+
+from .base import Backend, StateExistsError, StateLockedError, StateNotFoundError
+from .local import LocalBackend
+from .memory import MemoryBackend
+from .objectstore import ObjectStoreBackend
+
+__all__ = [
+    "Backend",
+    "LocalBackend",
+    "MemoryBackend",
+    "ObjectStoreBackend",
+    "StateExistsError",
+    "StateLockedError",
+    "StateNotFoundError",
+]

@@ -1,0 +1,42 @@
+"""L2 execution: plan/apply engine for the state document.
+
+Reference analog: ``shell/`` — the reference writes the doc to a temp dir and
+shells out to the external ``terraform`` binary
+(shell/run_terraform.go:63-185). This rebuild keeps that escape hatch
+(``TerraformExecutor``) but the primary engine is **in-process**
+(``LocalExecutor``): it resolves the module graph, evaluates
+``${module.x.y}`` interpolations, orders modules by dependency, and drives
+provider drivers directly — which is what makes the whole workflow layer
+testable (the single biggest gap in the reference, SURVEY.md §4: nothing below
+shell.RunTerraform* had any coverage).
+"""
+
+from .interpolate import (
+    InterpolationError,
+    extract_dependencies,
+    module_dependencies,
+    resolve,
+)
+from .plan import Plan, PlanAction, diff_states
+from .engine import (
+    ApplyError,
+    ExecutorState,
+    LocalExecutor,
+    OutputError,
+)
+from .terraform import TerraformExecutor
+
+__all__ = [
+    "ApplyError",
+    "ExecutorState",
+    "InterpolationError",
+    "LocalExecutor",
+    "OutputError",
+    "Plan",
+    "PlanAction",
+    "TerraformExecutor",
+    "diff_states",
+    "extract_dependencies",
+    "module_dependencies",
+    "resolve",
+]

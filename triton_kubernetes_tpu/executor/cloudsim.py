@@ -1,0 +1,213 @@
+"""In-process cloud + control-plane simulator.
+
+SURVEY.md §4's top rebuild recommendation: the reference had *zero* coverage
+below ``shell.RunTerraform*`` — terraform graph, Rancher API, VM boot and
+agent self-registration were all validated by hand. This simulator is the
+"fake in-process cloud+Rancher" that closes that gap: modules provision
+against it, workflows integration-test against it, and its state round-trips
+through the executor state file so targeted destroys work across invocations.
+
+It models, deterministically (no wall clock, no randomness):
+
+* instances / networks / disks per provider (the ``*-rancher-k8s-host`` and
+  network-envelope resources);
+* a Rancher-style control plane: manager bootstrap mints API credentials
+  (setup_rancher.sh.tpl:22-63 analog), cluster create-or-get returns
+  ``(cluster_id, registration_token, ca_checksum)`` idempotently
+  (rancher_cluster.sh:17-100 analog), nodes join with roles + labels
+  (install_rancher_agent.sh.tpl:44 analog);
+* hosted-K8s control planes (GKE/AKS) incl. **TPU node pools** with slice
+  topology -> per-node ICI mesh coordinate labels;
+* applied Kubernetes manifests per cluster (DaemonSets, JobSets, Deployments).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional
+
+
+def _token(*parts: str) -> str:
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:40]
+
+
+class CloudSimError(RuntimeError):
+    pass
+
+
+class CloudSimulator:
+    def __init__(self, state: Optional[Dict[str, Any]] = None):
+        s = state or {}
+        self.resources: Dict[str, Dict[str, Any]] = s.get("resources", {})
+        self.managers: Dict[str, Dict[str, Any]] = s.get("managers", {})
+        self.clusters: Dict[str, Dict[str, Any]] = s.get("clusters", {})
+        self.manifests: Dict[str, List[Dict[str, Any]]] = s.get("manifests", {})
+        self.serial: int = s.get("serial", 0)
+
+    # ------------------------------------------------------------- serialization
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "resources": self.resources,
+            "managers": self.managers,
+            "clusters": self.clusters,
+            "manifests": self.manifests,
+            "serial": self.serial,
+        }
+
+    # ---------------------------------------------------------------- resources
+    def _rkey(self, rtype: str, name: str) -> str:
+        return f"{rtype}:{name}"
+
+    def create_resource(self, rtype: str, name: str, **attrs: Any) -> Dict[str, Any]:
+        """Idempotent create-or-get of a generic cloud resource."""
+        key = self._rkey(rtype, name)
+        if key not in self.resources:
+            self.serial += 1
+            rec = {"type": rtype, "name": name, "id": f"{rtype}-{self.serial:04d}", **attrs}
+            if rtype.endswith("instance") or rtype.endswith("machine"):
+                rec.setdefault("ip", f"10.0.{(self.serial >> 8) & 255}.{self.serial & 255}")
+            self.resources[key] = rec
+        else:
+            self.resources[key].update(attrs)
+        return self.resources[key]
+
+    def get_resource(self, rtype: str, name: str) -> Optional[Dict[str, Any]]:
+        return self.resources.get(self._rkey(rtype, name))
+
+    def delete_resource(self, rtype: str, name: str) -> None:
+        self.resources.pop(self._rkey(rtype, name), None)
+        if rtype == "manager":
+            self.managers.pop(name, None)
+        if rtype == "cluster":
+            # "cluster" resources are keyed by cluster *id*, so deleting one
+            # module's registration can never hit a same-named cluster under
+            # another manager/provider.
+            if name in self.clusters:
+                del self.clusters[name]
+                self.manifests.pop(name, None)
+
+    # ------------------------------------------------------- control plane (mgr)
+    def bootstrap_manager(self, name: str, url: str) -> Dict[str, str]:
+        """Manager bootstrap: mints API credentials, idempotently.
+
+        Reference analog: null_resource.setup_rancher_k8s + data.external
+        rancher_server (modules/triton-rancher/main.tf:103-137) — the SSH'd
+        bash that logs into a fresh Rancher, mints a token and stores it in
+        ``~/rancher_api_key``.
+        """
+        if name not in self.managers:
+            self.managers[name] = {
+                "name": name,
+                "url": url,
+                "access_key": f"token-{_token(name, 'access')[:8]}",
+                "secret_key": _token(name, "secret"),
+                "clusters": [],
+            }
+        self.managers[name]["url"] = url
+        return {k: self.managers[name][k] for k in ("url", "access_key", "secret_key")}
+
+    def _find_manager(self, url: str) -> Dict[str, Any]:
+        for m in self.managers.values():
+            if m["url"] == url:
+                return m
+        raise CloudSimError(f"no manager at {url!r} (apply the manager module first)")
+
+    def create_or_get_cluster(self, manager_url: str, cluster_name: str,
+                              **attrs: Any) -> Dict[str, Any]:
+        """Create-or-get a cluster registration (idempotent).
+
+        Reference analog: files/rancher_cluster.sh:17-100 — POST /v3/cluster
+        if absent, then mint a clusterregistrationtoken and read the CA
+        checksum from /v3/settings/cacerts.
+        """
+        mgr = self._find_manager(manager_url)
+        for c in self.clusters.values():
+            if c["manager"] == mgr["name"] and c["name"] == cluster_name:
+                c.update(attrs)
+                return c
+        cid = f"c-{_token(mgr['name'], cluster_name)[:8]}"
+        cluster = {
+            "id": cid,
+            "name": cluster_name,
+            "manager": mgr["name"],
+            "registration_token": _token(cid, "reg"),
+            "ca_checksum": _token(cid, "ca"),
+            "nodes": {},
+            **attrs,
+        }
+        self.clusters[cid] = cluster
+        mgr["clusters"].append(cid)
+        return cluster
+
+    def register_node(self, registration_token: str, hostname: str,
+                      roles: List[str], labels: Optional[Dict[str, str]] = None,
+                      ca_checksum: str = "") -> Dict[str, Any]:
+        """Agent self-registration: a booted host joins its cluster.
+
+        Reference analog: install_rancher_agent.sh.tpl:44 (``docker run
+        rancher/rancher-agent --server ... --token ... --ca-checksum ...
+        --worker|--etcd|--controlplane``). Token+checksum pinning enforced.
+        """
+        for c in self.clusters.values():
+            if c["registration_token"] == registration_token:
+                if ca_checksum and ca_checksum != c["ca_checksum"]:
+                    raise CloudSimError(f"CA checksum mismatch for {hostname}")
+                c["nodes"][hostname] = {
+                    "hostname": hostname,
+                    "roles": sorted(roles),
+                    "labels": dict(labels or {}),
+                }
+                return c["nodes"][hostname]
+        raise CloudSimError(f"invalid registration token for {hostname}")
+
+    def cluster_by_id(self, cluster_id: str) -> Dict[str, Any]:
+        if cluster_id not in self.clusters:
+            raise CloudSimError(f"no such cluster {cluster_id!r}")
+        return self.clusters[cluster_id]
+
+    # --------------------------------------------------------------- hosted k8s
+    def create_hosted_cluster(self, kind: str, name: str, **attrs: Any) -> Dict[str, Any]:
+        """Hosted control plane (GKE/AKS analog): no agent registration —
+        nodes come from provider-managed node pools. Re-creates update attrs
+        in place (k8s_version bumps etc.), preserving node pools."""
+        key = self._rkey(f"{kind}_cluster", name)
+        if key not in self.resources:
+            self.create_resource(f"{kind}_cluster", name,
+                                 endpoint=f"https://{name}.{kind}.local",
+                                 node_pools={}, **attrs)
+        else:
+            self.resources[key].update(attrs)
+        return self.resources[key]
+
+    def create_node_pool(self, kind: str, cluster_name: str, pool_name: str,
+                         node_count: int, node_labels: Optional[List[Dict[str, str]]] = None,
+                         **attrs: Any) -> Dict[str, Any]:
+        """Node pool on a hosted cluster; each node gets the provided labels
+        (this is where TPU slice/ICI-coordinate labels land)."""
+        cluster = self.get_resource(f"{kind}_cluster", cluster_name)
+        if cluster is None:
+            raise CloudSimError(f"no {kind} cluster {cluster_name!r}")
+        nodes = []
+        for i in range(node_count):
+            labels = dict(node_labels[i]) if node_labels and i < len(node_labels) else {}
+            nodes.append({"name": f"{cluster_name}-{pool_name}-{i}", "labels": labels})
+        pool = {"name": pool_name, "node_count": node_count, "nodes": nodes, **attrs}
+        cluster["node_pools"][pool_name] = pool
+        return pool
+
+    # ---------------------------------------------------------------- manifests
+    def apply_manifest(self, cluster_id: str, manifest: Dict[str, Any]) -> None:
+        """kubectl-apply analog, idempotent on (kind, metadata.name)."""
+        objs = self.manifests.setdefault(cluster_id, [])
+        ident = (manifest.get("kind"), manifest.get("metadata", {}).get("name"))
+        for i, existing in enumerate(objs):
+            if (existing.get("kind"), existing.get("metadata", {}).get("name")) == ident:
+                objs[i] = manifest
+                return
+        objs.append(manifest)
+
+    def get_manifests(self, cluster_id: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        objs = self.manifests.get(cluster_id, [])
+        if kind is None:
+            return objs
+        return [o for o in objs if o.get("kind") == kind]

@@ -1,0 +1,281 @@
+"""The in-process apply/destroy/output engine.
+
+Reference analog: shell/run_terraform.go:63-185 — but instead of shelling out
+to terraform, this engine resolves the module graph itself: topological order
+from ``${module.x.y}`` references, per-module validate -> resolve -> apply
+against the driver, applied state persisted where the document's
+``terraform.backend`` block points. The reference's workflow-visible contract
+is preserved exactly:
+
+* apply is whole-graph and idempotent (create/node.go's scale-out path relies
+  on existing modules no-op'ing);
+* destroy supports ``targets`` fan-out (destroy/cluster.go:126-143);
+* output returns one module's outputs (get/cluster.go:15 -> ``terraform
+  output -module <key>``) — but from cached applied state, fixing the
+  reference's heavyweight init-per-read (SURVEY.md §3.5 note).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..state import StateDocument
+from ..modules import get_module
+from ..modules.base import DriverContext
+from .cloudsim import CloudSimulator
+from .interpolate import module_dependencies, resolve, topo_order
+from .plan import Plan, PlanAction, diff_states
+
+
+class ApplyError(RuntimeError):
+    pass
+
+
+class OutputError(KeyError):
+    pass
+
+
+# In-process stores for the "memory" executor backend (tests).
+_MEMORY_STATES: Dict[str, Dict[str, Any]] = {}
+
+
+@dataclass
+class ExecutorState:
+    """Applied-resource state (terraform.tfstate analog)."""
+
+    modules: Dict[str, Any] = field(default_factory=dict)
+    cloud: Dict[str, Any] = field(default_factory=dict)
+    serial: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"serial": self.serial, "modules": self.modules, "cloud": self.cloud}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExecutorState":
+        return ExecutorState(
+            modules=d.get("modules", {}),
+            cloud=d.get("cloud", {}),
+            serial=d.get("serial", 0),
+        )
+
+
+def _backend_location(doc: StateDocument) -> Dict[str, Any]:
+    cfg = doc.get("terraform.backend")
+    if not isinstance(cfg, dict) or not cfg:
+        # Default: local state in a per-name dir under the user cache.
+        return {"local": {"path": os.path.expanduser(
+            f"~/.triton-kubernetes-tpu/{doc.name}/terraform.tfstate")}}
+    return cfg
+
+
+def load_executor_state(doc: StateDocument) -> ExecutorState:
+    loc = _backend_location(doc)
+    if "memory" in loc:
+        raw = _MEMORY_STATES.get(loc["memory"]["name"])
+        # Deep-copy so callers can never alias the stored state.
+        return ExecutorState.from_dict(copy.deepcopy(raw)) if raw else ExecutorState()
+    if "local" in loc:
+        path = loc["local"]["path"]
+        if os.path.isfile(path):
+            with open(path) as f:
+                return ExecutorState.from_dict(json.load(f))
+        return ExecutorState()
+    if "objectstore" in loc:
+        path = os.path.join(
+            os.path.expanduser("~/.triton-kubernetes-tpu/.objectstore"),
+            loc["objectstore"]["path"],
+        )
+        if os.path.isfile(path):
+            with open(path) as f:
+                return ExecutorState.from_dict(json.load(f))
+        return ExecutorState()
+    raise ApplyError(f"unsupported executor backend: {list(loc)}")
+
+
+def save_executor_state(doc: StateDocument, est: ExecutorState) -> None:
+    est.serial += 1
+    loc = _backend_location(doc)
+    if "memory" in loc:
+        _MEMORY_STATES[loc["memory"]["name"]] = copy.deepcopy(est.to_dict())
+        return
+    if "local" in loc:
+        path = loc["local"]["path"]
+    elif "objectstore" in loc:
+        path = os.path.join(
+            os.path.expanduser("~/.triton-kubernetes-tpu/.objectstore"),
+            loc["objectstore"]["path"],
+        )
+    else:
+        raise ApplyError(f"unsupported executor backend: {list(loc)}")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(est.to_dict(), f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def delete_executor_state(doc: StateDocument) -> None:
+    loc = _backend_location(doc)
+    if "memory" in loc:
+        _MEMORY_STATES.pop(loc["memory"]["name"], None)
+    elif "local" in loc and os.path.isfile(loc["local"]["path"]):
+        os.unlink(loc["local"]["path"])
+    elif "objectstore" in loc:
+        path = os.path.join(
+            os.path.expanduser("~/.triton-kubernetes-tpu/.objectstore"),
+            loc["objectstore"]["path"],
+        )
+        if os.path.isfile(path):
+            os.unlink(path)
+
+
+class LocalExecutor:
+    """Drives modules in-process. The default executor everywhere."""
+
+    def __init__(self, log: Optional[Callable[[str], None]] = None):
+        self.log = log or (lambda msg: None)
+
+    # ------------------------------------------------------------------- plan
+    def plan(self, doc: StateDocument, targets: Optional[List[str]] = None) -> Plan:
+        desired = doc.get("module") or {}
+        est = load_executor_state(doc)
+        plan = diff_states(desired, est.modules, targets)
+        self._taint_dependents(plan, desired, targets)
+        return plan
+
+    @staticmethod
+    def _taint_dependents(plan: Plan, desired: Dict[str, Any],
+                          targets: Optional[List[str]]) -> None:
+        """A module whose dependency is being (re)applied must re-resolve its
+        interpolations even though its own config text is unchanged — configs
+        are compared *unresolved*, so without this, changed upstream outputs
+        would never propagate (terraform re-converges here; so must we)."""
+        deps = module_dependencies(desired)
+        tset = set(targets) if targets is not None else None
+        changed = True
+        while changed:
+            changed = False
+            for name, dset in deps.items():
+                if tset is not None and name not in tset:
+                    continue
+                if plan.actions.get(name) is PlanAction.NOOP and any(
+                    plan.actions.get(d) in (PlanAction.CREATE, PlanAction.UPDATE)
+                    for d in dset
+                ):
+                    plan.actions[name] = PlanAction.UPDATE
+                    changed = True
+
+    # ------------------------------------------------------------------ apply
+    def apply(self, doc: StateDocument, targets: Optional[List[str]] = None) -> Plan:
+        desired: Dict[str, Any] = doc.get("module") or {}
+        est = load_executor_state(doc)
+        plan = diff_states(desired, est.modules, targets)
+        self._taint_dependents(plan, desired, targets)
+        self.log(plan.summary())
+
+        cloud = CloudSimulator(est.cloud)
+        order = topo_order(desired)
+        outputs: Dict[str, Dict[str, Any]] = {
+            name: rec.get("outputs", {}) for name, rec in est.modules.items()
+        }
+
+        # State is saved even on a mid-apply failure, so resources provisioned
+        # before the error stay on record (terraform persists errored applies;
+        # dropping the record would orphan real resources behind a real driver).
+        try:
+            with tempfile.TemporaryDirectory(prefix="tk-tpu-apply-") as workdir:
+                for name in order:
+                    action = plan.actions.get(name, PlanAction.NOOP)
+                    if action not in (PlanAction.CREATE, PlanAction.UPDATE):
+                        continue
+                    raw_cfg = desired[name]
+                    module = get_module(raw_cfg.get("source", ""))
+                    cfg = module.validate(raw_cfg)
+                    try:
+                        resolved = resolve(cfg, outputs)
+                    except KeyError as e:
+                        raise ApplyError(f"module {name!r}: {e}") from e
+                    self.log(f"module.{name}: {action.value} ({module.SOURCE})")
+                    ctx = DriverContext(cloud=cloud, workdir=workdir, module_key=name)
+                    mod_outputs, resources = module.apply(resolved, ctx)
+                    missing = [o for o in module.OUTPUTS if o not in mod_outputs]
+                    if missing:
+                        raise ApplyError(
+                            f"module {name!r} did not produce outputs {missing}")
+                    outputs[name] = mod_outputs
+                    est.modules[name] = {
+                        # Deep-copied: the doc may be mutated after apply and
+                        # must not retroactively change the applied record.
+                        "config": copy.deepcopy(raw_cfg),
+                        "outputs": mod_outputs,
+                        "resources": [r.to_dict() for r in resources],
+                    }
+
+                # Modules present in applied state but gone from the doc:
+                # prune dependents-first (same ordering contract as destroy()).
+                delete_names = set(plan.by_action(PlanAction.DELETE))
+                cfgs = {n: est.modules[n].get("config", {}) for n in est.modules}
+                prune_order = [n for n in topo_order(cfgs) if n in delete_names]
+                for name in reversed(prune_order):
+                    self._destroy_one(name, est, cloud, workdir)
+        finally:
+            est.cloud = cloud.to_dict()
+            save_executor_state(doc, est)
+        return plan
+
+    # ---------------------------------------------------------------- destroy
+    def destroy(self, doc: StateDocument, targets: Optional[List[str]] = None) -> None:
+        """Destroy targeted modules (or everything when targets is None) —
+        RunTerraformDestroyWithState analog (shell/run_terraform.go:104)."""
+        est = load_executor_state(doc)
+        cloud = CloudSimulator(est.cloud)
+        names = list(est.modules) if targets is None else [
+            t for t in targets if t in est.modules
+        ]
+        # Reverse dependency order: dependents first.
+        cfgs = {n: est.modules[n].get("config", {}) for n in est.modules}
+        order = [n for n in topo_order(cfgs) if n in names]
+        with tempfile.TemporaryDirectory(prefix="tk-tpu-destroy-") as workdir:
+            for name in reversed(order):
+                self._destroy_one(name, est, cloud, workdir)
+        est.cloud = cloud.to_dict()
+        if targets is None:
+            delete_executor_state(doc)
+        else:
+            save_executor_state(doc, est)
+
+    def _destroy_one(self, name: str, est: ExecutorState,
+                     cloud: CloudSimulator, workdir: str) -> None:
+        rec = est.modules.get(name)
+        if rec is None:
+            return
+        self.log(f"module.{name}: destroy")
+        try:
+            module = get_module(rec.get("config", {}).get("source", ""))
+        except Exception:
+            module = None
+        ctx = DriverContext(cloud=cloud, workdir=workdir, module_key=name)
+        if module is not None:
+            module.destroy(rec, ctx)
+        else:
+            for rdict in reversed(rec.get("resources", [])):
+                cloud.delete_resource(rdict["type"], rdict["name"])
+        del est.modules[name]
+
+    # ----------------------------------------------------------------- output
+    def output(self, doc: StateDocument, module_key: str) -> Dict[str, Any]:
+        """One module's outputs from applied state (no re-init; fixes the
+        reference's heavyweight read path, SURVEY.md §3.5)."""
+        est = load_executor_state(doc)
+        if module_key not in est.modules:
+            raise OutputError(f"no applied module {module_key!r}")
+        return dict(est.modules[module_key].get("outputs", {}))
+
+    def cloud_view(self, doc: StateDocument) -> CloudSimulator:
+        """Read-only view of the simulated cloud (tests, `get` inspection)."""
+        return CloudSimulator(load_executor_state(doc).cloud)

@@ -1,0 +1,87 @@
+"""Escape-hatch executor: drive a real external ``terraform`` binary.
+
+Faithful to the reference's shell layer (shell/run_terraform.go:63-185,
+shell/run_shell_cmd.go:8-29): write the doc as ``main.tf.json`` into a fresh
+temp dir, side-load any pinned third-party provider plugins, ``terraform init
+-force-copy`` (so terraform copies its state to the configured backend), then
+``apply -auto-approve`` / ``destroy -auto-approve [-target=...]`` / ``output``,
+streaming stdio through to the operator.
+
+Used when a deployment actually targets real clouds with real HCL modules;
+the in-process LocalExecutor is the default for everything else.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from ..state import StateDocument
+
+
+class TerraformNotFoundError(RuntimeError):
+    pass
+
+
+class TerraformExecutor:
+    def __init__(self, binary: str = "terraform",
+                 plugin_dir: Optional[str] = None,
+                 stream_output: bool = True):
+        self.binary = binary
+        self.plugin_dir = plugin_dir
+        self.stream_output = stream_output
+
+    def _require_binary(self) -> str:
+        path = shutil.which(self.binary)
+        if path is None:
+            raise TerraformNotFoundError(
+                f"terraform binary {self.binary!r} not found on PATH")
+        return path
+
+    def _run(self, args: List[str], cwd: str) -> None:
+        """Stdio passthrough like the reference (shell/run_shell_cmd.go:10-12)."""
+        kwargs: Dict[str, Any] = {"cwd": cwd, "check": True}
+        if not self.stream_output:
+            kwargs.update(capture_output=True)
+        subprocess.run([self._require_binary(), *args], **kwargs)
+
+    def _workdir(self, doc: StateDocument) -> tempfile.TemporaryDirectory:
+        td = tempfile.TemporaryDirectory(prefix="tk-tpu-tf-")
+        with open(os.path.join(td.name, "main.tf.json"), "wb") as f:
+            f.write(doc.to_bytes())
+        if self.plugin_dir and os.path.isdir(self.plugin_dir):
+            # Side-loaded pinned plugins (reference: installThirdPartyProviders,
+            # shell/run_terraform.go:21-61, terraform-provider-rke SHA256-pinned).
+            dst = os.path.join(td.name, "terraform.d", "plugins")
+            shutil.copytree(self.plugin_dir, dst)
+        return td
+
+    def apply(self, doc: StateDocument, targets: Optional[List[str]] = None) -> None:
+        with self._workdir(doc) as cwd:
+            self._run(["init", "-force-copy"], cwd)
+            args = ["apply", "-auto-approve"]
+            for t in targets or []:
+                args.append(f"-target=module.{t}")
+            self._run(args, cwd)
+
+    def destroy(self, doc: StateDocument, targets: Optional[List[str]] = None) -> None:
+        with self._workdir(doc) as cwd:
+            self._run(["init", "-force-copy"], cwd)
+            args = ["destroy", "-auto-approve"]
+            for t in targets or []:
+                args.append(f"-target=module.{t}")
+            self._run(args, cwd)
+
+    def output(self, doc: StateDocument, module_key: str) -> Dict[str, Any]:
+        with self._workdir(doc) as cwd:
+            self._run(["init", "-force-copy"], cwd)
+            res = subprocess.run(
+                [self._require_binary(), "output", "-json",
+                 f"-module={module_key}"],
+                cwd=cwd, check=True, capture_output=True,
+            )
+            return json.loads(res.stdout or b"{}")

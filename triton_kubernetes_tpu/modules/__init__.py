@@ -1,0 +1,36 @@
+"""L1 provisioning modules: provider resource graphs.
+
+Reference analog: ``terraform/modules/**`` — 25 HCL modules in three families
+(``*-rancher`` manager, ``*-rancher-k8s`` cluster envelope,
+``*-rancher-k8s-host`` per-VM join) plus hosted-K8s (gke/aks) and backups
+(SURVEY.md §2.2). Here each module is a Python class with declared variables
+and outputs, applied in-process against a provider driver — and the GCP path
+gains the TPU fork (``gcp_tpu.py``): GKE clusters whose node pools are TPU
+v5e/v5p/v6e slices with ICI topology surfaced as node labels.
+"""
+
+from .base import DriverContext, Module, ModuleError, Resource
+from .registry import REGISTRY, get_module, module_name_from_source, register
+
+# Import provider modules for registration side effects.
+from . import bare_metal  # noqa: E402
+from . import triton  # noqa: E402
+from . import aws  # noqa: E402
+from . import gcp  # noqa: E402
+from . import azure  # noqa: E402
+from . import vsphere  # noqa: E402
+from . import gke  # noqa: E402
+from . import aks  # noqa: E402
+from . import gcp_tpu  # noqa: E402
+from . import backup  # noqa: E402
+
+__all__ = [
+    "DriverContext",
+    "Module",
+    "ModuleError",
+    "REGISTRY",
+    "Resource",
+    "get_module",
+    "module_name_from_source",
+    "register",
+]

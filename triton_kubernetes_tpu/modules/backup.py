@@ -1,0 +1,99 @@
+"""Backup modules: Velero-style cluster backup into object storage.
+
+Reference analog: modules/k8s-backup-manta (Heptio Ark v0.7.1 + a Minio→Manta
+gateway Deployment, main.tf:12-62) and modules/k8s-backup-s3 (Ark with AWS
+creds secret, main.tf:1-71). The TPU-era targets are GCS (new, first-class
+for checkpoints), S3, and Manta (parity). One backup per cluster, enforced at
+the workflow layer (create/backup.go:119-123 analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from .base import DriverContext, Module, Resource, Variable
+from .registry import register
+
+
+class _BackupBase(Module):
+    KIND = ""
+    OUTPUTS = ["backup_location"]
+    VARIABLES = [
+        Variable("cluster_name", required=True),
+        Variable("cluster_id", required=True),
+    ]
+
+    def location(self, config: Dict[str, Any]) -> str:
+        raise NotImplementedError
+
+    def extra_manifests(self, config: Dict[str, Any]) -> List[Dict[str, Any]]:
+        return []
+
+    def apply(self, config: Dict[str, Any], ctx: DriverContext
+              ) -> Tuple[Dict[str, Any], List[Resource]]:
+        cluster_id = config["cluster_id"]
+        loc = self.location(config)
+        manifests = [{
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "velero", "namespace": "velero"},
+            "spec": {"replicas": 1,
+                     "backupStorageLocation": {"provider": self.KIND,
+                                               "bucket": loc}},
+        }] + self.extra_manifests(config)
+        for m in manifests:
+            ctx.cloud.apply_manifest(cluster_id, m)
+        name = f"{config['cluster_name']}-backup"
+        ctx.cloud.create_resource("backup", name, kind=self.KIND, location=loc)
+        return {"backup_location": loc}, [Resource("backup", name)]
+
+
+@register
+class GcsBackup(_BackupBase):
+    SOURCE = "modules/k8s-backup-gcs"
+    KIND = "gcs"
+    VARIABLES = _BackupBase.VARIABLES + [
+        Variable("gcp_path_to_credentials", required=True),
+        Variable("gcs_bucket", required=True),
+    ]
+
+    def location(self, config: Dict[str, Any]) -> str:
+        return f"gs://{config['gcs_bucket']}/{config['cluster_name']}"
+
+
+@register
+class S3Backup(_BackupBase):
+    SOURCE = "modules/k8s-backup-s3"
+    KIND = "s3"
+    VARIABLES = _BackupBase.VARIABLES + [
+        Variable("aws_access_key", required=True),
+        Variable("aws_secret_key", required=True),
+        Variable("aws_region", default="us-east-1"),
+        Variable("aws_s3_bucket", required=True),
+    ]
+
+    def location(self, config: Dict[str, Any]) -> str:
+        return f"s3://{config['aws_s3_bucket']}/{config['cluster_name']}"
+
+
+@register
+class MantaBackup(_BackupBase):
+    SOURCE = "modules/k8s-backup-manta"
+    KIND = "manta"
+    VARIABLES = _BackupBase.VARIABLES + [
+        Variable("triton_account", required=True),
+        Variable("triton_key_path", required=True),
+        Variable("triton_key_id", required=True),
+        Variable("manta_subuser", default=""),
+    ]
+
+    def location(self, config: Dict[str, Any]) -> str:
+        return f"manta:/{config['triton_account']}/stor/{config['cluster_name']}-backup"
+
+    def extra_manifests(self, config: Dict[str, Any]) -> List[Dict[str, Any]]:
+        # The Minio→Manta gateway Deployment (k8s-backup-manta analog,
+        # files/minio-manta-deployment.yaml:30-55).
+        return [{
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "minio-manta-gateway", "namespace": "velero"},
+            "spec": {"replicas": 1},
+        }]

@@ -1,0 +1,68 @@
+"""Bare-metal modules: existing hosts driven over SSH.
+
+Reference analog: modules/bare-metal-rancher (pure null_resource/remote-exec,
+main.tf:1-121), modules/bare-metal-rancher-k8s (API call only),
+modules/bare-metal-rancher-k8s-host (SSH agent install). These are also the
+local test-bed modules (BASELINE config 1: 1-node CPU cluster on the local
+machine).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from .base import DriverContext, Resource, Variable
+from .family import ClusterModule, HostModule, ManagerModule
+from .registry import register
+
+
+@register
+class BareMetalManager(ManagerModule):
+    SOURCE = "modules/bare-metal-manager"
+    ALIASES = ("bare-metal-rancher",)
+    PROVIDER = "bare-metal"
+    VARIABLES = ManagerModule.VARIABLES + [
+        Variable("host", required=True),
+        Variable("ssh_user", default="root"),
+        Variable("key_path", default="~/.ssh/id_rsa"),
+        Variable("bastion_host", default=""),
+    ]
+
+    def apply(self, config: Dict[str, Any], ctx: DriverContext
+              ) -> Tuple[Dict[str, Any], List[Resource]]:
+        # No VM creation: adopt the named host (remote-exec analog).
+        name = config["name"]
+        ctx.cloud.create_resource(
+            "bare-metal_instance", f"{name}-manager",
+            ip=config["host"], role="manager", adopted=True)
+        url = f"https://{config['host']}"
+        creds = ctx.cloud.bootstrap_manager(name, url)
+        ctx.cloud.create_resource("manager", name, url=url)
+        resources = [Resource("bare-metal_instance", f"{name}-manager"),
+                     Resource("manager", name)]
+        return ({"manager_url": creds["url"],
+                 "manager_access_key": creds["access_key"],
+                 "manager_secret_key": creds["secret_key"]}, resources)
+
+
+@register
+class BareMetalCluster(ClusterModule):
+    SOURCE = "modules/bare-metal-k8s"
+    ALIASES = ("bare-metal-rancher-k8s",)
+    PROVIDER = "bare-metal"
+
+
+@register
+class BareMetalHost(HostModule):
+    SOURCE = "modules/bare-metal-k8s-host"
+    ALIASES = ("bare-metal-rancher-k8s-host",)
+    PROVIDER = "bare-metal"
+    VARIABLES = HostModule.VARIABLES + [
+        Variable("host", required=True),
+        Variable("ssh_user", default="root"),
+        Variable("key_path", default="~/.ssh/id_rsa"),
+        Variable("bastion_host", default=""),
+    ]
+
+    def instance_attrs(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        return {"ip": config["host"], "adopted": True}

@@ -1,0 +1,204 @@
+"""THE TPU FORK: GKE clusters whose worker capacity is TPU pod slices.
+
+This is the north-star deliverable (BASELINE.json): the GCP provider path
+provisions **TPU v5e/v5p/v6e node pools** (``tpu_topology`` placement, one
+node per TPU host) instead of GPU node pools; host software is the libtpu +
+JAX DaemonSet (topology/daemonsets.py) instead of docker/nvidia bootstrap;
+and every node carries ICI mesh-coordinate labels (topology/labels.py) so
+multi-host JAX jobs schedule slice-contiguously.
+
+Three modules:
+
+* ``gcp-tpu-k8s``       — GKE control plane + network, imported into the manager
+                          (gke-rancher-k8s analog, modules/gke-rancher-k8s/main.tf:18-82);
+* ``gcp-tpu-nodepool``  — one TPU slice as a node pool (the *-k8s-host analog:
+                          where the reference adds one VM per module, this adds
+                          one slice per module — the TPU-native unit of capacity);
+* ``tpu-jobset``        — a multi-host JAX workload (JobSet + headless service)
+                          pinned to a slice; how the bundled MaxText-class jobs
+                          (train/) are deployed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from ..topology import SliceSpec, host_labels_for_slice
+from ..topology.daemonsets import (
+    render_slice_health_daemonset,
+    render_tpu_device_plugin,
+    render_tpu_runtime_daemonset,
+)
+from ..topology.jobset import render_headless_service, render_jobset
+from .base import DriverContext, Module, ModuleError, Resource, Variable
+from .registry import register
+
+
+@register
+class GcpTpuCluster(Module):
+    """GKE control plane destined for TPU node pools, imported into the manager."""
+
+    SOURCE = "modules/gcp-tpu-k8s"
+    OUTPUTS = ["cluster_id", "endpoint", "gcp_compute_network_name"]
+    VARIABLES = [
+        Variable("name", required=True),
+        Variable("manager_url", required=True),
+        Variable("manager_access_key", required=True),
+        Variable("manager_secret_key", required=True),
+        Variable("gcp_path_to_credentials", required=True),
+        Variable("gcp_project_id", required=True),
+        Variable("gcp_region", default="us-east5"),
+        Variable("k8s_version", default="1.29"),
+        # System pool for non-TPU pods (device-plugin controllers, CoreDNS...).
+        Variable("system_node_count", default=1),
+        Variable("system_machine_type", default="n1-standard-4"),
+    ]
+
+    def apply(self, config: Dict[str, Any], ctx: DriverContext
+              ) -> Tuple[Dict[str, Any], List[Resource]]:
+        name = config["name"]
+        net = f"{name}-network"
+        ctx.cloud.create_resource("gcp_compute_network", net)
+        # DCN-facing firewall: jax.distributed coordinator + health ports only.
+        # ICI traffic never touches cloud networking (SURVEY.md §5).
+        ctx.cloud.create_resource("gcp_compute_firewall", f"{name}-dcn",
+                                  ports=[22, 443, 6443, 8471, 8476, 8480])
+        hosted = ctx.cloud.create_hosted_cluster(
+            "gke", name,
+            project=config["gcp_project_id"],
+            region=config.get("gcp_region"),
+            k8s_version=config.get("k8s_version"),
+            network=net,
+        )
+        ctx.cloud.create_node_pool(
+            "gke", name, "system-pool",
+            node_count=int(config.get("system_node_count", 1)),
+            machine_type=config.get("system_machine_type"),
+        )
+        imported = ctx.cloud.create_or_get_cluster(
+            config["manager_url"], name, imported=True, kind="gke-tpu")
+        ctx.cloud.create_resource("cluster", imported["id"], cluster_name=name)
+        resources = [Resource("gcp_compute_network", net),
+                     Resource("gcp_compute_firewall", f"{name}-dcn"),
+                     Resource("gke_cluster", name),
+                     Resource("cluster", imported["id"])]
+        return ({"cluster_id": imported["id"],
+                 "endpoint": hosted["endpoint"],
+                 "gcp_compute_network_name": net}, resources)
+
+
+@register
+class GcpTpuNodePool(Module):
+    """One TPU slice as a GKE node pool: the TPU-native unit of capacity.
+
+    Replaces the ``*-rancher-k8s-host`` per-VM pattern: node count is derived
+    from the slice topology (one Kubernetes node per TPU host), nodes carry
+    ICI coordinates as labels, and the libtpu/JAX runtime + device plugin +
+    slice-health DaemonSets are installed on first pool creation.
+    """
+
+    SOURCE = "modules/gcp-tpu-nodepool"
+    OUTPUTS = ["slice_id", "topology", "num_hosts", "num_chips", "node_names"]
+    VARIABLES = [
+        Variable("pool_name", required=True),
+        Variable("gke_cluster_name", required=True),
+        Variable("cluster_id", required=True),
+        Variable("gcp_path_to_credentials", required=True),
+        Variable("gcp_project_id", required=True),
+        Variable("tpu_accelerator", required=True),  # e.g. "v5p-64"
+        Variable("tpu_topology", default=""),  # e.g. "4x4x4"; derived if empty
+        Variable("reserved", default=False),
+        Variable("spot", default=False),
+        Variable("runtime_image", default=""),
+    ]
+
+    def apply(self, config: Dict[str, Any], ctx: DriverContext
+              ) -> Tuple[Dict[str, Any], List[Resource]]:
+        spec = SliceSpec.from_accelerator(
+            config["tpu_accelerator"], config.get("tpu_topology") or None)
+        pool_name = config["pool_name"]
+        cluster_name = config["gke_cluster_name"]
+        slice_id = f"{cluster_name}-{pool_name}"
+        labels = host_labels_for_slice(spec, slice_id)
+        pool = ctx.cloud.create_node_pool(
+            "gke", cluster_name, pool_name,
+            node_count=spec.num_hosts,
+            node_labels=labels,
+            machine_type=spec.generation.machine_type,
+            accelerator=spec.generation.gke_accelerator,
+            tpu_topology=spec.topology,  # GKE placement: physical slice shape
+            placement_policy={"type": "COMPACT", "tpu_topology": spec.topology},
+            reserved=bool(config.get("reserved")),
+            spot=bool(config.get("spot")),
+        )
+        cluster_id = config["cluster_id"]
+        kwargs = {}
+        if config.get("runtime_image"):
+            kwargs["image"] = config["runtime_image"]
+        for manifest in (render_tpu_runtime_daemonset(spec, **kwargs),
+                         render_tpu_device_plugin(spec),
+                         render_slice_health_daemonset(spec, **kwargs)):
+            ctx.cloud.apply_manifest(cluster_id, manifest)
+        resources = [Resource("gke_node_pool", f"{cluster_name}/{pool_name}")]
+        return ({
+            "slice_id": slice_id,
+            "topology": spec.topology,
+            "num_hosts": spec.num_hosts,
+            "num_chips": spec.chips,
+            "node_names": [n["name"] for n in pool["nodes"]],
+        }, resources)
+
+    def destroy(self, applied: Dict[str, Any], ctx: DriverContext) -> None:
+        cfg = applied.get("config", {})
+        cluster = ctx.cloud.get_resource("gke_cluster", cfg.get("gke_cluster_name", ""))
+        if cluster:
+            cluster.get("node_pools", {}).pop(cfg.get("pool_name", ""), None)
+        super().destroy(applied, ctx)
+
+
+@register
+class TpuJobSet(Module):
+    """A multi-host JAX workload pinned to one slice (JobSet + headless svc).
+
+    This is how the bundled training jobs deploy: ``jax.distributed`` init
+    over DCN via the headless service, collectives over ICI within the slice.
+    """
+
+    SOURCE = "modules/tpu-jobset"
+    OUTPUTS = ["job_name", "num_workers", "coordinator"]
+    VARIABLES = [
+        Variable("job_name", required=True),
+        Variable("cluster_id", required=True),
+        Variable("tpu_accelerator", required=True),
+        Variable("tpu_topology", default=""),
+        Variable("slice_id", required=True),
+        Variable("image", default="tk8s/jax-tpu-runtime:0.1.0"),
+        Variable("command", default=["python", "-c", "import jax; print(jax.devices())"]),
+        Variable("env", default={}),
+        Variable("namespace", default="default"),
+    ]
+
+    def apply(self, config: Dict[str, Any], ctx: DriverContext
+              ) -> Tuple[Dict[str, Any], List[Resource]]:
+        spec = SliceSpec.from_accelerator(
+            config["tpu_accelerator"], config.get("tpu_topology") or None)
+        name = config["job_name"]
+        cluster_id = config["cluster_id"]
+        svc = render_headless_service(name, config.get("namespace", "default"))
+        job = render_jobset(
+            name, spec, config["slice_id"],
+            image=config.get("image", ""),
+            command=list(config.get("command") or []),
+            namespace=config.get("namespace", "default"),
+            env=dict(config.get("env") or {}),
+        )
+        ctx.cloud.apply_manifest(cluster_id, svc)
+        ctx.cloud.apply_manifest(cluster_id, job)
+        coordinator = job["spec"]["template"]["spec"]["containers"][0]
+        coord_env = {e["name"]: e.get("value") for e in coordinator["env"]
+                     if "value" in e}
+        return ({
+            "job_name": name,
+            "num_workers": spec.num_hosts,
+            "coordinator": coord_env["JAX_COORDINATOR_ADDRESS"],
+        }, [Resource("k8s_job", name)])

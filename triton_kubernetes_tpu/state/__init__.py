@@ -1,0 +1,26 @@
+"""L3 state layer: the declarative cluster-topology document.
+
+Reference analog: ``state/state.go:10-186`` (a gabs JSON container holding the
+``main.tf.json`` Terraform config document, with path-addressed get/set and the
+module naming conventions ``module.cluster-manager``,
+``module.cluster_{provider}_{name}``, ``module.node_{provider}_{cluster}_{host}``,
+``module.backup_{clusterKey}``).
+"""
+
+from .document import (
+    ClusterKeyError,
+    StateDocument,
+    cluster_key,
+    node_key,
+    parse_cluster_key,
+    parse_node_key,
+)
+
+__all__ = [
+    "ClusterKeyError",
+    "StateDocument",
+    "cluster_key",
+    "node_key",
+    "parse_cluster_key",
+    "parse_node_key",
+]

@@ -1,0 +1,42 @@
+"""TPU slice topology: generations, ICI meshes, node labels, JobSets.
+
+No reference analog — this is the layer the TPU fork adds (SURVEY.md §2.5:
+"slice-contiguous scheduling ... has no reference analog at all"). It owns:
+
+* the TPU generation table (v4/v5e/v5p/v6e: chips/host, peak TFLOPs, HBM,
+  ICI torus rank) and slice-shape arithmetic;
+* the node-label scheme that surfaces ICI mesh coordinates to the Kubernetes
+  scheduler so multi-host JAX jobs land slice-contiguously;
+* JobSet + headless-service rendering for ``jax.distributed`` initialization.
+"""
+
+from .slices import (
+    TPU_GENERATIONS,
+    SliceSpec,
+    TpuGeneration,
+    default_topology,
+    parse_accelerator,
+)
+from .labels import (
+    GKE_ACCELERATOR_LABEL,
+    GKE_TOPOLOGY_LABEL,
+    LABEL_PREFIX,
+    host_labels_for_slice,
+    selector_for_slice,
+)
+from .jobset import render_headless_service, render_jobset
+
+__all__ = [
+    "GKE_ACCELERATOR_LABEL",
+    "GKE_TOPOLOGY_LABEL",
+    "LABEL_PREFIX",
+    "SliceSpec",
+    "TPU_GENERATIONS",
+    "TpuGeneration",
+    "default_topology",
+    "host_labels_for_slice",
+    "parse_accelerator",
+    "render_headless_service",
+    "render_jobset",
+    "selector_for_slice",
+]

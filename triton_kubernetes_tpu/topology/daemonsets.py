@@ -1,0 +1,130 @@
+"""DaemonSet manifests for TPU node pools: libtpu/JAX runtime + device plugin
++ slice-health probe.
+
+This replaces the reference's per-VM bootstrap role (Packer-baked images +
+install_docker_rancher.sh.tpl / nvidia-era device plumbing, SURVEY.md §2.5
+table): on TPU node pools, host software is declarative — a DaemonSet that
+ships libtpu + a pinned JAX/XLA runtime, the TPU device plugin that exposes
+``google.com/tpu`` resources, and a health DaemonSet whose readiness gate is
+"libtpu can enumerate all local chips" (the slice-health probe SURVEY.md §5
+calls for).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .labels import GKE_ACCELERATOR_LABEL
+from .slices import SliceSpec
+
+DEFAULT_RUNTIME_IMAGE = "tk8s/jax-tpu-runtime:0.1.0"
+DEFAULT_DEVICE_PLUGIN_IMAGE = "tk8s/tpu-device-plugin:0.1.0"
+
+
+def _tpu_node_selector(spec: SliceSpec) -> Dict[str, str]:
+    return {GKE_ACCELERATOR_LABEL: spec.generation.gke_accelerator}
+
+
+def render_tpu_runtime_daemonset(spec: SliceSpec,
+                                 image: str = DEFAULT_RUNTIME_IMAGE,
+                                 namespace: str = "kube-system") -> Dict[str, Any]:
+    """libtpu + JAX/XLA runtime DaemonSet (nvidia-docker analog, TPU-native)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": "tpu-jax-runtime", "namespace": namespace},
+        "spec": {
+            "selector": {"matchLabels": {"app": "tpu-jax-runtime"}},
+            "template": {
+                "metadata": {"labels": {"app": "tpu-jax-runtime"}},
+                "spec": {
+                    "nodeSelector": _tpu_node_selector(spec),
+                    "hostNetwork": True,  # ICI/DCN init needs host networking
+                    "containers": [{
+                        "name": "runtime",
+                        "image": image,
+                        "securityContext": {"privileged": True},
+                        "volumeMounts": [
+                            {"name": "libtpu", "mountPath": "/lib/libtpu"},
+                            {"name": "dev", "mountPath": "/dev"},
+                        ],
+                        "env": [
+                            {"name": "TPU_CHIPS_PER_HOST",
+                             "value": str(spec.generation.chips_per_host)},
+                        ],
+                    }],
+                    "volumes": [
+                        {"name": "libtpu", "hostPath": {"path": "/lib/libtpu"}},
+                        {"name": "dev", "hostPath": {"path": "/dev"}},
+                    ],
+                },
+            },
+        },
+    }
+
+
+def render_tpu_device_plugin(spec: SliceSpec,
+                             image: str = DEFAULT_DEVICE_PLUGIN_IMAGE,
+                             namespace: str = "kube-system") -> Dict[str, Any]:
+    """Device plugin advertising ``google.com/tpu`` (nvidia-device-plugin analog)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": "tpu-device-plugin", "namespace": namespace},
+        "spec": {
+            "selector": {"matchLabels": {"app": "tpu-device-plugin"}},
+            "template": {
+                "metadata": {"labels": {"app": "tpu-device-plugin"}},
+                "spec": {
+                    "nodeSelector": _tpu_node_selector(spec),
+                    "priorityClassName": "system-node-critical",
+                    "containers": [{
+                        "name": "device-plugin",
+                        "image": image,
+                        "volumeMounts": [{
+                            "name": "device-plugin-sock",
+                            "mountPath": "/var/lib/kubelet/device-plugins",
+                        }],
+                    }],
+                    "volumes": [{
+                        "name": "device-plugin-sock",
+                        "hostPath": {"path": "/var/lib/kubelet/device-plugins"},
+                    }],
+                },
+            },
+        },
+    }
+
+
+def render_slice_health_daemonset(spec: SliceSpec,
+                                  image: str = DEFAULT_RUNTIME_IMAGE,
+                                  namespace: str = "kube-system") -> Dict[str, Any]:
+    """Readiness = libtpu enumerates all local chips (slice-health probe)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": "tpu-slice-health", "namespace": namespace},
+        "spec": {
+            "selector": {"matchLabels": {"app": "tpu-slice-health"}},
+            "template": {
+                "metadata": {"labels": {"app": "tpu-slice-health"}},
+                "spec": {
+                    "nodeSelector": _tpu_node_selector(spec),
+                    "containers": [{
+                        "name": "probe",
+                        "image": image,
+                        "command": ["python", "-c",
+                                    "import jax; assert len(jax.local_devices()) == "
+                                    f"{spec.generation.chips_per_host}"],
+                        "readinessProbe": {
+                            "exec": {"command": [
+                                "python", "-c",
+                                "import jax; assert len(jax.local_devices()) == "
+                                f"{spec.generation.chips_per_host}"]},
+                            "periodSeconds": 60,
+                        },
+                    }],
+                },
+            },
+        },
+    }

@@ -1,0 +1,99 @@
+"""JobSet + headless-service rendering for multi-host JAX workloads.
+
+The reference's per-VM bootstrap was a bash template baked into cloud-init
+(install_rancher_agent.sh.tpl). The TPU-native equivalent is declarative: a
+headless Service gives every worker a stable DNS name, and a JobSet-style
+indexed Job provides ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` plus the
+``jax.distributed`` coordinator address (worker 0), which is all
+``jax.distributed.initialize()`` needs over DCN. Within a slice, collectives
+ride ICI with no Kubernetes networking involvement at all — hence hostNetwork
+for the coordinator port only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .labels import selector_for_slice
+from .slices import SliceSpec
+
+COORDINATOR_PORT = 8476
+
+
+def render_headless_service(name: str, namespace: str = "default") -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "clusterIP": "None",  # headless: DNS per pod
+            "selector": {"jobset.tk8s.io/name": name},
+            "ports": [{"name": "jax-coordinator", "port": COORDINATOR_PORT}],
+        },
+    }
+
+
+def render_jobset(
+    name: str,
+    spec: SliceSpec,
+    slice_id: str,
+    image: str,
+    command: List[str],
+    namespace: str = "default",
+    env: Optional[Dict[str, str]] = None,
+    completions: Optional[int] = None,
+) -> Dict[str, Any]:
+    """An indexed-Job manifest: one pod per TPU host of the slice."""
+    n = completions if completions is not None else spec.num_hosts
+    hostnames = ",".join(
+        f"{name}-{i}.{name}.{namespace}.svc" for i in range(n))
+    coordinator = f"{name}-0.{name}.{namespace}.svc:{COORDINATOR_PORT}"
+    base_env = {
+        "TPU_WORKER_HOSTNAMES": hostnames,
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "TPU_TOPOLOGY": spec.topology,
+        "TPU_CHIPS_PER_HOST": str(spec.generation.chips_per_host),
+        "NUM_TPU_WORKERS": str(n),
+    }
+    base_env.update(env or {})
+    container = {
+        "name": "worker",
+        "image": image,
+        "command": command,
+        "env": (
+            [{"name": k, "value": v} for k, v in sorted(base_env.items())]
+            + [{
+                # Worker id comes from the indexed-Job completion index.
+                "name": "TPU_WORKER_ID",
+                "valueFrom": {"fieldRef": {
+                    "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"}},
+            }]
+        ),
+        "ports": [{"containerPort": COORDINATOR_PORT}],
+        "resources": {"limits": {"google.com/tpu": str(spec.generation.chips_per_host)}},
+    }
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": {"jobset.tk8s.io/name": name,
+                       "jobset.tk8s.io/slice-id": slice_id},
+        },
+        "spec": {
+            "completions": n,
+            "parallelism": n,
+            "completionMode": "Indexed",
+            "backoffLimit": 0,
+            "template": {
+                "metadata": {"labels": {"jobset.tk8s.io/name": name}},
+                "spec": {
+                    "subdomain": name,  # pairs with the headless service
+                    "restartPolicy": "Never",
+                    "nodeSelector": selector_for_slice(spec, slice_id),
+                    "containers": [container],
+                },
+            },
+        },
+    }

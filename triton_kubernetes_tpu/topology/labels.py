@@ -1,0 +1,58 @@
+"""Kubernetes node-label scheme carrying ICI mesh coordinates.
+
+The north-star requirement (BASELINE.json): "surface ICI mesh coordinates as
+Kubernetes node labels so multi-host JAX jobs schedule slice-contiguously".
+Two label families land on every TPU node:
+
+* the standard GKE selectors (``cloud.google.com/gke-tpu-accelerator``,
+  ``cloud.google.com/gke-tpu-topology``) that TPU-aware schedulers and
+  device plugins already understand;
+* our own ``tpu.tk8s.io/*`` labels: slice id, worker id, and the host's ICI
+  coordinates (``ici-x``/``ici-y``/``ici-z``) so placement policies and
+  debugging tools can reason about physical adjacency without provider APIs.
+
+The reference's closest analog is the Rancher host-role labels
+(``rancherHostLabelsConfig``, create/node.go: worker/etcd/control) — the same
+"make topology visible to the scheduler as labels" move, one layer down.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .slices import SliceSpec
+
+LABEL_PREFIX = "tpu.tk8s.io"
+GKE_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+AXIS_NAMES = ("x", "y", "z")
+
+
+def host_labels_for_slice(spec: SliceSpec, slice_id: str) -> List[Dict[str, str]]:
+    """Per-host label dicts for one slice, in TPU_WORKER_ID order."""
+    out: List[Dict[str, str]] = []
+    for worker_id, coord in enumerate(spec.host_coordinates()):
+        labels = {
+            GKE_ACCELERATOR_LABEL: spec.generation.gke_accelerator,
+            GKE_TOPOLOGY_LABEL: spec.topology,
+            f"{LABEL_PREFIX}/generation": spec.generation.name,
+            f"{LABEL_PREFIX}/slice-id": slice_id,
+            f"{LABEL_PREFIX}/worker-id": str(worker_id),
+            f"{LABEL_PREFIX}/num-workers": str(spec.num_hosts),
+            f"{LABEL_PREFIX}/chips-per-host": str(spec.generation.chips_per_host),
+        }
+        for axis, c in zip(AXIS_NAMES, coord):
+            labels[f"{LABEL_PREFIX}/ici-{axis}"] = str(c)
+        out.append(labels)
+    return out
+
+
+def selector_for_slice(spec: SliceSpec, slice_id: str) -> Dict[str, str]:
+    """nodeSelector that pins a workload to one slice — the guarantee that a
+    64-chip job never straddles slices (SURVEY.md §7 "hard parts")."""
+    return {
+        GKE_ACCELERATOR_LABEL: spec.generation.gke_accelerator,
+        GKE_TOPOLOGY_LABEL: spec.topology,
+        f"{LABEL_PREFIX}/slice-id": slice_id,
+    }

@@ -1,0 +1,139 @@
+"""TPU generation table and slice-shape arithmetic.
+
+Accelerator names follow the ``<gen>-<chips>`` convention used throughout
+BASELINE.md (v5e-8, v5p-64, v5p-256): the number is the **chip count** of the
+slice. Peak-FLOPs numbers are the public per-chip bf16 figures and drive MFU
+accounting in ``train/mfu.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class TpuGeneration:
+    name: str
+    chips_per_host: int
+    peak_bf16_tflops: float
+    hbm_gb_per_chip: float
+    ici_rank: int  # 2 => 2D torus (v5e/v6e), 3 => 3D torus (v4/v5p)
+    gke_accelerator: str  # GKE nodeSelector accelerator value
+    machine_type: str  # GKE TPU machine type family
+    max_chips: int
+
+
+TPU_GENERATIONS: Dict[str, TpuGeneration] = {
+    "v4": TpuGeneration("v4", 4, 275.0, 32.0, 3, "tpu-v4-podslice", "ct4p-hightpu-4t", 4096),
+    "v5e": TpuGeneration("v5e", 4, 197.0, 16.0, 2, "tpu-v5-lite-podslice", "ct5lp-hightpu-4t", 256),
+    "v5p": TpuGeneration("v5p", 4, 459.0, 95.0, 3, "tpu-v5p-slice", "ct5p-hightpu-4t", 8192),
+    "v6e": TpuGeneration("v6e", 4, 918.0, 32.0, 2, "tpu-v6e-slice", "ct6e-standard-4t", 256),
+}
+
+
+def parse_accelerator(name: str) -> Tuple[TpuGeneration, int]:
+    """``"v5p-64"`` -> (v5p generation, 64 chips)."""
+    gen_name, sep, count = name.partition("-")
+    if gen_name not in TPU_GENERATIONS:
+        raise ValueError(
+            f"unknown TPU generation {gen_name!r}; know {sorted(TPU_GENERATIONS)}")
+    if not sep or not count.isdigit() or int(count) < 1:
+        raise ValueError(f"accelerator must be <gen>-<chips>, got {name!r}")
+    gen = TPU_GENERATIONS[gen_name]
+    chips = int(count)
+    if chips > gen.max_chips:
+        raise ValueError(f"{gen_name} slices max out at {gen.max_chips} chips")
+    return gen, chips
+
+
+def _balanced_factors(n: int, rank: int) -> List[int]:
+    """Near-balanced factorization of n into `rank` factors, largest last —
+    the shape XLA's ICI mesh wants (keep dims even where possible)."""
+    dims = [1] * rank
+    remaining = n
+    # Greedy: repeatedly pull the smallest prime factor into the smallest dim.
+    factors: List[int] = []
+    d = 2
+    while d * d <= remaining:
+        while remaining % d == 0:
+            factors.append(d)
+            remaining //= d
+        d += 1
+    if remaining > 1:
+        factors.append(remaining)
+    for f in sorted(factors, reverse=True):
+        dims[dims.index(min(dims))] *= f
+    return sorted(dims)
+
+
+def default_topology(gen: TpuGeneration, chips: int) -> str:
+    """Default ICI topology string for a slice, e.g. ``"4x4x4"`` (v5p-64) or
+    ``"2x4"`` (v5e-8). Matches GKE's ``tpu-topology`` placement format."""
+    if chips == 1:
+        return "x".join(["1"] * gen.ici_rank)
+    dims = _balanced_factors(chips, gen.ici_rank)
+    return "x".join(str(d) for d in dims)
+
+
+@dataclass(frozen=True)
+class SliceSpec:
+    """A fully-resolved slice: generation + chip count + topology."""
+
+    generation: TpuGeneration
+    chips: int
+    topology: str
+
+    @staticmethod
+    def from_accelerator(name: str, topology: str | None = None) -> "SliceSpec":
+        gen, chips = parse_accelerator(name)
+        topo = topology or default_topology(gen, chips)
+        dims = [int(d) for d in topo.split("x")]
+        prod = 1
+        for d in dims:
+            prod *= d
+        if prod != chips:
+            raise ValueError(
+                f"topology {topo} has {prod} chips but accelerator says {chips}")
+        return SliceSpec(gen, chips, topo)
+
+    @property
+    def dims(self) -> List[int]:
+        return [int(d) for d in self.topology.split("x")]
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.chips // self.generation.chips_per_host)
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def peak_bf16_tflops(self) -> float:
+        return self.chips * self.generation.peak_bf16_tflops
+
+    def chip_coordinates(self) -> List[Tuple[int, ...]]:
+        """All chip coordinates in the ICI torus, x-major (matches the
+        TPU_WORKER_ID host-enumeration order)."""
+        dims = self.dims
+        coords: List[Tuple[int, ...]] = []
+
+        def rec(prefix: Tuple[int, ...], rest: List[int]) -> None:
+            if not rest:
+                coords.append(prefix)
+                return
+            for i in range(rest[0]):
+                rec(prefix + (i,), rest[1:])
+
+        # Iterate last dim fastest so consecutive chips are ICI neighbors.
+        rec((), dims)
+        return coords
+
+    def host_coordinates(self) -> List[Tuple[int, ...]]:
+        """One coordinate per host: the coordinate of its first chip.
+        Hosts own ``chips_per_host`` consecutive chips in enumeration order."""
+        chips = self.chip_coordinates()
+        step = self.generation.chips_per_host if self.chips > 1 else self.chips
+        step = min(step, len(chips))
+        return [chips[i] for i in range(0, len(chips), step)]
