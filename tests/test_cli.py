@@ -1,0 +1,127 @@
+"""CLI tests (cmd/version_test.go analog + silent-mode command drives)."""
+
+import json
+
+import pytest
+
+from triton_kubernetes_tpu import __version__
+from triton_kubernetes_tpu.backends import MemoryBackend
+from triton_kubernetes_tpu.cli.main import main
+from triton_kubernetes_tpu.config import ScriptedPrompter
+from triton_kubernetes_tpu.executor import LocalExecutor
+from triton_kubernetes_tpu.executor.engine import _MEMORY_STATES
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    _MEMORY_STATES.clear()
+
+
+def test_version_output(capsys):
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out.strip()
+    assert out.startswith(__version__)
+    assert "(" in out and out.endswith(")")
+
+
+def test_no_command_prints_help(capsys):
+    assert main([]) == 1
+    assert "create" in capsys.readouterr().out
+
+
+def test_bad_set_flag(capsys):
+    assert main(["--set", "noequals", "create", "manager"]) == 2
+
+
+def test_silent_create_manager_and_get(capsys):
+    be = MemoryBackend()
+    ex = LocalExecutor()
+    rc = main([
+        "--non-interactive",
+        "--set", "manager_cloud_provider=bare-metal",
+        "--set", "name=m1",
+        "--set", "host=10.0.0.5",
+        "create", "manager",
+    ], backend=be, executor=ex)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "created: m1" in out
+
+    rc = main(["--non-interactive", "--set", "cluster_manager=m1",
+               "get", "manager"], backend=be, executor=ex)
+    assert rc == 0
+    outputs = json.loads(capsys.readouterr().out)
+    assert outputs["manager_url"].startswith("https://")
+
+
+def test_silent_missing_key_is_error(capsys):
+    be = MemoryBackend()
+    rc = main(["--non-interactive", "--set", "manager_cloud_provider=bare-metal",
+               "create", "manager"], backend=be, executor=LocalExecutor())
+    assert rc == 1
+    assert "name must be specified" in capsys.readouterr().err
+
+
+def test_yaml_config_file_flow(tmp_path, capsys):
+    """Silent-install YAML: manager + TPU cluster from files, like the
+    reference's examples/silent-install."""
+    be = MemoryBackend()
+    ex = LocalExecutor()
+    mgr_yaml = tmp_path / "manager.yaml"
+    mgr_yaml.write_text(
+        "manager_cloud_provider: bare-metal\n"
+        "name: prod\n"
+        "host: 192.168.0.2\n")
+    assert main(["--non-interactive", "--config", str(mgr_yaml),
+                 "create", "manager"], backend=be, executor=ex) == 0
+
+    cl_yaml = tmp_path / "cluster.yaml"
+    cl_yaml.write_text(
+        "cluster_manager: prod\n"
+        "cluster_cloud_provider: gcp-tpu\n"
+        "name: ml\n"
+        "gcp_path_to_credentials: /tmp/creds.json\n"
+        "gcp_project_id: proj\n"
+        "nodes:\n"
+        "  - hostname: pool0\n"
+        "    tpu_accelerator: v5p-64\n")
+    assert main(["--non-interactive", "--config", str(cl_yaml),
+                 "create", "cluster"], backend=be, executor=ex) == 0
+    out = capsys.readouterr().out
+    assert "created: cluster_gcp-tpu_ml" in out
+
+    assert main(["--non-interactive", "--set", "cluster_manager=prod",
+                 "--set", "cluster_name=ml", "get", "cluster"],
+                backend=be, executor=ex) == 0
+    outputs = json.loads(capsys.readouterr().out)
+    assert outputs["cluster_id"].startswith("c-")
+
+
+def test_interactive_prompter_wiring(capsys):
+    """Scripted prompter through the CLI path (interactive mode)."""
+    be = MemoryBackend()
+    rc = main(["create", "manager"],
+              prompter=ScriptedPrompter([
+                  "bare-metal", "m2", "", "", "", "", "10.0.0.9",
+                  "", "", "", "Yes"]),
+              backend=be, executor=LocalExecutor())
+    assert rc == 0
+    assert be.states() == ["m2"]
+
+
+def test_destroy_cluster_via_cli(capsys):
+    be = MemoryBackend()
+    ex = LocalExecutor()
+    main(["--non-interactive", "--set", "manager_cloud_provider=bare-metal",
+          "--set", "name=m1", "--set", "host=10.0.0.5",
+          "create", "manager"], backend=be, executor=ex)
+    main(["--non-interactive", "--set", "cluster_manager=m1",
+          "--set", "cluster_cloud_provider=bare-metal", "--set", "name=c1",
+          "create", "cluster"], backend=be, executor=ex)
+    rc = main(["--non-interactive", "--set", "cluster_manager=m1",
+               "--set", "cluster_name=c1", "destroy", "cluster"],
+              backend=be, executor=ex)
+    assert rc == 0
+    doc = be.state("m1")
+    assert doc.clusters() == {}

@@ -1,0 +1,90 @@
+"""Config precedence + tri-modal resolver tests (viper/promptui analogs)."""
+
+import pytest
+
+from triton_kubernetes_tpu.config import (
+    Config,
+    InputResolver,
+    MissingInputError,
+    ScriptedPrompter,
+    ValidationError,
+)
+from triton_kubernetes_tpu.config.config import _mini_yaml
+
+
+def test_precedence_override_file_env(tmp_path):
+    f = tmp_path / "c.yaml"
+    f.write_text("name: from-file\nregion: file-region\n")
+    cfg = Config(config_file=str(f), env={"TK8S_NAME": "from-env", "TK8S_ZONE": "env-zone"})
+    assert cfg.get("name") == "from-file"  # file beats env
+    assert cfg.get("zone") == "env-zone"  # env as fallback (AutomaticEnv analog)
+    cfg.set("name", "explicit")
+    assert cfg.get("name") == "explicit"  # override beats all
+    assert cfg.is_set("region") and cfg.is_set("zone") and not cfg.is_set("nope")
+
+
+def test_env_scalars_parsed():
+    cfg = Config(env={"TK8S_COUNT": "3", "TK8S_HA": "true"})
+    assert cfg.get("count") == 3
+    assert cfg.get("ha") is True
+
+
+def test_mini_yaml_parses_silent_install_shape():
+    text = """
+# comment
+cluster_manager: mgr
+name: gcp-ha
+k8s_version: v1.29.10
+ha: false
+nodes:
+  - node_count: 3
+    rancher_host_label: etcd
+    hostname: gcp-ha-e
+  - node_count: 4
+    rancher_host_label: worker
+    hostname: gcp-ha-w
+"""
+    d = _mini_yaml(text)
+    assert d["cluster_manager"] == "mgr"
+    assert d["ha"] is False
+    assert len(d["nodes"]) == 2
+    assert d["nodes"][0] == {"node_count": 3, "rancher_host_label": "etcd",
+                             "hostname": "gcp-ha-e"}
+
+
+def test_resolver_tri_modal():
+    cfg = Config(env={})
+    cfg.set("present", "x")
+    r_silent = InputResolver(cfg, None, non_interactive=True)
+    assert r_silent.value("present") == "x"
+    with pytest.raises(MissingInputError, match="absent must be specified"):
+        r_silent.value("absent")
+    assert r_silent.value("absent", default="d") == "d"
+
+    r_prompt = InputResolver(Config(env={}), ScriptedPrompter(["typed"]), False)
+    assert r_prompt.value("absent", "Label") == "typed"
+
+
+def test_resolver_choose_validates_configured_value():
+    cfg = Config(env={})
+    cfg.set("color", "purple")
+    r = InputResolver(cfg, None, True)
+    with pytest.raises(ValidationError, match="not a valid choice"):
+        r.choose("color", "Color", [("red", "red"), ("blue", "blue")])
+    cfg.set("color", "blue")
+    assert r.choose("color", "Color", [("red", "red"), ("blue", "blue")]) == "blue"
+
+
+def test_resolver_validate_on_configured_value():
+    cfg = Config(env={})
+    cfg.set("pw", "short")
+    r = InputResolver(cfg, None, True)
+    with pytest.raises(ValidationError):
+        r.value("pw", validate=lambda v: None if len(v) >= 16 else "too short")
+
+
+def test_confirm_auto_in_non_interactive():
+    r = InputResolver(Config(env={}), None, True)
+    assert r.confirm("confirm", "Proceed?") is True
+    r2 = InputResolver(Config(env={}), ScriptedPrompter(["No"]), False)
+    assert r2.confirm("confirm", "Proceed?") is False

@@ -193,3 +193,124 @@ def test_hosted_cluster_update_applies_attrs():
         assert "system-pool" in gke["node_pools"]  # pools preserved on update
     finally:
         delete_executor_state(doc)
+
+
+def test_underscore_names_rejected_key_ambiguity():
+    """'_' is the key delimiter: cluster 'prod' + host 'db_1' would collide
+    with cluster 'prod_db' + host '1' on node_gcp_prod_db_1."""
+    doc = StateDocument("m")
+    with pytest.raises(ClusterKeyError):
+        doc.add_cluster("gcp", "prod_db", {})
+    ckey = doc.add_cluster("gcp", "prod", {})
+    with pytest.raises(ClusterKeyError):
+        doc.add_node(ckey, "db_1", {})
+    doc.add_node(ckey, "db-1", {})  # dashes fine
+
+
+def test_objectstore_executor_state_bucket_scoped(tmp_path):
+    """Two buckets with the same state name must not share applied state, and
+    the executor state must live in the bucket itself."""
+    from triton_kubernetes_tpu.backends import ObjectStoreBackend
+    from triton_kubernetes_tpu.backends.objectstore import DirObjectStore
+
+    ex = LocalExecutor()
+    docs = []
+    for i in range(2):
+        bucket = str(tmp_path / f"bucket{i}")
+        be = ObjectStoreBackend(DirObjectStore(bucket), bucket_hint=bucket)
+        d = be.state("m")
+        d.set_backend_config(be.executor_backend_config("m"))
+        d.set_manager({"source": "modules/bare-metal-manager", "name": "m",
+                       "host": f"10.0.{i}.1"})
+        ex.apply(d)
+        be.persist(d)
+        docs.append(d)
+    # Different applied records per bucket.
+    u0 = ex.output(docs[0], "cluster-manager")["manager_url"]
+    u1 = ex.output(docs[1], "cluster-manager")["manager_url"]
+    assert u0 != u1
+    # Executor state is physically inside the bucket dir.
+    found = list((tmp_path / "bucket0").rglob("terraform.tfstate"))
+    assert found, "executor state not stored in the bucket"
+
+
+def test_objectstore_blind_persist_is_conflict(tmp_path):
+    from triton_kubernetes_tpu.backends import ObjectStoreBackend, StateLockedError
+    from triton_kubernetes_tpu.backends.objectstore import DirObjectStore
+
+    store = DirObjectStore(tmp_path / "b")
+    a = ObjectStoreBackend(store)
+    d = a.state("m")
+    d.set_manager({"name": "m"})
+    a.persist(d)
+    # Fresh instance persists blind (never loaded): must be a conflict.
+    b = ObjectStoreBackend(store)
+    with pytest.raises(StateLockedError):
+        b.persist(StateDocument("m", b'{"module": {"evil": {}}}'))
+    assert a.state("m").manager() == {"name": "m"}
+
+
+def test_non_host_aligned_chips_rejected():
+    from triton_kubernetes_tpu.topology import SliceSpec, parse_accelerator
+
+    with pytest.raises(ValueError, match="multiple of"):
+        parse_accelerator("v5e-6")
+    # 1- and 2-chip sub-host configs remain legal.
+    assert SliceSpec.from_accelerator("v5e-1").num_hosts == 1
+    spec2 = SliceSpec.from_accelerator("v5e-2")
+    assert spec2.num_hosts == 1
+    assert len(spec2.host_coordinates()) == 1
+
+
+def test_jobset_destroy_removes_manifests(tmp_path):
+    doc = _mem_doc("js")
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "js",
+                     "host": "10.0.0.1"})
+    ckey = doc.add_cluster("gcp-tpu", "ml", {
+        "source": "modules/gcp-tpu-k8s", "name": "ml",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        "gcp_path_to_credentials": "/c.json", "gcp_project_id": "p"})
+    doc.set("module.job-train", {
+        "source": "modules/tpu-jobset", "job_name": "train",
+        "cluster_id": f"${{module.{ckey}.cluster_id}}",
+        "tpu_accelerator": "v5e-8", "slice_id": "s0"})
+    ex = LocalExecutor()
+    try:
+        ex.apply(doc)
+        cid = ex.output(doc, ckey)["cluster_id"]
+        cloud = ex.cloud_view(doc)
+        assert cloud.get_manifests(cid, "Job")
+        ex.destroy(doc, targets=["job-train"])
+        cloud = ex.cloud_view(doc)
+        assert not cloud.get_manifests(cid, "Job")
+        assert not cloud.get_manifests(cid, "Service")
+    finally:
+        delete_executor_state(doc)
+
+
+def test_last_tpu_pool_destroy_removes_daemonsets():
+    doc = _mem_doc("ds")
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "ds",
+                     "host": "10.0.0.1"})
+    ckey = doc.add_cluster("gcp-tpu", "ml", {
+        "source": "modules/gcp-tpu-k8s", "name": "ml",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        "gcp_path_to_credentials": "/c.json", "gcp_project_id": "p"})
+    pkey = doc.add_node(ckey, "pool0", {
+        "source": "modules/gcp-tpu-nodepool", "pool_name": "pool0",
+        "gke_cluster_name": "ml", "cluster_id": f"${{module.{ckey}.cluster_id}}",
+        "gcp_path_to_credentials": "/c.json", "gcp_project_id": "p",
+        "tpu_accelerator": "v5e-8"})
+    ex = LocalExecutor()
+    try:
+        ex.apply(doc)
+        cid = ex.output(doc, ckey)["cluster_id"]
+        assert ex.cloud_view(doc).get_manifests(cid, "DaemonSet")
+        ex.destroy(doc, targets=[pkey])
+        assert not ex.cloud_view(doc).get_manifests(cid, "DaemonSet")
+    finally:
+        delete_executor_state(doc)

@@ -1,0 +1,351 @@
+"""Workflow tests: guard-rail error contracts (reference get/destroy *_test.go
+analogs) + full silent-mode integration flows against the in-process cloud."""
+
+import pytest
+
+from triton_kubernetes_tpu.backends import MemoryBackend
+from triton_kubernetes_tpu.config import (
+    Config,
+    InputResolver,
+    MissingInputError,
+    ScriptedPrompter,
+)
+from triton_kubernetes_tpu.executor import LocalExecutor
+from triton_kubernetes_tpu.executor.engine import _MEMORY_STATES
+from triton_kubernetes_tpu.workflows import (
+    WorkflowContext,
+    WorkflowError,
+    delete_cluster,
+    delete_manager,
+    delete_node,
+    get_cluster,
+    get_manager,
+    new_backup,
+    new_cluster,
+    new_manager,
+    new_node,
+)
+from triton_kubernetes_tpu.workflows.providers.base import new_hostnames
+from triton_kubernetes_tpu.state import StateDocument
+
+
+@pytest.fixture(autouse=True)
+def _clean_memory_executor_state():
+    yield
+    _MEMORY_STATES.clear()
+
+
+def make_ctx(values=None, answers=None, non_interactive=True, backend=None):
+    cfg = Config(env={})
+    for k, v in (values or {}).items():
+        cfg.set(k, v)
+    prompter = ScriptedPrompter(answers or [])
+    resolver = InputResolver(cfg, prompter, non_interactive)
+    return WorkflowContext(
+        backend=backend or MemoryBackend(),
+        executor=LocalExecutor(),
+        resolver=resolver,
+    )
+
+
+MANAGER_SILENT = {
+    "manager_cloud_provider": "bare-metal",
+    "name": "mgr1",
+    "host": "10.0.0.10",
+}
+
+
+def _create_manager(ctx=None, **extra):
+    ctx = ctx or make_ctx({**MANAGER_SILENT, **extra})
+    assert new_manager(ctx) == "mgr1"
+    return ctx
+
+
+# ---------------------------------------------------------- guard-rail errors
+
+@pytest.mark.parametrize("fn,msg", [
+    (get_manager, "No cluster managers."),
+    (get_cluster, "No cluster managers."),
+    (delete_cluster, "No cluster managers."),
+    (delete_manager, "No cluster managers, please create a cluster manager "
+                     "before creating a kubernetes cluster."),
+    (delete_node, "No cluster managers, please create a cluster manager "
+                  "before creating a kubernetes node."),
+    (new_cluster, "No cluster managers, please create a cluster manager "
+                  "before creating a kubernetes cluster."),
+    (new_node, "No cluster managers, please create a cluster manager "
+               "before creating a kubernetes node."),
+])
+def test_no_managers_errors(fn, msg):
+    with pytest.raises(WorkflowError) as ei:
+        fn(make_ctx())
+    assert str(ei.value) == msg
+
+
+def test_unspecified_manager_error():
+    ctx = _create_manager()
+    with pytest.raises(MissingInputError, match="cluster_manager must be specified"):
+        get_manager(make_ctx(backend=ctx.backend))
+
+
+def test_nonexistent_manager_error():
+    ctx = _create_manager()
+    with pytest.raises(WorkflowError,
+                       match="Selected cluster manager 'ghost' does not exist."):
+        get_manager(make_ctx({"cluster_manager": "ghost"}, backend=ctx.backend))
+
+
+def test_no_clusters_error():
+    ctx = _create_manager()
+    with pytest.raises(WorkflowError, match="No clusters."):
+        get_cluster(make_ctx({"cluster_manager": "mgr1"}, backend=ctx.backend))
+
+
+def test_nonexistent_cluster_error():
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    with pytest.raises(WorkflowError,
+                       match="A cluster named 'nope', does not exist."):
+        delete_cluster(make_ctx({"cluster_manager": "mgr1",
+                                 "cluster_name": "nope"}, backend=ctx.backend))
+
+
+def test_unspecified_hostname_error():
+    ctx = _create_manager()
+    cctx = make_ctx({
+        "cluster_manager": "mgr1", "cluster_cloud_provider": "bare-metal",
+        "name": "c1",
+        "nodes": [{"node_count": 1, "rancher_host_label": "worker",
+                   "hostname": "c1-w", "host": "10.0.0.11"}],
+    }, backend=ctx.backend)
+    new_cluster(cctx)
+    with pytest.raises(MissingInputError, match="hostname must be specified"):
+        delete_node(make_ctx({"cluster_manager": "mgr1", "cluster_name": "c1"},
+                             backend=ctx.backend))
+
+
+# ------------------------------------------------------------- create manager
+
+def test_manager_name_uniqueness():
+    ctx = _create_manager()
+    with pytest.raises(WorkflowError, match="already exists"):
+        new_manager(make_ctx(MANAGER_SILENT, backend=ctx.backend))
+
+
+def test_manager_persisted_only_after_apply(tmp_path):
+    ctx = _create_manager()
+    assert ctx.backend.states() == ["mgr1"]
+    doc = ctx.backend.state("mgr1")
+    assert doc.manager()["name"] == "mgr1"
+    out = ctx.executor.output(doc, "cluster-manager")
+    assert out["manager_url"].startswith("https://")
+
+
+def test_manager_interactive_flow():
+    """Interactive path: provider select, name input, host, confirm."""
+    ctx = make_ctx(values={}, non_interactive=False, answers=[
+        "bare-metal",   # Cloud Provider
+        "mgr1",         # Cluster Manager Name
+        "",             # Private Registry (default empty)
+        "",             # Manager Server Image
+        "",             # Manager Agent Image
+        "",             # Admin Password
+        "10.0.0.10",    # Host
+        "",             # SSH User (default)
+        "",             # SSH Key Path (default)
+        "",             # Bastion Host
+        "Yes",          # confirm
+    ])
+    assert new_manager(ctx) == "mgr1"
+
+
+# ------------------------------------------------- create cluster with nodes
+
+CLUSTER_HA_SILENT = {
+    "cluster_manager": "mgr1",
+    "cluster_cloud_provider": "bare-metal",
+    "name": "ha",
+    "k8s_version": "v1.31.2",
+    "k8s_network_provider": "calico",
+    "nodes": [
+        {"node_count": 3, "rancher_host_label": "etcd", "hostname": "ha-e",
+         "host": "10.1.0.1"},
+        {"node_count": 3, "rancher_host_label": "control", "hostname": "ha-c",
+         "host": "10.1.0.2"},
+        {"node_count": 4, "rancher_host_label": "worker", "hostname": "ha-w",
+         "host": "10.1.0.3"},
+    ],
+}
+
+
+def test_cluster_ha_silent_batch():
+    """The examples/silent-install HA shape: 3 etcd + 3 control + 4 worker."""
+    ctx = _create_manager()
+    cctx = make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend)
+    ckey = new_cluster(cctx)
+    assert ckey == "cluster_bare-metal_ha"
+
+    doc = ctx.backend.state("mgr1")
+    nodes = doc.nodes(ckey)
+    assert len(nodes) == 10
+    assert {"ha-e-1", "ha-e-2", "ha-e-3", "ha-c-1", "ha-c-2", "ha-c-3",
+            "ha-w-1", "ha-w-2", "ha-w-3", "ha-w-4"} == set(nodes)
+
+    # Roles landed in the control plane.
+    cloud = cctx.executor.cloud_view(doc)
+    cid = cctx.executor.output(doc, ckey)["cluster_id"]
+    cluster = cloud.cluster_by_id(cid)
+    roles = {h: n["roles"] for h, n in cluster["nodes"].items()}
+    assert roles["ha-e-1"] == ["etcd"]
+    assert roles["ha-c-1"] == ["controlplane"]
+    assert roles["ha-w-4"] == ["worker"]
+
+
+def test_node_scale_out_and_numbering():
+    ctx = _create_manager()
+    cctx = make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend)
+    ckey = new_cluster(cctx)
+    # Scale out 2 more workers with the same prefix: numbering continues.
+    nctx = make_ctx({
+        "cluster_manager": "mgr1", "cluster_name": "ha",
+        "rancher_host_label": "worker", "node_count": 2, "hostname": "ha-w",
+        "host": "10.1.0.9",
+    }, backend=ctx.backend)
+    created = new_node(nctx)
+    assert created == ["ha-w-5", "ha-w-6"]
+
+
+def test_new_hostnames_collision_semantics():
+    """create/node_test.go analog: numbering skips existing names."""
+    doc = StateDocument("m")
+    ckey = doc.add_cluster("gcp", "c", {})
+    doc.add_node(ckey, "n-1", {})
+    doc.add_node(ckey, "n-3", {})
+    assert new_hostnames(doc, ckey, "n", 3) == ["n-2", "n-4", "n-5"]
+    assert new_hostnames(doc, ckey, "other", 2) == ["other-1", "other-2"]
+
+
+def test_etcd_count_must_be_quorum_shaped():
+    ctx = _create_manager()
+    cctx = make_ctx({
+        "cluster_manager": "mgr1", "cluster_cloud_provider": "bare-metal",
+        "name": "c2",
+        "nodes": [{"node_count": 2, "rancher_host_label": "etcd",
+                   "hostname": "e", "host": "h"}],
+    }, backend=ctx.backend)
+    with pytest.raises(Exception, match="not a valid choice"):
+        new_cluster(cctx)
+
+
+# ----------------------------------------------------------------- TPU flows
+
+TPU_CLUSTER_SILENT = {
+    "cluster_manager": "mgr1",
+    "cluster_cloud_provider": "gcp-tpu",
+    "name": "ml",
+    "gcp_path_to_credentials": "/tmp/creds.json",
+    "gcp_project_id": "proj-1",
+    "gcp_region": "us-east5",
+    "nodes": [{"hostname": "pool0", "tpu_accelerator": "v5p-64"}],
+}
+
+
+def test_tpu_cluster_silent_flow():
+    """BASELINE configs 2-4 shape: non-interactive create cluster
+    (provider=gcp-tpu) brings up a slice node pool."""
+    ctx = _create_manager()
+    cctx = make_ctx(TPU_CLUSTER_SILENT, backend=ctx.backend)
+    ckey = new_cluster(cctx)
+    assert ckey == "cluster_gcp-tpu_ml"
+
+    doc = ctx.backend.state("mgr1")
+    pool_key = doc.nodes(ckey)["pool0"]
+    out = cctx.executor.output(doc, pool_key)
+    assert out["num_chips"] == 64
+    assert out["topology"] == "4x4x4"
+
+    cloud = cctx.executor.cloud_view(doc)
+    gke = cloud.get_resource("gke_cluster", "ml")
+    assert gke["node_pools"]["pool0"]["placement_policy"]["type"] == "COMPACT"
+    cid = cctx.executor.output(doc, ckey)["cluster_id"]
+    ds = [m["metadata"]["name"] for m in cloud.get_manifests(cid, "DaemonSet")]
+    assert "tpu-jax-runtime" in ds
+
+
+def test_tpu_node_added_to_existing_cluster():
+    ctx = _create_manager()
+    new_cluster(make_ctx(TPU_CLUSTER_SILENT, backend=ctx.backend))
+    nctx = make_ctx({
+        "cluster_manager": "mgr1", "cluster_name": "ml",
+        "hostname": "pool1", "tpu_accelerator": "v5e-8",
+        "gcp_path_to_credentials": "/tmp/creds.json", "gcp_project_id": "proj-1",
+    }, backend=ctx.backend)
+    assert new_node(nctx) == ["pool1"]
+    doc = ctx.backend.state("mgr1")
+    out = nctx.executor.output(doc, "node_gcp-tpu_ml_pool1")
+    assert out["num_hosts"] == 2
+
+
+# -------------------------------------------------------------------- backup
+
+def test_backup_flow_and_one_per_cluster():
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    bctx = make_ctx({
+        "cluster_manager": "mgr1", "cluster_name": "ha",
+        "backup_cloud_provider": "gcs",
+        "gcp_path_to_credentials": "/tmp/c.json", "gcs_bucket": "bkt",
+    }, backend=ctx.backend)
+    bkey = new_backup(bctx)
+    assert bkey == "backup_cluster_bare-metal_ha"
+    with pytest.raises(WorkflowError, match="already exists"):
+        new_backup(make_ctx({
+            "cluster_manager": "mgr1", "cluster_name": "ha",
+            "backup_cloud_provider": "gcs",
+            "gcp_path_to_credentials": "/tmp/c.json", "gcs_bucket": "bkt",
+        }, backend=ctx.backend))
+
+
+# ------------------------------------------------------------------- destroy
+
+def test_destroy_cluster_fanout_prunes_doc():
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    dctx = make_ctx({"cluster_manager": "mgr1", "cluster_name": "ha"},
+                    backend=ctx.backend)
+    delete_cluster(dctx)
+    doc = ctx.backend.state("mgr1")
+    assert doc.clusters() == {}
+    assert doc.manager() is not None  # manager untouched
+    # Manager still applied.
+    assert dctx.executor.output(doc, "cluster-manager")["manager_url"]
+
+
+def test_destroy_node_only():
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    dctx = make_ctx({"cluster_manager": "mgr1", "cluster_name": "ha",
+                     "hostname": "ha-w-4"}, backend=ctx.backend)
+    delete_node(dctx)
+    doc = ctx.backend.state("mgr1")
+    assert "ha-w-4" not in doc.nodes("cluster_bare-metal_ha")
+    assert len(doc.nodes("cluster_bare-metal_ha")) == 9
+
+
+def test_destroy_manager_deletes_state():
+    ctx = _create_manager()
+    dctx = make_ctx({"cluster_manager": "mgr1"}, backend=ctx.backend)
+    delete_manager(dctx)
+    assert ctx.backend.states() == []
+
+
+# ----------------------------------------------------------------------- get
+
+def test_get_manager_and_cluster_outputs():
+    ctx = _create_manager()
+    new_cluster(make_ctx(TPU_CLUSTER_SILENT, backend=ctx.backend))
+    out = get_manager(make_ctx({"cluster_manager": "mgr1"}, backend=ctx.backend))
+    assert set(out) >= {"manager_url", "manager_access_key", "manager_secret_key"}
+    cout = get_cluster(make_ctx({"cluster_manager": "mgr1",
+                                 "cluster_name": "ml"}, backend=ctx.backend))
+    assert cout["cluster_id"].startswith("c-")

@@ -131,7 +131,10 @@ class ObjectStoreBackend(Backend):
         return StateDocument(name, data)
 
     def persist(self, state: StateDocument) -> None:
-        expected = self._generations.get(state.name)
+        # A name never loaded through this instance defaults to generation 0
+        # ("only if absent") — persisting blind must be a detected conflict,
+        # not an unconditional clobber of someone else's committed document.
+        expected = self._generations.get(state.name, 0)
         new_gen = self.store.put(
             self._key(state.name), state.to_bytes(), if_generation_match=expected
         )

@@ -1,0 +1,10 @@
+"""L5 CLI: ``create | destroy | get | version`` command tree.
+
+Reference analog: ``cmd/`` (cobra root + subcommands, cmd/root.go:14-67,
+cmd/create.go:14-96, cmd/destroy.go:15-82, cmd/get.go:15-75,
+cmd/version.go:10-26). Run as ``python -m triton_kubernetes_tpu.cli``.
+"""
+
+from .main import build_parser, main
+
+__all__ = ["build_parser", "main"]

@@ -1,0 +1,155 @@
+"""CLI entrypoint.
+
+Command surface mirrors the reference exactly (cmd/create.go:89-93,
+cmd/destroy.go:70, cmd/get.go:62):
+
+    create  {manager|cluster|node|backup}
+    destroy {manager|cluster|node}
+    get     {manager|cluster}
+    version
+
+Global flags: ``--config <yaml>`` (silent-install file), ``--non-interactive``,
+``--set k=v`` (highest-precedence override), ``--backend-provider local|objectstore``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .. import __version__
+from ..backends import Backend, LocalBackend, ObjectStoreBackend
+from ..backends.objectstore import DirObjectStore
+from ..config import (
+    Config,
+    InputResolver,
+    InteractivePrompter,
+    MissingInputError,
+    ScriptedPrompter,
+    ValidationError,
+)
+from ..executor import LocalExecutor
+from ..state import ClusterKeyError
+from ..workflows import (
+    WorkflowContext,
+    WorkflowError,
+    delete_cluster,
+    delete_manager,
+    delete_node,
+    get_cluster,
+    get_manager,
+    new_backup,
+    new_cluster,
+    new_manager,
+    new_node,
+)
+
+GIT_SHA = "dev"  # stamped by packaging (Makefile -ldflags analog, Makefile:2)
+
+
+def choose_backend(resolver: InputResolver) -> Backend:
+    """Backend selection (util/backend_prompt.go:18-168 analog).
+
+    ``local`` keeps everything under ~/.triton-kubernetes-tpu; ``objectstore``
+    is the Manta/GCS-style remote (a directory emulation unless a real bucket
+    client is wired in), with ``manta``/``gcs`` accepted as aliases.
+    """
+    kind = resolver.choose(
+        "backend_provider", "Backend Provider",
+        [("local", "local"), ("objectstore", "objectstore"),
+         ("manta", "objectstore"), ("gcs", "objectstore")],
+        default="local")
+    if kind == "local":
+        root = resolver.config.get("backend_root", "~/.triton-kubernetes-tpu")
+        return LocalBackend(root)
+    bucket = resolver.value("backend_bucket", "Object-store bucket/path",
+                            default="~/.triton-kubernetes-tpu-bucket")
+    return ObjectStoreBackend(DirObjectStore(str(bucket)), bucket_hint=str(bucket))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="triton-kubernetes-tpu",
+        description="TPU-native multi-cloud Kubernetes cluster manager",
+    )
+    p.add_argument("--config", metavar="FILE",
+                   help="silent-install YAML configuration file")
+    p.add_argument("--non-interactive", action="store_true",
+                   help="fail instead of prompting for missing inputs")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="KEY=VALUE", help="config override (repeatable)")
+
+    sub = p.add_subparsers(dest="command")
+
+    create = sub.add_parser("create", help="create resources")
+    create.add_argument("kind", choices=["manager", "cluster", "node", "backup"])
+
+    destroy = sub.add_parser("destroy", help="destroy resources")
+    destroy.add_argument("kind", choices=["manager", "cluster", "node"])
+
+    get = sub.add_parser("get", help="display resource information")
+    get.add_argument("kind", choices=["manager", "cluster"])
+
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def main(argv: Optional[List[str]] = None,
+         prompter=None, backend: Optional[Backend] = None,
+         executor=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.command == "version":
+        # cmd/version.go format: "<semver> (<git sha>)"
+        print(f"{__version__} ({GIT_SHA})")
+        return 0
+
+    if args.command is None:
+        build_parser().print_help()
+        return 1
+
+    config = Config(config_file=args.config)
+    for item in args.overrides:
+        key, sep, value = item.partition("=")
+        if not sep:
+            print(f"error: --set expects KEY=VALUE, got {item!r}", file=sys.stderr)
+            return 2
+        config.set(key, value)
+
+    if prompter is None:
+        prompter = InteractivePrompter()
+    resolver = InputResolver(config, prompter, args.non_interactive)
+
+    try:
+        be = backend if backend is not None else choose_backend(resolver)
+        ex = executor if executor is not None else LocalExecutor(
+            log=lambda msg: print(msg))
+        ctx = WorkflowContext(backend=be, executor=ex, resolver=resolver)
+
+        if args.command == "create":
+            result = {"manager": new_manager, "cluster": new_cluster,
+                      "node": new_node, "backup": new_backup}[args.kind](ctx)
+            if result:
+                print(f"created: {result}")
+        elif args.command == "destroy":
+            result = {"manager": delete_manager, "cluster": delete_cluster,
+                      "node": delete_node}[args.kind](ctx)
+            if result:
+                print(f"destroyed: {result}")
+        elif args.command == "get":
+            outputs = {"manager": get_manager, "cluster": get_cluster}[args.kind](ctx)
+            print(json.dumps(outputs, indent=2, sort_keys=True))
+    except (WorkflowError, MissingInputError, ValidationError,
+            ClusterKeyError, EOFError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        print("\naborted", file=sys.stderr)
+        return 130
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""Tri-modal input layer: flags/YAML/env > non-interactive error > prompt.
+
+Reference analog: cobra flags + viper three-way precedence (cmd/root.go:49-66)
+plus the idiom repeated ~90 times across the workflows::
+
+    if viper.IsSet(k): use it
+    elif nonInteractiveMode: error "k must be specified"
+    else: promptui prompt with live-API-backed choices
+
+SURVEY.md §5 calls this "the UX heart of the tool"; ``InputResolver`` is that
+idiom as a single reusable object, with the silent-install YAML schema
+(docs/guide/silent-install-yaml.md) as the config-file format.
+"""
+
+from .config import Config
+from .prompts import (
+    InteractivePrompter,
+    MissingInputError,
+    Prompter,
+    ScriptedPrompter,
+    ValidationError,
+)
+from .resolver import InputResolver
+
+__all__ = [
+    "Config",
+    "InputResolver",
+    "InteractivePrompter",
+    "MissingInputError",
+    "Prompter",
+    "ScriptedPrompter",
+    "ValidationError",
+]

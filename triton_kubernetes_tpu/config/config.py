@@ -1,0 +1,142 @@
+"""Config value store with viper-style precedence.
+
+Precedence (cmd/root.go:49-66 analog): explicit overrides (``--set k=v`` or
+programmatic ``set()``) > config file (``--config file.yaml``, else
+``$HOME/.triton-kubernetes-tpu.yaml`` if present) > environment variables
+(AutomaticEnv analog, but namespaced: key ``aws_region`` reads
+``$TK8S_AWS_REGION`` — a bare ``$AWS_REGION`` fallback would let unrelated
+process env, e.g. the TPU runtime's own ``TPU_TOPOLOGY``, silently leak into
+workflow inputs).
+
+YAML support: the silent-install schema is intentionally flat (scalars plus
+the ``nodes:`` list of dicts), so a tiny built-in parser covers it without a
+yaml dependency; PyYAML is used when available.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+DEFAULT_CONFIG_PATH = "~/.triton-kubernetes-tpu.yaml"
+
+
+def _parse_scalar(s: str) -> Any:
+    s = s.strip()
+    if s in ("true", "True"):
+        return True
+    if s in ("false", "False"):
+        return False
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    if len(s) >= 2 and s[0] == s[-1] and s[0] in "\"'":
+        return s[1:-1]
+    return s
+
+
+def _mini_yaml(text: str) -> Dict[str, Any]:
+    """Parse the flat silent-install subset: ``key: value`` lines, lists of
+    dicts via ``-`` items, one nesting level, ``#`` comments."""
+    root: Dict[str, Any] = {}
+    current_list: Optional[list] = None
+    current_item: Optional[dict] = None
+    list_indent = 0
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip():
+            continue
+        indent = len(line) - len(line.lstrip())
+        stripped = line.strip()
+        if stripped.startswith("- "):
+            if current_list is None:
+                raise ValueError(f"list item outside a list: {raw!r}")
+            current_item = {}
+            current_list.append(current_item)
+            list_indent = indent
+            stripped = stripped[2:]
+            if stripped:
+                k, _, v = stripped.partition(":")
+                current_item[k.strip()] = _parse_scalar(v)
+            continue
+        if current_item is not None and indent > list_indent:
+            k, _, v = stripped.partition(":")
+            current_item[k.strip()] = _parse_scalar(v)
+            continue
+        current_item = None
+        current_list = None
+        k, sep, v = stripped.partition(":")
+        if not sep:
+            raise ValueError(f"cannot parse line: {raw!r}")
+        if v.strip() == "":
+            current_list = []
+            root[k.strip()] = current_list
+        else:
+            root[k.strip()] = _parse_scalar(v)
+    return root
+
+
+def load_yaml_file(path: str) -> Dict[str, Any]:
+    text = Path(os.path.expanduser(path)).read_text()
+    try:
+        import yaml  # type: ignore
+
+        data = yaml.safe_load(text)
+        return data if isinstance(data, dict) else {}
+    except ImportError:
+        return _mini_yaml(text)
+
+
+class Config:
+    def __init__(self, config_file: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self._overrides: Dict[str, Any] = {}
+        self._file_values: Dict[str, Any] = {}
+        self._env = env if env is not None else dict(os.environ)
+        if config_file:
+            self._file_values = load_yaml_file(config_file)
+        else:
+            default = Path(os.path.expanduser(DEFAULT_CONFIG_PATH))
+            if default.is_file():
+                self._file_values = load_yaml_file(str(default))
+
+    def set(self, key: str, value: Any) -> None:
+        self._overrides[key] = value
+
+    def unset(self, key: str) -> None:
+        self._overrides.pop(key, None)
+
+    @staticmethod
+    def _env_key(key: str) -> str:
+        return "TK8S_" + key.upper().replace("-", "_")
+
+    def is_set(self, key: str) -> bool:
+        return (
+            key in self._overrides
+            or key in self._file_values
+            or self._env_key(key) in self._env
+        )
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._overrides:
+            return self._overrides[key]
+        if key in self._file_values:
+            return self._file_values[key]
+        if self._env_key(key) in self._env:
+            return _parse_scalar(self._env[self._env_key(key)])
+        return default
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = dict(self._file_values)
+        out.update(self._overrides)
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Config({json.dumps(self.to_dict(), default=str)[:200]})"

@@ -1,0 +1,118 @@
+"""Prompt engines: interactive (promptui analog) and scripted (for tests).
+
+Reference analog: promptui Select/Prompt used throughout create/ and util/
+(e.g. util/confirm_prompt.go:10-35), with live cloud-API-backed choice lists.
+"""
+
+from __future__ import annotations
+
+import abc
+import sys
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+
+class MissingInputError(ValueError):
+    """Non-interactive mode and a required key is absent — the exact error
+    contract the reference's guard-rail tests pin (e.g. destroy/cluster_test.go)."""
+
+
+class ValidationError(ValueError):
+    pass
+
+
+Validator = Callable[[Any], Optional[str]]  # returns error message or None
+
+
+class Prompter(abc.ABC):
+    @abc.abstractmethod
+    def select(self, label: str, options: Sequence[Tuple[str, Any]]) -> Any:
+        """Pick one of (display, value) options; returns the value."""
+
+    @abc.abstractmethod
+    def input(self, label: str, default: Optional[str] = None,
+              validate: Optional[Validator] = None) -> str: ...
+
+    def confirm(self, label: str) -> bool:
+        return self.select(label, [("Yes", True), ("No", False)])
+
+
+class InteractivePrompter(Prompter):
+    """Plain-stdin prompter (numbered select), stdio like the reference."""
+
+    def __init__(self, infile=None, outfile=None):
+        self.infile = infile or sys.stdin
+        self.outfile = outfile or sys.stdout
+
+    def _write(self, s: str) -> None:
+        self.outfile.write(s)
+        self.outfile.flush()
+
+    def select(self, label: str, options: Sequence[Tuple[str, Any]]) -> Any:
+        if not options:
+            raise ValidationError(f"{label}: no options available")
+        self._write(f"{label}:\n")
+        for i, (display, _) in enumerate(options, 1):
+            self._write(f"  {i}. {display}\n")
+        while True:
+            self._write(f"Select [1-{len(options)}]: ")
+            line = self.infile.readline()
+            if not line:
+                raise EOFError(f"stdin closed while selecting {label!r}")
+            choice = line.strip()
+            if choice.isdigit() and 1 <= int(choice) <= len(options):
+                return options[int(choice) - 1][1]
+            # Also accept typing the display string exactly.
+            for display, value in options:
+                if choice == display:
+                    return value
+            self._write("Invalid selection.\n")
+
+    def input(self, label: str, default: Optional[str] = None,
+              validate: Optional[Validator] = None) -> str:
+        suffix = f" [{default}]" if default not in (None, "") else ""
+        while True:
+            self._write(f"{label}{suffix}: ")
+            line = self.infile.readline()
+            if not line:
+                raise EOFError(f"stdin closed while prompting {label!r}")
+            value = line.strip() or (default or "")
+            err = validate(value) if validate else None
+            if err is None:
+                return value
+            self._write(f"{err}\n")
+
+
+class ScriptedPrompter(Prompter):
+    """Deterministic prompter fed a list of answers (test fixture)."""
+
+    def __init__(self, answers: Optional[List[Any]] = None):
+        self.answers = list(answers or [])
+        self.transcript: List[str] = []
+
+    def _next(self, label: str) -> Any:
+        if not self.answers:
+            raise AssertionError(f"no scripted answer left for prompt {label!r}")
+        self.transcript.append(label)
+        return self.answers.pop(0)
+
+    def select(self, label: str, options: Sequence[Tuple[str, Any]]) -> Any:
+        if not options:
+            raise ValidationError(f"{label}: no options available")
+        ans = self._next(label)
+        for display, value in options:
+            if ans == display or ans == value:
+                return value
+        raise AssertionError(
+            f"scripted answer {ans!r} not among options for {label!r}: "
+            f"{[d for d, _ in options]}")
+
+    def input(self, label: str, default: Optional[str] = None,
+              validate: Optional[Validator] = None) -> str:
+        ans = self._next(label)
+        value = str(ans) if ans is not None else (default or "")
+        if value == "" and default:
+            value = default
+        err = validate(value) if validate else None
+        if err is not None:
+            raise ValidationError(f"{label}: {err}")
+        return value

@@ -1,0 +1,76 @@
+"""The tri-modal input idiom as one object.
+
+Every input in every workflow goes through ``InputResolver``: config value if
+set, hard error in non-interactive mode, otherwise an interactive prompt —
+optionally with live choices (cloud-API-backed in the reference,
+driver-backed here). This is the ~90-times-repeated viper/promptui pattern
+(SURVEY.md §5) factored once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+from .config import Config
+from .prompts import MissingInputError, Prompter, ValidationError, Validator
+
+
+class InputResolver:
+    def __init__(self, config: Config, prompter: Optional[Prompter],
+                 non_interactive: bool):
+        self.config = config
+        self.prompter = prompter
+        self.non_interactive = non_interactive
+
+    def _missing(self, key: str) -> MissingInputError:
+        return MissingInputError(f"{key} must be specified")
+
+    def value(self, key: str, label: Optional[str] = None, *,
+              default: Optional[Any] = None,
+              validate: Optional[Validator] = None) -> Any:
+        """Free-form input (promptui Prompt analog)."""
+        if self.config.is_set(key):
+            v = self.config.get(key)
+            err = validate(v) if validate else None
+            if err is not None:
+                raise ValidationError(f"{key}: {err}")
+            return v
+        if self.non_interactive:
+            if default is not None:
+                return default
+            raise self._missing(key)
+        return self.prompter.input(label or key, default=(
+            str(default) if default is not None else None), validate=validate)
+
+    def choose(self, key: str, label: str,
+               options: Sequence[Tuple[str, Any]],
+               default: Optional[Any] = None) -> Any:
+        """Choice input (promptui Select analog). A configured value must
+        match one of the options' values (or displays)."""
+        if self.config.is_set(key):
+            v = self.config.get(key)
+            for display, value in options:
+                if v == value or v == display:
+                    return value
+            raise ValidationError(
+                f"{key}: {v!r} is not a valid choice "
+                f"(valid: {[v2 for _, v2 in options]})")
+        if self.non_interactive:
+            if default is not None:
+                return default
+            raise self._missing(key)
+        return self.prompter.select(label, options)
+
+    def confirm(self, key: str, label: str) -> bool:
+        """Yes/No (util/confirm_prompt.go analog). Non-interactive mode
+        auto-confirms, matching the reference's silent installs."""
+        if self.config.is_set(key):
+            return bool(self.config.get(key))
+        if self.non_interactive:
+            return True
+        return self.prompter.confirm(label)
+
+    def flag(self, key: str, default: bool = False) -> bool:
+        if self.config.is_set(key):
+            return bool(self.config.get(key))
+        return default

@@ -206,6 +206,15 @@ class CloudSimulator:
                 return
         objs.append(manifest)
 
+    def delete_manifest(self, cluster_id: str, kind: str, name: str) -> bool:
+        """kubectl-delete analog; returns True if the object existed."""
+        objs = self.manifests.get(cluster_id, [])
+        for i, m in enumerate(objs):
+            if (m.get("kind"), m.get("metadata", {}).get("name")) == (kind, name):
+                del objs[i]
+                return True
+        return False
+
     def get_manifests(self, cluster_id: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
         objs = self.manifests.get(cluster_id, [])
         if kind is None:

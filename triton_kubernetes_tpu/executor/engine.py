@@ -86,14 +86,17 @@ def load_executor_state(doc: StateDocument) -> ExecutorState:
                 return ExecutorState.from_dict(json.load(f))
         return ExecutorState()
     if "objectstore" in loc:
-        path = os.path.join(
-            os.path.expanduser("~/.triton-kubernetes-tpu/.objectstore"),
-            loc["objectstore"]["path"],
-        )
-        if os.path.isfile(path):
-            with open(path) as f:
-                return ExecutorState.from_dict(json.load(f))
-        return ExecutorState()
+        # Executor state lives in the same (emulated) bucket as the document —
+        # keyed by bucket, so two buckets never share applied state, and a
+        # second machine pointed at the bucket sees the same record.
+        from ..backends.objectstore import DirObjectStore
+
+        store = DirObjectStore(loc["objectstore"]["bucket"])
+        try:
+            data, _ = store.get(loc["objectstore"]["path"])
+        except KeyError:
+            return ExecutorState()
+        return ExecutorState.from_dict(json.loads(data))
     raise ApplyError(f"unsupported executor backend: {list(loc)}")
 
 
@@ -103,15 +106,16 @@ def save_executor_state(doc: StateDocument, est: ExecutorState) -> None:
     if "memory" in loc:
         _MEMORY_STATES[loc["memory"]["name"]] = copy.deepcopy(est.to_dict())
         return
-    if "local" in loc:
-        path = loc["local"]["path"]
-    elif "objectstore" in loc:
-        path = os.path.join(
-            os.path.expanduser("~/.triton-kubernetes-tpu/.objectstore"),
-            loc["objectstore"]["path"],
-        )
-    else:
+    if "objectstore" in loc:
+        from ..backends.objectstore import DirObjectStore
+
+        store = DirObjectStore(loc["objectstore"]["bucket"])
+        store.put(loc["objectstore"]["path"],
+                  json.dumps(est.to_dict(), indent=2, sort_keys=True).encode())
+        return
+    if "local" not in loc:
         raise ApplyError(f"unsupported executor backend: {list(loc)}")
+    path = loc["local"]["path"]
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -126,12 +130,10 @@ def delete_executor_state(doc: StateDocument) -> None:
     elif "local" in loc and os.path.isfile(loc["local"]["path"]):
         os.unlink(loc["local"]["path"])
     elif "objectstore" in loc:
-        path = os.path.join(
-            os.path.expanduser("~/.triton-kubernetes-tpu/.objectstore"),
-            loc["objectstore"]["path"],
-        )
-        if os.path.isfile(path):
-            os.unlink(path)
+        from ..backends.objectstore import DirObjectStore
+
+        DirObjectStore(loc["objectstore"]["bucket"]).delete(
+            loc["objectstore"]["path"])
 
 
 class LocalExecutor:

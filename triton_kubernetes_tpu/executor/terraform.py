@@ -77,11 +77,34 @@ class TerraformExecutor:
             self._run(args, cwd)
 
     def output(self, doc: StateDocument, module_key: str) -> Dict[str, Any]:
+        """Module outputs via root-level re-exports.
+
+        The reference ran ``terraform output -module <key>``
+        (get/cluster.go -> run_terraform.go:146), but the ``-module`` flag was
+        removed in terraform 0.12; modern terraform only exposes root
+        outputs. Docs written for this executor re-export module outputs at
+        root as ``<module_key>__<output>`` (see ``add_output_exports``); this
+        reads all root outputs and strips that prefix.
+        """
         with self._workdir(doc) as cwd:
             self._run(["init", "-force-copy"], cwd)
             res = subprocess.run(
-                [self._require_binary(), "output", "-json",
-                 f"-module={module_key}"],
+                [self._require_binary(), "output", "-json"],
                 cwd=cwd, check=True, capture_output=True,
             )
-            return json.loads(res.stdout or b"{}")
+            all_outputs = json.loads(res.stdout or b"{}")
+            prefix = f"{module_key}__"
+            return {
+                k[len(prefix):]: v.get("value") if isinstance(v, dict) else v
+                for k, v in all_outputs.items() if k.startswith(prefix)
+            }
+
+    @staticmethod
+    def add_output_exports(doc: StateDocument, module_key: str,
+                           output_names: List[str]) -> None:
+        """Write root-level ``output`` blocks re-exporting a module's outputs
+        as ``<module_key>__<name>`` so ``output()`` can read them on
+        terraform >= 0.12."""
+        for name in output_names:
+            doc.set(f"output.{module_key}__{name}.value",
+                    f"${{module.{module_key}.{name}}}")

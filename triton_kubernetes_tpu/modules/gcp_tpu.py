@@ -146,13 +146,23 @@ class GcpTpuNodePool(Module):
             "num_hosts": spec.num_hosts,
             "num_chips": spec.chips,
             "node_names": [n["name"] for n in pool["nodes"]],
+            # Resolved id, recorded for destroy (the stored config only has
+            # the unresolved interpolation string).
+            "cluster_id": cluster_id,
         }, resources)
 
     def destroy(self, applied: Dict[str, Any], ctx: DriverContext) -> None:
         cfg = applied.get("config", {})
         cluster = ctx.cloud.get_resource("gke_cluster", cfg.get("gke_cluster_name", ""))
         if cluster:
-            cluster.get("node_pools", {}).pop(cfg.get("pool_name", ""), None)
+            pools = cluster.get("node_pools", {})
+            pools.pop(cfg.get("pool_name", ""), None)
+            # Last TPU pool gone: uninstall the TPU DaemonSets too.
+            if not any(p.get("tpu_topology") for p in pools.values()):
+                cluster_id = applied.get("outputs", {}).get("cluster_id", "")
+                for ds in ("tpu-jax-runtime", "tpu-device-plugin",
+                           "tpu-slice-health"):
+                    ctx.cloud.delete_manifest(cluster_id, "DaemonSet", ds)
         super().destroy(applied, ctx)
 
 
@@ -201,4 +211,16 @@ class TpuJobSet(Module):
             "job_name": name,
             "num_workers": spec.num_hosts,
             "coordinator": coord_env["JAX_COORDINATOR_ADDRESS"],
+            "cluster_id": cluster_id,  # resolved, for destroy
         }, [Resource("k8s_job", name)])
+
+    def destroy(self, applied: Dict[str, Any], ctx: DriverContext) -> None:
+        """Remove the Job and its headless Service from the cluster — the
+        default resource-record cleanup alone would leave the workload
+        manifests applied."""
+        out = applied.get("outputs", {})
+        cluster_id = out.get("cluster_id", "")
+        name = out.get("job_name") or applied.get("config", {}).get("job_name", "")
+        ctx.cloud.delete_manifest(cluster_id, "Job", name)
+        ctx.cloud.delete_manifest(cluster_id, "Service", name)
+        super().destroy(applied, ctx)

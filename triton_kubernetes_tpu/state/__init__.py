@@ -8,6 +8,7 @@ module naming conventions ``module.cluster-manager``,
 """
 
 from .document import (
+    MANAGER_KEY,
     ClusterKeyError,
     StateDocument,
     cluster_key,
@@ -17,6 +18,7 @@ from .document import (
 )
 
 __all__ = [
+    "MANAGER_KEY",
     "ClusterKeyError",
     "StateDocument",
     "cluster_key",

@@ -27,8 +27,11 @@ _NODE_PREFIX = "node_"
 _BACKUP_PREFIX = "backup_"
 
 # Module-key segments travel through dotted paths, so '.' (and whitespace)
-# would corrupt the document. Providers additionally never contain '_'.
-_SEGMENT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_-]*$")
+# would corrupt the document — and '_' is the key-scheme *delimiter*, so
+# allowing it inside cluster names or hostnames would make keys ambiguous
+# (cluster 'prod' + host 'db_1' vs cluster 'prod_db' + host '1' would
+# collide on 'node_gcp_prod_db_1'). Dashes only, like the reference examples.
+_SEGMENT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9-]*$")
 _PROVIDER_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9-]*$")
 
 

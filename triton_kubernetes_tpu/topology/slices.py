@@ -44,6 +44,14 @@ def parse_accelerator(name: str) -> Tuple[TpuGeneration, int]:
     chips = int(count)
     if chips > gen.max_chips:
         raise ValueError(f"{gen_name} slices max out at {gen.max_chips} chips")
+    # Slices are host-aligned: sub-host slices exist only as 1- or 2-chip
+    # configs; anything larger must be a whole number of hosts, or node
+    # count / worker ids / coordinate labels would disagree with the
+    # physical slice.
+    if chips > 2 and chips % gen.chips_per_host != 0:
+        raise ValueError(
+            f"{name}: chip count must be 1, 2, or a multiple of "
+            f"{gen.chips_per_host} (chips/host on {gen_name})")
     return gen, chips
 
 

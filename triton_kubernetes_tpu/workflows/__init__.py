@@ -1,0 +1,31 @@
+"""L4 workflows: create/destroy/get for managers, clusters, nodes, backups.
+
+Reference analog: ``create/``, ``destroy/``, ``get/`` — interactive or
+silent-YAML flows that mutate the state document and run the executor, with
+the commit-after-success discipline (persist only after apply succeeded,
+create/manager.go:139-151). Error-string contracts for non-interactive guard
+rails are preserved verbatim from the reference (SURVEY.md §4: "cheap and
+clearly effective at pinning the silent-mode contract").
+"""
+
+from .common import WorkflowContext, WorkflowError
+from .manager import new_manager
+from .cluster import new_cluster
+from .node import new_node
+from .backup import new_backup
+from .destroy import delete_cluster, delete_manager, delete_node
+from .get import get_cluster, get_manager
+
+__all__ = [
+    "WorkflowContext",
+    "WorkflowError",
+    "delete_cluster",
+    "delete_manager",
+    "delete_node",
+    "get_cluster",
+    "get_manager",
+    "new_backup",
+    "new_cluster",
+    "new_manager",
+    "new_node",
+]

@@ -1,0 +1,63 @@
+"""``create backup`` workflow.
+
+Reference analog: create/backup.go:17-215 — pick manager, pick cluster,
+reject if a backup already exists (one per cluster, :119-123), choose the
+storage kind, apply, persist. Kinds: gcs (new, TPU-era checkpoints), s3,
+manta (parity).
+"""
+
+from __future__ import annotations
+
+from .common import WorkflowContext, WorkflowError, module_source, select_cluster, select_manager
+
+BACKUP_KINDS = ["gcs", "s3", "manta"]
+
+
+def new_backup(ctx: WorkflowContext) -> str:
+    r = ctx.resolver
+    manager = select_manager(
+        ctx, "No cluster managers, please create a cluster manager "
+             "before creating a backup.")
+    state = ctx.backend.state(manager)
+    cluster_name, cluster_key = select_cluster(ctx, state)
+
+    if state.backup(cluster_key) is not None:
+        raise WorkflowError(
+            f"A backup for cluster '{cluster_name}' already exists.")
+
+    kind = r.choose("backup_cloud_provider", "Backup Storage",
+                    [(k, k) for k in BACKUP_KINDS])
+    cfg = {
+        "source": module_source(ctx, f"k8s-backup-{kind}"),
+        "cluster_name": cluster_name,
+        "cluster_id": f"${{module.{cluster_key}.cluster_id}}",
+    }
+    if kind == "gcs":
+        cfg["gcp_path_to_credentials"] = r.value(
+            "gcp_path_to_credentials", "Path to GCP credentials file")
+        cfg["gcs_bucket"] = r.value("gcs_bucket", "GCS Bucket")
+    elif kind == "s3":
+        cfg["aws_access_key"] = r.value("aws_access_key", "AWS Access Key")
+        cfg["aws_secret_key"] = r.value("aws_secret_key", "AWS Secret Key")
+        cfg["aws_region"] = r.value("aws_region", "AWS Region",
+                                    default="us-east-1")
+        cfg["aws_s3_bucket"] = r.value("aws_s3_bucket", "S3 Bucket")
+    else:
+        cfg["triton_account"] = r.value("triton_account", "Triton Account Name")
+        cfg["triton_key_path"] = r.value("triton_key_path", "Triton Key Path",
+                                         default="~/.ssh/id_rsa")
+        cfg["triton_key_id"] = r.value("triton_key_id", "Triton Key ID",
+                                       default="")
+        cfg["manta_subuser"] = r.value("manta_subuser", "Manta Subuser",
+                                       default="")
+
+    backup_key = state.add_backup(cluster_key, cfg)
+
+    if not r.confirm("confirm", f"Proceed? This will back up '{cluster_name}'"):
+        state.delete(f"module.{backup_key}")
+        return ""
+
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    ctx.executor.apply(state)
+    ctx.backend.persist(state)
+    return backup_key

@@ -1,0 +1,73 @@
+"""``create cluster`` workflow (+ inline node batches).
+
+Reference analog: create/cluster.go:45-301 — pick manager, pick provider,
+provider fn adds ``module.cluster_*``, then per-node-type node blocks from the
+silent-YAML ``nodes:`` list or an interactive add-node loop, confirm, apply,
+persist. The reference's gabs re-parse workaround (cluster.go:150-154) is
+unnecessary here — fresh children are immediately visible.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..config import MissingInputError
+from .common import WorkflowContext, WorkflowError, select_manager
+from .manager import _validate_name
+from .node import add_nodes_for_label
+from .providers import CLUSTER_PROVIDERS, HOSTED_PROVIDERS, NODE_PROVIDERS
+
+
+def new_cluster(ctx: WorkflowContext) -> str:
+    r = ctx.resolver
+    manager = select_manager(
+        ctx, "No cluster managers, please create a cluster manager "
+             "before creating a kubernetes cluster.")
+    state = ctx.backend.state(manager)
+
+    provider = r.choose("cluster_cloud_provider", "Cloud Provider",
+                        [(p, p) for p in sorted(CLUSTER_PROVIDERS)])
+    name = r.value("name", "Cluster Name", validate=_validate_name)
+    cluster_key = CLUSTER_PROVIDERS[provider](ctx, state, name)
+
+    hostnames: List[str] = []
+    if provider not in HOSTED_PROVIDERS:
+        hostnames = _gather_nodes(ctx, state, provider, cluster_key)
+
+    if not r.confirm("confirm", f"Proceed? This will create cluster '{name}'"):
+        return ""
+
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    ctx.executor.apply(state)
+    ctx.backend.persist(state)
+    if hostnames:
+        print(f"{len(hostnames)} nodes added: {', '.join(hostnames)}")
+    return cluster_key
+
+
+def _gather_nodes(ctx: WorkflowContext, state, provider: str,
+                  cluster_key: str) -> List[str]:
+    """Silent mode: one batch per ``nodes:`` entry (create/cluster.go:169-229).
+    Interactive: add-node loop until declined (cluster.go:231-292)."""
+    r = ctx.resolver
+    node_fn = NODE_PROVIDERS.get(provider)
+    if node_fn is None:
+        return []
+    created: List[str] = []
+
+    nodes_spec = ctx.config.get("nodes")
+    if isinstance(nodes_spec, list):
+        for block in nodes_spec:
+            if not isinstance(block, dict):
+                raise WorkflowError(f"invalid nodes entry: {block!r}")
+            # Scope each block's keys as overrides for the node fn
+            # (viper.Set per-node-var analog, cluster.go:174-229).
+            created.extend(add_nodes_for_label(ctx, state, provider,
+                                               cluster_key, overrides=block))
+        return created
+
+    if ctx.non_interactive:
+        return created
+    while r.prompter.confirm("Add a node to this cluster?"):
+        created.extend(add_nodes_for_label(ctx, state, provider, cluster_key))
+    return created

@@ -1,0 +1,97 @@
+"""Shared workflow plumbing: context object, selection helpers, error contracts.
+
+The selection helpers reproduce the reference's exact non-interactive error
+strings (get/cluster.go:23-82, destroy/node.go:24-126, create/node.go:51-112)
+so silent-mode behavior is pin-compatible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ..backends import Backend
+from ..config import Config, InputResolver, MissingInputError
+from ..state import StateDocument
+
+
+class WorkflowError(RuntimeError):
+    pass
+
+
+@dataclass
+class WorkflowContext:
+    backend: Backend
+    executor: object  # LocalExecutor or TerraformExecutor
+    resolver: InputResolver
+
+    @property
+    def config(self) -> Config:
+        return self.resolver.config
+
+    @property
+    def non_interactive(self) -> bool:
+        return self.resolver.non_interactive
+
+
+def module_source(ctx: WorkflowContext, name: str) -> str:
+    """Module source string, honoring the local-dev redirect keys
+    (``source_url``/``source_ref``; reference create/cluster.go:20-22,305-312)."""
+    base = ctx.config.get("source_url")
+    if base:
+        ref = ctx.config.get("source_ref", "master")
+        return f"{base}//modules/{name}?ref={ref}"
+    return f"modules/{name}"
+
+
+def select_manager(ctx: WorkflowContext,
+                   none_message: str = "No cluster managers.") -> str:
+    """Pick a cluster manager from the backend's persisted states."""
+    states = ctx.backend.states()
+    if not states:
+        raise WorkflowError(none_message)
+    if ctx.config.is_set("cluster_manager"):
+        name = ctx.config.get("cluster_manager")
+        if name not in states:
+            raise WorkflowError(
+                f"Selected cluster manager '{name}' does not exist.")
+        return name
+    if ctx.non_interactive:
+        raise MissingInputError("cluster_manager must be specified")
+    return ctx.resolver.prompter.select(
+        "Cluster Manager", [(s, s) for s in states])
+
+
+def select_cluster(ctx: WorkflowContext, state: StateDocument) -> Tuple[str, str]:
+    """Pick a cluster from the state doc; returns (name, module_key)."""
+    clusters = state.clusters()
+    if not clusters:
+        raise WorkflowError("No clusters.")
+    if ctx.config.is_set("cluster_name"):
+        name = ctx.config.get("cluster_name")
+        if name not in clusters:
+            raise WorkflowError(f"A cluster named '{name}', does not exist.")
+        return name, clusters[name]
+    if ctx.non_interactive:
+        raise MissingInputError("cluster_name must be specified")
+    name = ctx.resolver.prompter.select(
+        "Cluster", [(n, n) for n in sorted(clusters)])
+    return name, clusters[name]
+
+
+def select_node(ctx: WorkflowContext, state: StateDocument,
+                cluster_key: str) -> Tuple[str, str]:
+    """Pick a node of a cluster; returns (hostname, module_key)."""
+    nodes = state.nodes(cluster_key)
+    if not nodes:
+        raise WorkflowError("No nodes.")
+    if ctx.config.is_set("hostname"):
+        hostname = ctx.config.get("hostname")
+        if hostname not in nodes:
+            raise WorkflowError(f"A node named '{hostname}', does not exist.")
+        return hostname, nodes[hostname]
+    if ctx.non_interactive:
+        raise MissingInputError("hostname must be specified")
+    hostname = ctx.resolver.prompter.select(
+        "Node", [(n, n) for n in sorted(nodes)])
+    return hostname, nodes[hostname]

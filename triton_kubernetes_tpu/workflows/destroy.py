@@ -1,0 +1,76 @@
+"""``destroy {manager,cluster,node}`` workflows.
+
+Reference analogs: destroy/manager.go:16-97 (full destroy then delete the
+state from the backend), destroy/cluster.go:16-181 (targeted destroy fan-out:
+cluster + every node + backup, then prune the doc and persist),
+destroy/node.go:16-186 (single-node targeted destroy).
+"""
+
+from __future__ import annotations
+
+from .common import (
+    WorkflowContext,
+    WorkflowError,
+    select_cluster,
+    select_manager,
+    select_node,
+)
+
+
+def delete_manager(ctx: WorkflowContext) -> str:
+    r = ctx.resolver
+    manager = select_manager(
+        ctx, "No cluster managers, please create a cluster manager "
+             "before creating a kubernetes cluster.")
+    if not r.confirm("confirm",
+                     f"Proceed? This will destroy manager '{manager}' "
+                     "and everything it manages"):
+        return ""
+    state = ctx.backend.state(manager)
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    ctx.executor.destroy(state)  # no targets: whole graph
+    ctx.backend.delete(manager)
+    return manager
+
+
+def delete_cluster(ctx: WorkflowContext) -> str:
+    r = ctx.resolver
+    manager = select_manager(ctx)
+    state = ctx.backend.state(manager)
+    cluster_name, cluster_key = select_cluster(ctx, state)
+    if not r.confirm("confirm",
+                     f"Proceed? This will destroy cluster '{cluster_name}'"):
+        return ""
+
+    # Target fan-out: the cluster module, all its nodes, and its backup
+    # (destroy/cluster.go:126-143).
+    targets = [cluster_key]
+    targets.extend(state.nodes(cluster_key).values())
+    backup_key = state.backup(cluster_key)
+    if backup_key:
+        targets.append(backup_key)
+
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    ctx.executor.destroy(state, targets=targets)
+    for key in targets:
+        state.delete(f"module.{key}")
+    ctx.backend.persist(state)
+    return cluster_key
+
+
+def delete_node(ctx: WorkflowContext) -> str:
+    r = ctx.resolver
+    manager = select_manager(
+        ctx, "No cluster managers, please create a cluster manager "
+             "before creating a kubernetes node.")
+    state = ctx.backend.state(manager)
+    _, cluster_key = select_cluster(ctx, state)
+    hostname, node_key = select_node(ctx, state, cluster_key)
+    if not r.confirm("confirm",
+                     f"Proceed? This will destroy node '{hostname}'"):
+        return ""
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    ctx.executor.destroy(state, targets=[node_key])
+    state.delete(f"module.{node_key}")
+    ctx.backend.persist(state)
+    return node_key

@@ -1,0 +1,28 @@
+"""``get {manager,cluster}`` workflows.
+
+Reference analogs: get/manager.go:14-67, get/cluster.go:15-113 (``terraform
+output -module <key>``). Reads come from cached applied state — no re-init
+(fixing the reference's heavyweight read path, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..state import MANAGER_KEY
+from .common import WorkflowContext, select_cluster, select_manager
+
+
+def get_manager(ctx: WorkflowContext) -> Dict[str, Any]:
+    manager = select_manager(ctx)
+    state = ctx.backend.state(manager)
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    return ctx.executor.output(state, MANAGER_KEY)
+
+
+def get_cluster(ctx: WorkflowContext) -> Dict[str, Any]:
+    manager = select_manager(ctx)
+    state = ctx.backend.state(manager)
+    _, cluster_key = select_cluster(ctx, state)
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    return ctx.executor.output(state, cluster_key)

@@ -1,0 +1,46 @@
+"""``create manager`` workflow.
+
+Reference analog: create/manager.go:29-151 — pick provider, name with
+uniqueness check against backend.States(), provider config fn, confirmation,
+set executor backend config, apply, persist-only-on-success.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..config import MissingInputError
+from ..state import StateDocument
+from .common import WorkflowContext, WorkflowError
+from .providers import MANAGER_PROVIDERS
+
+
+def _validate_name(v) -> str | None:
+    # Dashes only: '_' is the module-key delimiter (state/document.py).
+    if not re.match(r"^[A-Za-z0-9][A-Za-z0-9-]*$", str(v)):
+        return "name must be alphanumeric with dashes"
+    return None
+
+
+def new_manager(ctx: WorkflowContext) -> str:
+    r = ctx.resolver
+    provider = r.choose("manager_cloud_provider", "Cloud Provider",
+                        [(p, p) for p in sorted(MANAGER_PROVIDERS)])
+    name = r.value("name", "Cluster Manager Name", validate=_validate_name)
+
+    if ctx.backend.exists(name):
+        raise WorkflowError(
+            f"A cluster manager named '{name}' already exists.")
+
+    state = ctx.backend.state(name)
+    MANAGER_PROVIDERS[provider](ctx, state, name)
+
+    if not r.confirm("confirm", f"Proceed? This will create cluster manager '{name}'"):
+        return ""
+
+    state.set_backend_config(ctx.backend.executor_backend_config(name))
+    ctx.executor.apply(state)
+    # Commit-after-success: the doc is persisted only now
+    # (create/manager.go:147-151).
+    ctx.backend.persist(state)
+    return name

@@ -1,0 +1,84 @@
+"""``create node`` workflow (scale-out path).
+
+Reference analog: create/node.go:43-195 — pick manager, pick cluster,
+dispatch by the provider parsed from the cluster key, node-count semantics
+(workers free-form >=1, etcd/control 1/3/5/7), hostname-prefix collision-free
+numbering, confirm, apply, persist. For ``gcp-tpu`` clusters a "node" is a
+TPU slice node pool — count/labels don't apply; pool name and accelerator do.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+from ..state import StateDocument, parse_cluster_key
+from .common import WorkflowContext, WorkflowError, select_cluster, select_manager
+from .providers import NODE_PROVIDERS
+from .providers.base import (
+    HOST_LABEL_CHOICES,
+    new_hostnames,
+    node_count_for_label,
+)
+
+
+@contextlib.contextmanager
+def _scoped_overrides(ctx: WorkflowContext, overrides: Optional[Dict]):
+    """Temporarily layer a nodes:-block's keys over the config
+    (viper.Set per-node-var analog, create/cluster.go:174-229)."""
+    if not overrides:
+        yield
+        return
+    for k, v in overrides.items():
+        ctx.config.set(k, v)
+    try:
+        yield
+    finally:
+        for k in overrides:
+            ctx.config.unset(k)
+
+
+def add_nodes_for_label(ctx: WorkflowContext, state: StateDocument,
+                        provider: str, cluster_key: str,
+                        overrides: Optional[Dict] = None) -> List[str]:
+    """Create one batch of same-role nodes (one ``nodes:`` block)."""
+    r = ctx.resolver
+    node_fn = NODE_PROVIDERS[provider]
+    with _scoped_overrides(ctx, overrides):
+        if provider == "gcp-tpu":
+            pool_name = r.value("hostname", "TPU Pool Name", default="pool0")
+            key = node_fn(ctx, state, cluster_key, str(pool_name), "worker")
+            return [str(pool_name)]
+        host_label = r.choose("rancher_host_label", "Host Role",
+                              [(l, l) for l in HOST_LABEL_CHOICES],
+                              default="worker")
+        count = node_count_for_label(ctx, host_label)
+        prefix = r.value("hostname", "Hostname prefix")
+        hostnames = new_hostnames(state, cluster_key, str(prefix), count)
+        for hostname in hostnames:
+            node_fn(ctx, state, cluster_key, hostname, host_label)
+        return hostnames
+
+
+def new_node(ctx: WorkflowContext) -> List[str]:
+    r = ctx.resolver
+    manager = select_manager(
+        ctx, "No cluster managers, please create a cluster manager "
+             "before creating a kubernetes node.")
+    state = ctx.backend.state(manager)
+    _, cluster_key = select_cluster(ctx, state)
+    provider, _ = parse_cluster_key(cluster_key)
+    if provider not in NODE_PROVIDERS:
+        raise WorkflowError(
+            f"Could not determine cloud provider for cluster '{cluster_key}'")
+
+    hostnames = add_nodes_for_label(ctx, state, provider, cluster_key)
+
+    if not r.confirm("confirm",
+                     f"Proceed? This will add {len(hostnames)} node(s)"):
+        return []
+
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    ctx.executor.apply(state)
+    ctx.backend.persist(state)
+    return hostnames

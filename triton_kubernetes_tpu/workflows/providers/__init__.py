@@ -1,0 +1,51 @@
+"""Per-provider workflow config builders.
+
+Reference analog: create/manager_*.go, create/cluster_*.go, create/node_*.go —
+the functions that collect provider-specific inputs (tri-modal) and write the
+module config into the state doc. Provider names match the reference's
+choices plus the TPU fork (``gcp-tpu``).
+"""
+
+from . import aws, azure, bare_metal, gcp, gcp_tpu, triton, vsphere
+
+MANAGER_PROVIDERS = {
+    "triton": triton.manager_config,
+    "aws": aws.manager_config,
+    "gcp": gcp.manager_config,
+    "azure": azure.manager_config,
+    "bare-metal": bare_metal.manager_config,
+}
+
+CLUSTER_PROVIDERS = {
+    "triton": triton.cluster_config,
+    "aws": aws.cluster_config,
+    "gcp": gcp.cluster_config,
+    "gke": gcp.gke_cluster_config,
+    "gcp-tpu": gcp_tpu.cluster_config,
+    "azure": azure.cluster_config,
+    "aks": azure.aks_cluster_config,
+    "vsphere": vsphere.cluster_config,
+    "bare-metal": bare_metal.cluster_config,
+}
+
+NODE_PROVIDERS = {
+    "triton": triton.node_config,
+    "aws": aws.node_config,
+    "gcp": gcp.node_config,
+    "gcp-tpu": gcp_tpu.node_config,
+    "azure": azure.node_config,
+    "vsphere": vsphere.node_config,
+    "bare-metal": bare_metal.node_config,
+}
+
+# Hosted-control-plane providers have no agent nodes to add
+# (gke-rancher-k8s analog: nodes come from provider node pools). gcp-tpu is
+# hosted too, but its "nodes" are TPU slice pools, handled by gcp_tpu.node_config.
+HOSTED_PROVIDERS = {"gke", "aks"}
+
+__all__ = [
+    "CLUSTER_PROVIDERS",
+    "HOSTED_PROVIDERS",
+    "MANAGER_PROVIDERS",
+    "NODE_PROVIDERS",
+]

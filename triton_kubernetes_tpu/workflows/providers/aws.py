@@ -1,0 +1,80 @@
+"""AWS provider workflows (create/manager_aws.go:24-515,
+create/cluster_aws.go:24-364, create/node_aws.go:24-364 analogs)."""
+
+from __future__ import annotations
+
+from ...state import StateDocument
+from ..common import WorkflowContext
+from .base import base_cluster_config, base_manager_config, base_node_config
+
+REGIONS = ["us-east-1", "us-east-2", "us-west-1", "us-west-2",
+           "eu-west-1", "eu-central-1", "ap-southeast-1", "ap-northeast-1"]
+INSTANCE_TYPES = ["t2.medium", "t2.large", "m5.large", "m5.xlarge", "c5.xlarge"]
+
+
+def _creds(ctx: WorkflowContext) -> dict:
+    r = ctx.resolver
+    return {
+        "aws_access_key": r.value("aws_access_key", "AWS Access Key"),
+        "aws_secret_key": r.value("aws_secret_key", "AWS Secret Key"),
+        "aws_region": r.choose("aws_region", "AWS Region",
+                               [(x, x) for x in REGIONS], default=REGIONS[0]),
+    }
+
+
+def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> None:
+    r = ctx.resolver
+    cfg = base_manager_config(ctx, "aws-manager", name)
+    cfg.update(_creds(ctx))
+    cfg["aws_vpc_cidr"] = r.value("aws_vpc_cidr", "AWS VPC CIDR",
+                                  default="10.0.0.0/16")
+    cfg["aws_subnet_cidr"] = r.value("aws_subnet_cidr", "AWS Subnet CIDR",
+                                     default="10.0.2.0/24")
+    cfg["aws_instance_type"] = r.choose(
+        "aws_instance_type", "AWS Instance Type",
+        [(t, t) for t in INSTANCE_TYPES], default=INSTANCE_TYPES[0])
+    cfg["aws_public_key_path"] = r.value(
+        "aws_public_key_path", "AWS Public Key Path", default="~/.ssh/id_rsa.pub")
+    cfg["aws_key_name"] = r.value("aws_key_name", "AWS Key Name", default="")
+    state.set_manager(cfg)
+
+
+def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    r = ctx.resolver
+    cfg = base_cluster_config(ctx, "aws-k8s", name)
+    cfg.update(_creds(ctx))
+    cfg["aws_vpc_cidr"] = r.value("aws_vpc_cidr", "AWS VPC CIDR",
+                                  default="10.0.0.0/16")
+    cfg["aws_subnet_cidr"] = r.value("aws_subnet_cidr", "AWS Subnet CIDR",
+                                     default="10.0.2.0/24")
+    cfg["aws_public_key_path"] = r.value(
+        "aws_public_key_path", "AWS Public Key Path", default="~/.ssh/id_rsa.pub")
+    cfg["aws_key_name"] = r.value("aws_key_name", "AWS Key Name", default="")
+    return state.add_cluster("aws", name, cfg)
+
+
+def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
+                hostname: str, host_label: str) -> str:
+    r = ctx.resolver
+    cfg = base_node_config(ctx, "aws-k8s-host", cluster_key, hostname, host_label)
+    cfg.update(_creds(ctx))
+    cfg["aws_ami_id"] = r.value("aws_ami_id", "AWS AMI ID", default="ami-ubuntu-lts")
+    cfg["aws_instance_type"] = r.choose(
+        "aws_instance_type", "AWS Instance Type",
+        [(t, t) for t in INSTANCE_TYPES], default=INSTANCE_TYPES[0])
+    # Wire the cluster's network envelope via interpolation.
+    cfg["aws_subnet_id"] = f"${{module.{cluster_key}.aws_subnet_id}}"
+    cfg["aws_security_group_id"] = f"${{module.{cluster_key}.aws_security_group_id}}"
+    # Optional EBS volume (aws-rancher-k8s-host/main.tf:47-62 analog).
+    device = r.value("ebs_volume_device_name", "EBS Volume Device Name", default="")
+    if device:
+        cfg["ebs_volume_device_name"] = device
+        cfg["ebs_volume_mount_path"] = r.value(
+            "ebs_volume_mount_path", "EBS Volume Mount Path", default="/mnt/data")
+        cfg["ebs_volume_type"] = r.choose(
+            "ebs_volume_type", "EBS Volume Type",
+            [("standard", "standard"), ("gp2", "gp2"), ("io1", "io1")],
+            default="standard")
+        cfg["ebs_volume_size"] = int(r.value("ebs_volume_size", "EBS Volume Size (GB)",
+                                             default=100))
+    return state.add_node(cluster_key, hostname, cfg)

@@ -1,0 +1,105 @@
+"""Azure provider workflows, including the HA branch and hosted AKS.
+
+Reference analogs: create/manager_azure.go:23-578 (``ha: true`` switches to
+the azure-rke module and demands fqdn + TLS cert/key paths — note its
+cert-path-into-key-path bug at :155 is fixed here), create/cluster_azure.go,
+create/cluster_aks.go:27-522, create/node_azure.go:25-325.
+"""
+
+from __future__ import annotations
+
+from ...state import StateDocument
+from ..common import WorkflowContext, module_source
+from .base import base_cluster_config, base_manager_config, base_node_config
+
+LOCATIONS = ["West US 2", "East US", "West Europe", "Southeast Asia"]
+VM_SIZES = ["Standard_D2s_v3", "Standard_D4s_v3", "Standard_D8s_v3"]
+
+
+def _creds(ctx: WorkflowContext) -> dict:
+    r = ctx.resolver
+    return {
+        "azure_subscription_id": r.value("azure_subscription_id",
+                                         "Azure Subscription ID"),
+        "azure_client_id": r.value("azure_client_id", "Azure Client ID"),
+        "azure_client_secret": r.value("azure_client_secret", "Azure Client Secret"),
+        "azure_tenant_id": r.value("azure_tenant_id", "Azure Tenant ID"),
+        "azure_location": r.choose("azure_location", "Azure Location",
+                                   [(x, x) for x in LOCATIONS],
+                                   default=LOCATIONS[0]),
+    }
+
+
+def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> None:
+    r = ctx.resolver
+    ha = r.flag("ha", default=False)
+    if ha:
+        cfg = base_manager_config(ctx, "azure-rke-manager", name)
+        cfg.update(_creds(ctx))
+        cfg["node_count"] = int(r.value("node_count", "Manager Node Count",
+                                        default=3))
+        cfg["fqdn"] = r.value("fqdn", "Manager FQDN")
+        cfg["tls_cert_path"] = r.value("tls_cert_path", "TLS Certificate Path")
+        cfg["tls_private_key_path"] = r.value("tls_private_key_path",
+                                              "TLS Private Key Path")
+    else:
+        cfg = base_manager_config(ctx, "azure-manager", name)
+        cfg.update(_creds(ctx))
+    cfg["azure_size"] = r.choose("azure_size", "Azure VM Size",
+                                 [(s, s) for s in VM_SIZES], default=VM_SIZES[0])
+    cfg["azure_public_key_path"] = r.value(
+        "azure_public_key_path", "Azure Public Key Path",
+        default="~/.ssh/id_rsa.pub")
+    state.set_manager(cfg)
+
+
+def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    cfg = base_cluster_config(ctx, "azure-k8s", name)
+    cfg.update(_creds(ctx))
+    return state.add_cluster("azure", name, cfg)
+
+
+def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
+                hostname: str, host_label: str) -> str:
+    r = ctx.resolver
+    cfg = base_node_config(ctx, "azure-k8s-host", cluster_key, hostname, host_label)
+    cfg.update(_creds(ctx))
+    cfg["azure_size"] = r.choose("azure_size", "Azure VM Size",
+                                 [(s, s) for s in VM_SIZES], default=VM_SIZES[0])
+    cfg["azure_subnet_id"] = f"${{module.{cluster_key}.azure_subnet_id}}"
+    cfg["azure_public_key_path"] = r.value(
+        "azure_public_key_path", "Azure Public Key Path",
+        default="~/.ssh/id_rsa.pub")
+    disk_type = r.value("managed_disk_type", "Managed Disk Type", default="")
+    if disk_type:
+        cfg["managed_disk_type"] = disk_type
+        cfg["managed_disk_size"] = int(r.value("managed_disk_size",
+                                               "Managed Disk Size (GB)",
+                                               default=100))
+        cfg["managed_disk_mount_path"] = r.value(
+            "managed_disk_mount_path", "Managed Disk Mount Path",
+            default="/mnt/data")
+    return state.add_node(cluster_key, hostname, cfg)
+
+
+def aks_cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    """Hosted AKS path (create/cluster_aks.go analog)."""
+    r = ctx.resolver
+    cfg = {
+        "source": module_source(ctx, "aks-k8s"),
+        "name": name,
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        **_creds(ctx),
+        "azure_size": r.choose("azure_size", "Azure VM Size",
+                               [(s, s) for s in VM_SIZES], default=VM_SIZES[0]),
+        "azure_ssh_user": r.value("azure_ssh_user", "Azure SSH User",
+                                  default="azureuser"),
+        "azure_public_key_path": r.value("azure_public_key_path",
+                                         "Azure Public Key Path",
+                                         default="~/.ssh/id_rsa.pub"),
+        "k8s_version": r.value("k8s_version", "Kubernetes Version", default="1.31"),
+        "node_count": int(r.value("node_count", "Node Count", default=3)),
+    }
+    return state.add_cluster("aks", name, cfg)

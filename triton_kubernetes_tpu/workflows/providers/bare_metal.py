@@ -1,0 +1,53 @@
+"""Bare-metal provider workflows (create/manager_bare_metal.go:15-150,
+create/cluster_bare_metal.go:9-36, create/node_bare_metal.go:18-198 analogs).
+
+The node flow supports the reference's multi-host form: a ``hosts:`` list
+creates one module per host in a single pass.
+"""
+
+from __future__ import annotations
+
+from ...state import StateDocument
+from ..common import WorkflowContext
+from .base import base_cluster_config, base_manager_config, base_node_config
+
+
+def _ssh(ctx: WorkflowContext) -> dict:
+    r = ctx.resolver
+    return {
+        "ssh_user": r.value("ssh_user", "SSH User", default="root"),
+        "key_path": r.value("key_path", "SSH Key Path", default="~/.ssh/id_rsa"),
+        "bastion_host": r.value("bastion_host", "Bastion Host", default=""),
+    }
+
+
+def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> None:
+    r = ctx.resolver
+    cfg = base_manager_config(ctx, "bare-metal-manager", name)
+    cfg["host"] = r.value("host", "Host (IP or DNS name)")
+    cfg.update(_ssh(ctx))
+    state.set_manager(cfg)
+
+
+def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    return state.add_cluster("bare-metal", name,
+                             base_cluster_config(ctx, "bare-metal-k8s", name))
+
+
+def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
+                hostname: str, host_label: str) -> str:
+    r = ctx.resolver
+    cfg = base_node_config(ctx, "bare-metal-k8s-host", cluster_key,
+                           hostname, host_label)
+    # In silent mode a hosts: list maps hostnames to addresses; otherwise the
+    # host address is prompted per node.
+    hosts = ctx.config.get("hosts")
+    if isinstance(hosts, list) and hosts:
+        # Positional: Nth created hostname takes the Nth host entry.
+        idx = len(state.nodes(cluster_key))
+        entry = hosts[min(idx, len(hosts) - 1)]
+        cfg["host"] = entry.get("host") if isinstance(entry, dict) else entry
+    else:
+        cfg["host"] = r.value("host", f"Host address for {hostname}")
+    cfg.update(_ssh(ctx))
+    return state.add_node(cluster_key, hostname, cfg)

@@ -1,0 +1,131 @@
+"""Shared base-config builders for the three module families.
+
+Reference analogs: getBaseManagerTerraformConfig (create/manager.go:156-300),
+getBaseClusterTerraformConfig (create/cluster.go:296-532),
+getBaseNodeTerraformConfig (create/node.go:197-387). Silent-YAML key names
+match the reference's schema (docs/guide/silent-install-yaml.md) exactly —
+``rancher_server_image``, ``k8s_network_provider``, ``rancher_host_label``...
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ...state import StateDocument
+from ..common import WorkflowContext, module_source
+
+K8S_VERSIONS = [
+    "v1.27.16", "v1.28.15", "v1.29.10", "v1.30.6", "v1.31.2", "v1.32.0",
+]
+NETWORK_PROVIDERS = ["calico", "flannel"]
+
+
+def base_manager_config(ctx: WorkflowContext, module_name: str,
+                        name: str) -> Dict[str, Any]:
+    r = ctx.resolver
+    cfg: Dict[str, Any] = {
+        "source": module_source(ctx, module_name),
+        "name": name,
+    }
+    registry = r.value("private_registry", "Private Registry", default="")
+    if registry:
+        cfg["private_registry"] = registry
+        cfg["private_registry_username"] = r.value(
+            "private_registry_username", "Private Registry Username")
+        cfg["private_registry_password"] = r.value(
+            "private_registry_password", "Private Registry Password")
+    server_image = r.value("rancher_server_image", "Manager Server Image", default="")
+    if server_image:
+        cfg["manager_image"] = server_image
+    agent_image = r.value("rancher_agent_image", "Manager Agent Image", default="")
+    if agent_image:
+        cfg["agent_image"] = agent_image
+    cfg["admin_password"] = r.value(
+        "rancher_admin_password", "Admin Password (UI)", default="")
+    return cfg
+
+
+def base_cluster_config(ctx: WorkflowContext, module_name: str,
+                        name: str) -> Dict[str, Any]:
+    """Manager credentials are *interpolations* resolved at apply time by the
+    executor — never literal values (create/cluster.go:297-300 contract)."""
+    r = ctx.resolver
+    cfg: Dict[str, Any] = {
+        "source": module_source(ctx, module_name),
+        "name": name,
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        "k8s_version": r.choose(
+            "k8s_version", "Kubernetes Version",
+            [(v, v) for v in K8S_VERSIONS], default=K8S_VERSIONS[-1]),
+        "k8s_network_provider": r.choose(
+            "k8s_network_provider", "Kubernetes Network Provider",
+            [(n, n) for n in NETWORK_PROVIDERS], default="calico"),
+    }
+    registry = r.value("private_registry", "Private Registry", default="")
+    if registry:
+        cfg["private_registry"] = registry
+        cfg["private_registry_username"] = r.value(
+            "private_registry_username", "Private Registry Username")
+        cfg["private_registry_password"] = r.value(
+            "private_registry_password", "Private Registry Password")
+    k8s_registry = r.value("k8s_registry", "Kubernetes Registry", default="")
+    if k8s_registry:
+        cfg["k8s_registry"] = k8s_registry
+        cfg["k8s_registry_username"] = r.value(
+            "k8s_registry_username", "Kubernetes Registry Username")
+        cfg["k8s_registry_password"] = r.value(
+            "k8s_registry_password", "Kubernetes Registry Password")
+    return cfg
+
+
+HOST_LABEL_CHOICES = ["worker", "etcd", "control"]
+
+
+def base_node_config(ctx: WorkflowContext, module_name: str,
+                     cluster_key: str, hostname: str,
+                     host_label: str) -> Dict[str, Any]:
+    """Registration token + CA checksum wired as interpolations from the
+    cluster module (create/node.go getBaseNodeTerraformConfig contract), plus
+    the worker/etcd/control host label (rancherHostLabelsConfig)."""
+    return {
+        "source": module_source(ctx, module_name),
+        "hostname": hostname,
+        "rancher_cluster_registration_token":
+            f"${{module.{cluster_key}.registration_token}}",
+        "rancher_cluster_ca_checksum":
+            f"${{module.{cluster_key}.ca_checksum}}",
+        "rancher_host_labels": {host_label: True},
+    }
+
+
+def node_count_for_label(ctx: WorkflowContext, host_label: str) -> int:
+    """Workers: free-form >=1. etcd/control: 1/3/5/7 (quorum-shaped), matching
+    create/node.go getNodeCount."""
+    r = ctx.resolver
+    if host_label == "worker":
+        def _validate(v: Any) -> str | None:
+            try:
+                return None if int(v) >= 1 else "node_count must be >= 1"
+            except (TypeError, ValueError):
+                return "node_count must be an integer"
+        return int(r.value("node_count", "Number of nodes", default=1,
+                           validate=_validate))
+    return int(r.choose("node_count", "Number of nodes",
+                        [("1", 1), ("3", 3), ("5", 5), ("7", 7)], default=1))
+
+
+def new_hostnames(state: StateDocument, cluster_key: str,
+                  prefix: str, count: int) -> list[str]:
+    """Collision-free ``prefix-N`` numbering continuing past existing nodes
+    (create/node.go getNewHostnames, pinned by create/node_test.go)."""
+    existing = set(state.nodes(cluster_key))
+    out: list[str] = []
+    n = 1
+    while len(out) < count:
+        candidate = f"{prefix}-{n}"
+        if candidate not in existing:
+            out.append(candidate)
+        n += 1
+    return out

@@ -1,0 +1,125 @@
+"""GCP provider workflows — VM path and hosted-GKE path.
+
+Reference analogs: create/manager_gcp.go:22-422 (service-account JSON ->
+project id), create/cluster_gcp.go:23-168, create/node_gcp.go:21-387,
+create/cluster_gke.go:26-519 (hosted path with master password >=16 chars).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ...state import StateDocument
+from ..common import WorkflowContext
+from .base import base_cluster_config, base_manager_config, base_node_config
+from ..common import module_source
+
+REGIONS = ["us-central1", "us-east1", "us-east5", "us-west1",
+           "europe-west1", "europe-west4", "asia-northeast1"]
+MACHINE_TYPES = ["n1-standard-1", "n1-standard-2", "n1-standard-4",
+                 "n2-standard-4", "n2-standard-8"]
+IMAGES = ["ubuntu-os-cloud/ubuntu-2204-lts", "ubuntu-os-cloud/ubuntu-2404-lts"]
+
+
+def project_id_from_credentials(path: str) -> Optional[str]:
+    """Extract ``project_id`` from a service-account JSON file
+    (create/manager_gcp.go's re-unmarshal trick)."""
+    try:
+        with open(os.path.expanduser(path)) as f:
+            return json.load(f).get("project_id")
+    except (OSError, ValueError):
+        return None
+
+
+def _creds(ctx: WorkflowContext) -> dict:
+    r = ctx.resolver
+    path = r.value("gcp_path_to_credentials", "Path to GCP credentials file")
+    project = ctx.config.get("gcp_project_id") or project_id_from_credentials(path)
+    if not project:
+        project = r.value("gcp_project_id", "GCP Project ID")
+    return {"gcp_path_to_credentials": path, "gcp_project_id": project}
+
+
+def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> None:
+    r = ctx.resolver
+    cfg = base_manager_config(ctx, "gcp-manager", name)
+    cfg.update(_creds(ctx))
+    cfg["gcp_compute_region"] = r.choose(
+        "gcp_compute_region", "GCP Region", [(x, x) for x in REGIONS],
+        default=REGIONS[0])
+    cfg["gcp_zone"] = r.value("gcp_zone", "GCP Zone",
+                              default=f"{cfg['gcp_compute_region']}-a")
+    cfg["gcp_machine_type"] = r.choose(
+        "gcp_machine_type", "GCP Machine Type",
+        [(t, t) for t in MACHINE_TYPES], default=MACHINE_TYPES[1])
+    cfg["gcp_image"] = r.choose("gcp_image", "GCP Image",
+                                [(i, i) for i in IMAGES], default=IMAGES[0])
+    state.set_manager(cfg)
+
+
+def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    r = ctx.resolver
+    cfg = base_cluster_config(ctx, "gcp-k8s", name)
+    cfg.update(_creds(ctx))
+    cfg["gcp_compute_region"] = r.choose(
+        "gcp_compute_region", "GCP Region", [(x, x) for x in REGIONS],
+        default=REGIONS[0])
+    return state.add_cluster("gcp", name, cfg)
+
+
+def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
+                hostname: str, host_label: str) -> str:
+    r = ctx.resolver
+    cfg = base_node_config(ctx, "gcp-k8s-host", cluster_key, hostname, host_label)
+    cfg.update(_creds(ctx))
+    cfg["gcp_zone"] = r.value("gcp_instance_zone", "GCP Zone", default="us-central1-a")
+    cfg["gcp_machine_type"] = r.choose(
+        "gcp_machine_type", "GCP Machine Type",
+        [(t, t) for t in MACHINE_TYPES], default=MACHINE_TYPES[0])
+    cfg["gcp_image"] = r.value("gcp_image", "GCP Image", default=IMAGES[0])
+    # Network envelope from the cluster module (create/node_gcp.go contract).
+    cfg["gcp_compute_network_name"] = \
+        f"${{module.{cluster_key}.gcp_compute_network_name}}"
+    cfg["gcp_firewall_tag"] = f"${{module.{cluster_key}.gcp_firewall_tag}}"
+    disk_type = r.value("gcp_disk_type", "GCP Disk Type", default="")
+    if disk_type:
+        cfg["gcp_disk_type"] = disk_type
+        cfg["gcp_disk_size"] = int(r.value("gcp_disk_size", "GCP Disk Size (GB)",
+                                           default=100))
+        cfg["gcp_disk_mount_path"] = r.value(
+            "gcp_disk_mount_path", "GCP Disk Mount Path", default="/mnt/data")
+    return state.add_node(cluster_key, hostname, cfg)
+
+
+def gke_cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    """Hosted GKE path — no base cluster config (no k8s_network_provider or
+    registries; create/cluster_gke.go deliberately skips them)."""
+    r = ctx.resolver
+    creds = _creds(ctx)
+
+    def _pw(v) -> str | None:
+        return None if len(str(v)) >= 16 else \
+            "master_password must be at least 16 characters"
+
+    cfg = {
+        "source": module_source(ctx, "gke-k8s"),
+        "name": name,
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        **creds,
+        "gcp_zone": r.value("gcp_zone", "GCP Zone", default="us-central1-a"),
+        "gcp_additional_zones": r.value("gcp_additional_zones",
+                                        "GCP Additional Zones", default=[]),
+        "gcp_machine_type": r.choose(
+            "gcp_machine_type", "GCP Machine Type",
+            [(t, t) for t in MACHINE_TYPES], default=MACHINE_TYPES[1]),
+        "k8s_version": r.value("k8s_version", "Kubernetes Master Version",
+                               default="1.31"),
+        "node_count": int(r.value("node_count", "Node Count", default=3)),
+        "master_password": r.value("master_password", "GKE Master Password",
+                                   default="change-me-please-16", validate=_pw),
+    }
+    return state.add_cluster("gke", name, cfg)

@@ -1,0 +1,108 @@
+"""THE TPU FORK's workflow layer: ``create cluster --provider gcp-tpu`` and
+TPU slice "nodes".
+
+No reference analog (BASELINE.json north star: "add create/cluster_tpu.go,
+create/node_tpu.go"). The node flow is re-imagined for TPUs: where VM
+providers ask host-label + count, the TPU path asks **accelerator**
+(``v5e-8``, ``v5p-64``...) and optional topology, and one "node" module is a
+whole slice node pool (nodes-per-slice is derived, never asked).
+"""
+
+from __future__ import annotations
+
+from ...state import StateDocument
+from ...topology import TPU_GENERATIONS, SliceSpec, default_topology, parse_accelerator
+from ..common import WorkflowContext, module_source
+from .gcp import REGIONS, _creds
+
+TPU_REGIONS = ["us-east5", "us-central2", "us-south1", "europe-west4",
+               "asia-northeast1"]
+COMMON_ACCELERATORS = [
+    "v5e-1", "v5e-4", "v5e-8", "v5e-16", "v5e-64", "v5e-256",
+    "v5p-8", "v5p-64", "v5p-128", "v5p-256",
+    "v6e-8", "v6e-64", "v6e-256",
+    "v4-8", "v4-64",
+]
+
+
+def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    """GKE control plane destined for TPU node pools."""
+    r = ctx.resolver
+    creds = _creds(ctx)
+    cfg = {
+        "source": module_source(ctx, "gcp-tpu-k8s"),
+        "name": name,
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        **creds,
+        "gcp_region": r.choose("gcp_region", "GCP Region (TPU-capable)",
+                               [(x, x) for x in TPU_REGIONS],
+                               default=TPU_REGIONS[0]),
+        "k8s_version": r.value("k8s_version", "Kubernetes Version", default="1.31"),
+        "system_node_count": int(r.value("system_node_count",
+                                         "System Pool Node Count", default=1)),
+    }
+    return state.add_cluster("gcp-tpu", name, cfg)
+
+
+def _validate_accelerator(v) -> str | None:
+    try:
+        parse_accelerator(str(v))
+        return None
+    except ValueError as e:
+        return str(e)
+
+
+def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
+                pool_name: str, host_label: str = "worker") -> str:
+    """One TPU slice as a node pool. ``pool_name`` takes the hostname slot in
+    the module key scheme (node_gcp-tpu_<cluster>_<pool>)."""
+    r = ctx.resolver
+    creds = _creds(ctx)
+    accelerator = r.choose(
+        "tpu_accelerator", "TPU Accelerator (<generation>-<chips>)",
+        [(a, a) for a in COMMON_ACCELERATORS], default="v5e-8") \
+        if not ctx.config.is_set("tpu_accelerator") else \
+        r.value("tpu_accelerator", validate=_validate_accelerator)
+    gen, chips = parse_accelerator(str(accelerator))
+    topology = r.value("tpu_topology", "TPU Topology (e.g. 4x4x4)",
+                       default=default_topology(gen, chips))
+    # Validate the pair early — fail at prompt time, not apply time.
+    SliceSpec.from_accelerator(str(accelerator), str(topology) or None)
+    _, cluster_name = cluster_key.split("_", 2)[1:]
+    cfg = {
+        "source": module_source(ctx, "gcp-tpu-nodepool"),
+        "pool_name": pool_name,
+        "gke_cluster_name": cluster_name,
+        "cluster_id": f"${{module.{cluster_key}.cluster_id}}",
+        **creds,
+        "tpu_accelerator": str(accelerator),
+        "tpu_topology": str(topology),
+        "reserved": r.flag("tpu_reserved", default=False),
+        "spot": r.flag("tpu_spot", default=False),
+    }
+    return state.add_node(cluster_key, pool_name, cfg)
+
+
+def jobset_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
+                  pool_key: str, job_name: str) -> str:
+    """Attach a multi-host JAX workload to a provisioned slice."""
+    r = ctx.resolver
+    pool_cfg = state.get(f"module.{pool_key}") or {}
+    cfg = {
+        "source": module_source(ctx, "tpu-jobset"),
+        "job_name": job_name,
+        "cluster_id": f"${{module.{cluster_key}.cluster_id}}",
+        "tpu_accelerator": pool_cfg.get("tpu_accelerator", "v5e-8"),
+        "tpu_topology": pool_cfg.get("tpu_topology", ""),
+        "slice_id": f"${{module.{pool_key}.slice_id}}",
+        "image": r.value("job_image", "Workload Image",
+                         default="tk8s/jax-tpu-runtime:0.1.0"),
+        "command": r.value("job_command", "Workload Command",
+                           default=["python", "-m", "triton_kubernetes_tpu.train"]),
+        "env": r.value("job_env", "Workload Env", default={}),
+    }
+    key = f"job_{job_name}"
+    state.set(f"module.{key}", cfg)
+    return key

@@ -1,0 +1,69 @@
+"""Triton provider workflows (create/manager_triton.go:25-399,
+create/cluster_triton.go:16-140, create/node_triton.go:23-328 analogs)."""
+
+from __future__ import annotations
+
+from ...state import StateDocument
+from ..common import WorkflowContext
+from .base import base_cluster_config, base_manager_config, base_node_config
+
+TRITON_URLS = [
+    "https://us-east-1.api.joyent.com",
+    "https://us-west-1.api.joyent.com",
+    "https://eu-ams-1.api.joyentcloud.com",
+]
+IMAGES = ["ubuntu-certified-16.04", "ubuntu-certified-18.04"]
+PACKAGES = ["k4-highcpu-kvm-1.75G", "k4-highcpu-kvm-3.75G", "k4-general-kvm-7.75G"]
+NETWORKS = ["Joyent-SDC-Public", "Joyent-SDC-Private"]
+
+
+def _creds(ctx: WorkflowContext) -> dict:
+    r = ctx.resolver
+    return {
+        "triton_account": r.value("triton_account", "Triton Account Name"),
+        "triton_key_path": r.value("triton_key_path", "Triton Key Path",
+                                   default="~/.ssh/id_rsa"),
+        "triton_key_id": r.value("triton_key_id", "Triton Key ID", default=""),
+        "triton_url": r.choose("triton_url", "Triton URL",
+                               [(u, u) for u in TRITON_URLS],
+                               default=TRITON_URLS[0]),
+    }
+
+
+def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> None:
+    r = ctx.resolver
+    cfg = base_manager_config(ctx, "triton-manager", name)
+    cfg.update(_creds(ctx))
+    cfg["triton_image_name"] = r.choose(
+        "triton_image_name", "Triton Image", [(i, i) for i in IMAGES],
+        default=IMAGES[0])
+    cfg["triton_machine_package"] = r.choose(
+        "master_triton_machine_package", "Triton Machine Package",
+        [(p, p) for p in PACKAGES], default=PACKAGES[0])
+    cfg["triton_network_names"] = r.value(
+        "triton_network_names", "Triton Networks", default=[NETWORKS[0]])
+    state.set_manager(cfg)
+
+
+def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    cfg = base_cluster_config(ctx, "triton-k8s", name)
+    cfg.update(_creds(ctx))
+    return state.add_cluster("triton", name, cfg)
+
+
+def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
+                hostname: str, host_label: str) -> str:
+    r = ctx.resolver
+    cfg = base_node_config(ctx, "triton-k8s-host", cluster_key, hostname, host_label)
+    cfg.update(_creds(ctx))
+    cfg["triton_image_name"] = r.choose(
+        "triton_image_name", "Triton Image", [(i, i) for i in IMAGES],
+        default=IMAGES[0])
+    cfg["triton_ssh_user"] = r.value("triton_ssh_user", "Triton SSH User",
+                                     default="ubuntu")
+    cfg["triton_machine_package"] = r.choose(
+        "triton_machine_package", "Triton Machine Package",
+        [(p, p) for p in PACKAGES], default=PACKAGES[0])
+    cfg["triton_network_names"] = r.value(
+        "triton_network_names", "Triton Networks", default=[NETWORKS[0]])
+    return state.add_node(cluster_key, hostname, cfg)

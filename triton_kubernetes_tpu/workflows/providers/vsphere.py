@@ -1,0 +1,44 @@
+"""vSphere provider workflows (create/cluster_vsphere.go:13-210,
+create/node_vsphere.go:17-180 analogs; no vSphere manager in the reference)."""
+
+from __future__ import annotations
+
+from ...state import StateDocument
+from ..common import WorkflowContext
+from .base import base_cluster_config, base_node_config
+
+
+def _creds(ctx: WorkflowContext) -> dict:
+    r = ctx.resolver
+    return {
+        "vsphere_user": r.value("vsphere_user", "vSphere User"),
+        "vsphere_password": r.value("vsphere_password", "vSphere Password"),
+        "vsphere_server": r.value("vsphere_server", "vSphere Server"),
+        "vsphere_datacenter_name": r.value("vsphere_datacenter_name",
+                                           "vSphere Datacenter"),
+        "vsphere_datastore_name": r.value("vsphere_datastore_name",
+                                          "vSphere Datastore"),
+        "vsphere_resource_pool_name": r.value("vsphere_resource_pool_name",
+                                              "vSphere Resource Pool"),
+        "vsphere_network_name": r.value("vsphere_network_name",
+                                        "vSphere Network"),
+    }
+
+
+def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str:
+    cfg = base_cluster_config(ctx, "vsphere-k8s", name)
+    cfg.update(_creds(ctx))
+    return state.add_cluster("vsphere", name, cfg)
+
+
+def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
+                hostname: str, host_label: str) -> str:
+    r = ctx.resolver
+    cfg = base_node_config(ctx, "vsphere-k8s-host", cluster_key,
+                           hostname, host_label)
+    cfg.update(_creds(ctx))
+    cfg["vsphere_template_name"] = r.value("vsphere_template_name",
+                                           "vSphere Template VM")
+    cfg["ssh_user"] = r.value("ssh_user", "SSH User", default="root")
+    cfg["key_path"] = r.value("key_path", "SSH Key Path", default="~/.ssh/id_rsa")
+    return state.add_node(cluster_key, hostname, cfg)
