@@ -1,0 +1,91 @@
+"""models/: Llama + Mixtral forward passes, param accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.models import (
+    forward,
+    get_config,
+    init_params,
+    logical_axes,
+)
+
+
+def test_config_registry():
+    cfg = get_config("llama3-8b")
+    # Published Llama-3-8B ≈ 8.03B params; our accounting must land close.
+    assert abs(cfg.num_params() - 8.03e9) / 8.03e9 < 0.01
+    cfg70 = get_config("llama3-70b")
+    assert abs(cfg70.num_params() - 70.6e9) / 70.6e9 < 0.02
+    mix = get_config("mixtral-8x7b")
+    assert abs(mix.num_params() - 46.7e9) / 46.7e9 < 0.02
+    assert mix.active_params() < 14e9
+
+
+def test_config_overrides():
+    cfg = get_config("llama-test", num_layers=3)
+    assert cfg.num_layers == 3
+    with pytest.raises(KeyError):
+        get_config("nope")
+
+
+def test_params_match_logical_structure():
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    axes = logical_axes(cfg)
+    assert jax.tree.structure(params) == jax.tree.structure(
+        axes, is_leaf=lambda x: isinstance(x, tuple))
+    # Every leaf's rank matches its logical annotation.
+    flat_p = jax.tree.leaves(params)
+    flat_a = jax.tree.leaves(axes, is_leaf=lambda x: isinstance(x, tuple))
+    for p, a in zip(flat_p, flat_a):
+        assert p.ndim == len(a), (p.shape, a)
+
+
+def test_param_count_matches_accounting():
+    for name in ("llama-test", "mixtral-test"):
+        cfg = get_config(name)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        actual = sum(p.size for p in jax.tree.leaves(params))
+        assert actual == cfg.num_params(), name
+
+
+@pytest.mark.parametrize("name", ["llama-test", "mixtral-test"])
+def test_forward_shapes_and_finiteness(name):
+    cfg = get_config(name)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits, aux = jax.jit(
+        lambda p, t: forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+    assert np.isfinite(float(aux))
+    if name == "mixtral-test":
+        assert float(aux) > 0.0
+
+
+def test_scan_matches_unrolled():
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (1, 8), 0, cfg.vocab_size)
+    l_scan, _ = forward(params, tokens, cfg)
+    from dataclasses import replace
+    l_unroll, _ = forward(params, tokens, replace(cfg, scan_layers=False))
+    np.testing.assert_allclose(l_scan, l_unroll, atol=1e-5)
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    t1 = jax.random.randint(jax.random.PRNGKey(3), (1, 12), 0, cfg.vocab_size)
+    t2 = t1.at[0, -1].set((t1[0, -1] + 1) % cfg.vocab_size)
+    l1, _ = forward(params, t1, cfg)
+    l2, _ = forward(params, t2, cfg)
+    np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], atol=1e-6)
+    assert np.abs(np.asarray(l1[:, -1] - l2[:, -1])).max() > 1e-4

@@ -1,0 +1,66 @@
+"""parallel/ layer: mesh resolution + logical-axis sharding rules."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_kubernetes_tpu.parallel import (
+    MeshConfig,
+    create_mesh,
+    logical_to_spec,
+)
+from triton_kubernetes_tpu.parallel.mesh import MESH_AXES, ParallelismPlan
+
+
+def test_resolve_wildcard():
+    sizes = MeshConfig(data=2, fsdp=-1, tensor=2).resolve(8)
+    assert sizes["fsdp"] == 2 and sizes["data"] == 2 and sizes["tensor"] == 2
+
+
+def test_resolve_rejects_bad_product():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, fsdp=1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=-1).resolve(8)
+
+
+def test_create_mesh_axes(cpu_mesh_devices):
+    mesh = create_mesh(MeshConfig(fsdp=4, tensor=2))
+    assert mesh.axis_names == MESH_AXES
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert shape["fsdp"] == 4 and shape["tensor"] == 2 and shape["data"] == 1
+
+
+def test_logical_to_spec_basic():
+    assert logical_to_spec(("embed", "mlp")) == P("fsdp", "tensor")
+    assert logical_to_spec(("vocab", "embed")) == P("tensor", "fsdp")
+    assert logical_to_spec(("batch", "sequence", "heads", None)) == P(
+        ("data", "fsdp"), "seq", "tensor")
+
+
+def test_logical_to_spec_dedups_mesh_axes():
+    # "embed" then "batch": fsdp already used by embed → batch keeps only data.
+    spec = logical_to_spec(("embed", "batch"))
+    assert spec == P("fsdp", "data")
+
+
+def test_logical_to_spec_respects_mesh(cpu_mesh_devices):
+    mesh = create_mesh(MeshConfig(fsdp=8))
+    # All axes exist on a full MeshConfig mesh, including size-1 ones.
+    assert logical_to_spec(("embed", "mlp"), mesh=mesh) == P("fsdp", "tensor")
+
+
+def test_logical_to_spec_unknown_axis_raises():
+    with pytest.raises(KeyError):
+        logical_to_spec(("no-such-axis",))
+
+
+def test_parallelism_plan_guards():
+    with pytest.raises(ValueError, match="ring_attention"):
+        ParallelismPlan(MeshConfig(seq=2, fsdp=-1)).validate(8)
+    with pytest.raises(ValueError, match="microbatches"):
+        ParallelismPlan(
+            MeshConfig(stage=2, fsdp=-1), microbatches=3).validate(8)
+    sizes = ParallelismPlan(
+        MeshConfig(seq=2, fsdp=-1), ring_attention=True).validate(8)
+    assert sizes["seq"] == 2 and sizes["fsdp"] == 4

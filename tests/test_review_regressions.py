@@ -314,3 +314,93 @@ def test_last_tpu_pool_destroy_removes_daemonsets():
         assert not ex.cloud_view(doc).get_manifests(cid, "DaemonSet")
     finally:
         delete_executor_state(doc)
+
+
+def test_cli_set_parses_scalars(tmp_path, monkeypatch):
+    """--set confirm=false must be boolean False (was: truthy string)."""
+    from triton_kubernetes_tpu.cli.main import main
+    from triton_kubernetes_tpu.backends.memory import MemoryBackend
+    from triton_kubernetes_tpu.executor import LocalExecutor
+
+    be = MemoryBackend()
+    # Seed a manager so destroy has something to refuse.
+    doc = be.state("m1")
+    doc.set_backend_config(be.executor_backend_config("m1"))
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "m1",
+                     "host": "10.0.0.1"})
+    ex = LocalExecutor()
+    ex.apply(doc)
+    be.persist(doc)
+    rc = main(["--set", "cluster_manager=m1", "--set", "confirm=false",
+               "destroy", "manager"],
+              backend=be, executor=ex)
+    assert rc == 0
+    assert "m1" in be.states()  # confirm=false → destroy refused
+
+
+def test_cli_handles_output_error(capsys):
+    """get manager before apply prints 'error: ...', not a traceback."""
+    from triton_kubernetes_tpu.cli.main import main
+    from triton_kubernetes_tpu.backends.memory import MemoryBackend
+
+    be = MemoryBackend()
+    doc = be.state("m-geterr")
+    doc.set_backend_config(be.executor_backend_config("m-geterr"))
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "m-geterr",
+                     "host": "10.0.0.1"})
+    be.persist(doc)
+    rc = main(["--set", "cluster_manager=m-geterr", "--non-interactive",
+               "get", "manager"], backend=be)
+    assert rc == 1
+    assert "error:" in capsys.readouterr().err
+
+
+def test_interactive_default_preserves_type():
+    """Accepting a list/dict default returns the object, not its repr."""
+    from triton_kubernetes_tpu.config import Config, InputResolver
+    from triton_kubernetes_tpu.config.prompts import ScriptedPrompter
+
+    r = InputResolver(Config(env={}), ScriptedPrompter([""]), False)
+    v = r.value("nets", "Networks", default=["pub-net"])
+    assert v == ["pub-net"] and isinstance(v, list)
+
+
+def test_executor_state_store_roundtrips_via_location(tmp_path):
+    """Executor state uses the backend store's own location descriptor —
+    document and applied state land in the same bucket tree."""
+    from triton_kubernetes_tpu.backends import ObjectStoreBackend
+    from triton_kubernetes_tpu.backends.objectstore import DirObjectStore
+    from triton_kubernetes_tpu.executor import LocalExecutor
+
+    bucket = tmp_path / "bucket"
+    be = ObjectStoreBackend(DirObjectStore(bucket))
+    cfg = be.executor_backend_config("m1")
+    assert cfg["objectstore"]["kind"] == "dir"
+    assert cfg["objectstore"]["bucket"] == str(bucket.absolute())
+    doc = be.state("m1")
+    doc.set_backend_config(cfg)
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "m1",
+                     "host": "10.0.0.1"})
+    ex = LocalExecutor()
+    ex.apply(doc)
+    be.persist(doc)
+    # tfstate is inside the bucket, not a cwd-relative dir.
+    assert (bucket / "triton-kubernetes-tpu" / "m1" / "terraform.tfstate").is_file()
+    out = ex.output(doc, "cluster-manager")
+    assert out["manager_url"].startswith("https://")
+
+
+def test_terraform_workdir_exports_module_outputs(tmp_path):
+    """The rendered main.tf.json re-exports registered modules' outputs at
+    root so `terraform output -json` can serve output()."""
+    from triton_kubernetes_tpu.executor.terraform import TerraformExecutor
+    from triton_kubernetes_tpu.state import StateDocument
+
+    doc = StateDocument("m")
+    doc.set_manager({"source": "modules/bare-metal-manager", "name": "m",
+                     "host": "10.0.0.1"})
+    prepared = TerraformExecutor._with_output_exports(doc)
+    val = prepared.get("output.cluster-manager__manager_url.value")
+    assert val == "${module.cluster-manager.manager_url}"
+    # Original doc untouched.
+    assert doc.get("output") is None

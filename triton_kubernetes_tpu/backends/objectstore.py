@@ -49,6 +49,13 @@ class ObjectStore(abc.ABC):
     def list(self, prefix: str) -> List[str]: ...
 
 
+    def location(self) -> Dict[str, Any]:
+        """Serializable descriptor from which ``store_from_location`` can
+        reconstruct an equivalent store on another process/machine."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not describe its location")
+
+
 class DirObjectStore(ObjectStore):
     """Filesystem emulation of a versioned bucket (tests / local provider).
 
@@ -57,6 +64,10 @@ class DirObjectStore(ObjectStore):
 
     def __init__(self, root: str | Path):
         self.root = Path(os.path.expanduser(str(root)))
+
+    def location(self) -> Dict[str, Any]:
+        # Absolute so executor-state reads don't depend on the cwd.
+        return {"kind": "dir", "bucket": str(self.root.absolute())}
 
     def _paths(self, key: str) -> Tuple[Path, Path]:
         p = self.root / key
@@ -101,6 +112,21 @@ class DirObjectStore(ObjectStore):
                 if rel.startswith(prefix):
                     out.append(rel)
         return sorted(out)
+
+
+# kind -> constructor from a location dict. Real cloud stores (GCS/S3)
+# register here; the executor reconstructs stores via store_from_location.
+STORE_KINDS: Dict[str, Any] = {
+    "dir": lambda loc: DirObjectStore(loc["bucket"]),
+}
+
+
+def store_from_location(loc: Dict[str, Any]) -> ObjectStore:
+    kind = loc.get("kind", "dir")
+    if kind not in STORE_KINDS:
+        raise KeyError(
+            f"unknown object-store kind {kind!r}; know {sorted(STORE_KINDS)}")
+    return STORE_KINDS[kind](loc)
 
 
 class ObjectStoreBackend(Backend):
@@ -149,10 +175,12 @@ class ObjectStoreBackend(Backend):
 
     def executor_backend_config(self, name: str) -> Dict[str, Any]:
         """Executor state lives remotely too (reference: terraform.backend.manta,
-        backend/manta/backend.go:196-205)."""
-        return {
-            "objectstore": {
-                "bucket": self.bucket_hint,
-                "path": f"{PREFIX}/{name}/terraform.tfstate",
-            }
-        }
+        backend/manta/backend.go:196-205). The location block embeds the
+        store's own descriptor so the executor reconstructs the *same* store
+        (not a local directory named after the bucket)."""
+        try:
+            loc = dict(self.store.location())
+        except NotImplementedError:
+            loc = {"kind": "dir", "bucket": self.bucket_hint}
+        loc["path"] = f"{PREFIX}/{name}/terraform.tfstate"
+        return {"objectstore": loc}

@@ -9,7 +9,7 @@ cmd/destroy.go:70, cmd/get.go:62):
     version
 
 Global flags: ``--config <yaml>`` (silent-install file), ``--non-interactive``,
-``--set k=v`` (highest-precedence override), ``--backend-provider local|objectstore``.
+``--set k=v`` (highest-precedence override, e.g. ``--set backend_provider=local``).
 """
 
 from __future__ import annotations
@@ -22,15 +22,19 @@ from typing import List, Optional
 from .. import __version__
 from ..backends import Backend, LocalBackend, ObjectStoreBackend
 from ..backends.objectstore import DirObjectStore
+from ..backends.base import StateLockedError, StateNotFoundError
 from ..config import (
     Config,
     InputResolver,
     InteractivePrompter,
     MissingInputError,
-    ScriptedPrompter,
     ValidationError,
 )
+from ..config.config import parse_scalar
 from ..executor import LocalExecutor
+from ..executor.engine import ApplyError, OutputError
+from ..executor.terraform import TerraformNotFoundError
+from ..modules.base import ModuleError
 from ..state import ClusterKeyError
 from ..workflows import (
     WorkflowContext,
@@ -116,7 +120,9 @@ def main(argv: Optional[List[str]] = None,
         if not sep:
             print(f"error: --set expects KEY=VALUE, got {item!r}", file=sys.stderr)
             return 2
-        config.set(key, value)
+        # Same scalar coercion as YAML/env values, so --set confirm=false
+        # really is False (a raw "false" string would be truthy).
+        config.set(key, parse_scalar(value))
 
     if prompter is None:
         prompter = InteractivePrompter()
@@ -142,7 +148,9 @@ def main(argv: Optional[List[str]] = None,
             outputs = {"manager": get_manager, "cluster": get_cluster}[args.kind](ctx)
             print(json.dumps(outputs, indent=2, sort_keys=True))
     except (WorkflowError, MissingInputError, ValidationError,
-            ClusterKeyError, EOFError) as e:
+            ClusterKeyError, ApplyError, OutputError, ModuleError,
+            StateLockedError, StateNotFoundError, TerraformNotFoundError,
+            EOFError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     except KeyboardInterrupt:

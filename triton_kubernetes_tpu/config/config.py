@@ -23,7 +23,7 @@ from typing import Any, Dict, Optional
 DEFAULT_CONFIG_PATH = "~/.triton-kubernetes-tpu.yaml"
 
 
-def _parse_scalar(s: str) -> Any:
+def parse_scalar(s: str) -> Any:
     s = s.strip()
     if s in ("true", "True"):
         return True
@@ -64,11 +64,11 @@ def _mini_yaml(text: str) -> Dict[str, Any]:
             stripped = stripped[2:]
             if stripped:
                 k, _, v = stripped.partition(":")
-                current_item[k.strip()] = _parse_scalar(v)
+                current_item[k.strip()] = parse_scalar(v)
             continue
         if current_item is not None and indent > list_indent:
             k, _, v = stripped.partition(":")
-            current_item[k.strip()] = _parse_scalar(v)
+            current_item[k.strip()] = parse_scalar(v)
             continue
         current_item = None
         current_list = None
@@ -79,7 +79,7 @@ def _mini_yaml(text: str) -> Dict[str, Any]:
             current_list = []
             root[k.strip()] = current_list
         else:
-            root[k.strip()] = _parse_scalar(v)
+            root[k.strip()] = parse_scalar(v)
     return root
 
 
@@ -130,7 +130,7 @@ class Config:
         if key in self._file_values:
             return self._file_values[key]
         if self._env_key(key) in self._env:
-            return _parse_scalar(self._env[self._env_key(key)])
+            return parse_scalar(self._env[self._env_key(key)])
         return default
 
     def to_dict(self) -> Dict[str, Any]:

@@ -39,8 +39,13 @@ class InputResolver:
             if default is not None:
                 return default
             raise self._missing(key)
-        return self.prompter.input(label or key, default=(
-            str(default) if default is not None else None), validate=validate)
+        shown = str(default) if default is not None else None
+        v = self.prompter.input(label or key, default=shown, validate=validate)
+        if default is not None and v == shown:
+            # Default accepted: return the original object, not its repr
+            # (list/dict defaults must match the non-interactive path).
+            return default
+        return v
 
     def choose(self, key: str, label: str,
                options: Sequence[Tuple[str, Any]],

@@ -86,12 +86,12 @@ def load_executor_state(doc: StateDocument) -> ExecutorState:
                 return ExecutorState.from_dict(json.load(f))
         return ExecutorState()
     if "objectstore" in loc:
-        # Executor state lives in the same (emulated) bucket as the document —
-        # keyed by bucket, so two buckets never share applied state, and a
-        # second machine pointed at the bucket sees the same record.
-        from ..backends.objectstore import DirObjectStore
+        # Executor state lives in the same bucket as the document; the
+        # location block is the store's own descriptor (kind + params), so a
+        # second machine pointed at the bucket reconstructs the same store.
+        from ..backends.objectstore import store_from_location
 
-        store = DirObjectStore(loc["objectstore"]["bucket"])
+        store = store_from_location(loc["objectstore"])
         try:
             data, _ = store.get(loc["objectstore"]["path"])
         except KeyError:
@@ -107,9 +107,9 @@ def save_executor_state(doc: StateDocument, est: ExecutorState) -> None:
         _MEMORY_STATES[loc["memory"]["name"]] = copy.deepcopy(est.to_dict())
         return
     if "objectstore" in loc:
-        from ..backends.objectstore import DirObjectStore
+        from ..backends.objectstore import store_from_location
 
-        store = DirObjectStore(loc["objectstore"]["bucket"])
+        store = store_from_location(loc["objectstore"])
         store.put(loc["objectstore"]["path"],
                   json.dumps(est.to_dict(), indent=2, sort_keys=True).encode())
         return
@@ -130,9 +130,9 @@ def delete_executor_state(doc: StateDocument) -> None:
     elif "local" in loc and os.path.isfile(loc["local"]["path"]):
         os.unlink(loc["local"]["path"])
     elif "objectstore" in loc:
-        from ..backends.objectstore import DirObjectStore
+        from ..backends.objectstore import store_from_location
 
-        DirObjectStore(loc["objectstore"]["bucket"]).delete(
+        store_from_location(loc["objectstore"]).delete(
             loc["objectstore"]["path"])
 
 

@@ -51,8 +51,9 @@ class TerraformExecutor:
 
     def _workdir(self, doc: StateDocument) -> tempfile.TemporaryDirectory:
         td = tempfile.TemporaryDirectory(prefix="tk-tpu-tf-")
+        prepared = self._with_output_exports(doc)
         with open(os.path.join(td.name, "main.tf.json"), "wb") as f:
-            f.write(doc.to_bytes())
+            f.write(prepared.to_bytes())
         if self.plugin_dir and os.path.isdir(self.plugin_dir):
             # Side-loaded pinned plugins (reference: installThirdPartyProviders,
             # shell/run_terraform.go:21-61, terraform-provider-rke SHA256-pinned).
@@ -108,3 +109,23 @@ class TerraformExecutor:
         for name in output_names:
             doc.set(f"output.{module_key}__{name}.value",
                     f"${{module.{module_key}.{name}}}")
+
+    @classmethod
+    def _with_output_exports(cls, doc: StateDocument) -> StateDocument:
+        """Copy of the doc with every registered module's declared OUTPUTS
+        re-exported at root. Applied automatically on each run so output()
+        always finds its '<key>__' blocks; modules whose source isn't in the
+        registry (raw HCL module URLs) are skipped — callers wanting their
+        outputs use add_output_exports explicitly."""
+        from ..modules import get_module
+
+        prepared = doc.copy()
+        for key in list(prepared.module_keys()):
+            source = (prepared.get(f"module.{key}") or {}).get("source", "")
+            try:
+                module = get_module(source)
+            except Exception:
+                continue
+            if module.OUTPUTS:
+                cls.add_output_exports(prepared, key, module.OUTPUTS)
+        return prepared

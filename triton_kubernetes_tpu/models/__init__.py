@@ -1,0 +1,27 @@
+"""Bundled workload model families.
+
+The reference provisions clusters but ships no workload beyond a guestbook
+example (SURVEY.md §2.3); BASELINE.md makes a MaxText-class trainer the
+acceptance test for the provisioned TPU slices, so this package carries the
+model zoo: the Llama-3 dense family and the Mixtral MoE family, written as
+pure-JAX pytree models with logical-axis annotations consumed by
+``triton_kubernetes_tpu.parallel``.
+"""
+
+from .config import (
+    CONFIGS,
+    ModelConfig,
+    get_config,
+)
+from .llama import forward, init_params, logical_axes
+from . import mixtral
+
+__all__ = [
+    "CONFIGS",
+    "ModelConfig",
+    "get_config",
+    "forward",
+    "init_params",
+    "logical_axes",
+    "mixtral",
+]

@@ -1,0 +1,125 @@
+"""Model configuration registry.
+
+Real-family shapes (llama3-8b/70b, mixtral-8x7b) match the published
+architectures — they are the BASELINE.md gate workloads. The ``*-test``
+configs are mesh-divisible miniatures for the 8-device CPU test mesh, and
+``llama3-bench`` is sized to train comfortably in one v5e chip's 16 GB HBM
+for ``bench.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    embed_dim: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    mlp_dim: int
+    max_seq_len: int
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    # MoE (num_experts == 0 → dense SwiGLU MLP)
+    num_experts: int = 0
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    # Numerics / compile shape
+    dtype: str = "bfloat16"  # activation/compute dtype
+    param_dtype: str = "float32"  # master weights
+    remat: bool = True  # checkpoint each block: trade FLOPs for HBM
+    scan_layers: bool = True  # lax.scan over the layer stack
+
+    @property
+    def activation_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    @property
+    def weight_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.param_dtype)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def num_params(self) -> int:
+        """Total parameter count (all experts counted)."""
+        d, v = self.embed_dim, self.vocab_size
+        attn = d * self.head_dim * (
+            self.num_heads * 2 + self.num_kv_heads * 2)
+        if self.is_moe:
+            mlp = self.num_experts * 3 * d * self.mlp_dim + d * self.num_experts
+        else:
+            mlp = 3 * d * self.mlp_dim
+        norms = 2 * d
+        per_layer = attn + mlp + norms
+        return v * d * 2 + self.num_layers * per_layer + d
+
+    def active_params(self) -> int:
+        """Params touched per token (MoE: only selected experts)."""
+        if not self.is_moe:
+            return self.num_params()
+        d = self.embed_dim
+        inactive = (self.num_experts - self.num_selected) * 3 * d * self.mlp_dim
+        return self.num_params() - self.num_layers * inactive
+
+
+CONFIGS: Dict[str, ModelConfig] = {}
+
+
+def _register(cfg: ModelConfig) -> ModelConfig:
+    CONFIGS[cfg.name] = cfg
+    return cfg
+
+
+# ---- Llama-3 dense family (BASELINE configs 3 & 4) ----
+LLAMA3_8B = _register(ModelConfig(
+    name="llama3-8b", vocab_size=128_256, embed_dim=4096, num_layers=32,
+    num_heads=32, num_kv_heads=8, head_dim=128, mlp_dim=14_336,
+    max_seq_len=8192))
+
+LLAMA3_70B = _register(ModelConfig(
+    name="llama3-70b", vocab_size=128_256, embed_dim=8192, num_layers=80,
+    num_heads=64, num_kv_heads=8, head_dim=128, mlp_dim=28_672,
+    max_seq_len=8192))
+
+# ---- Mixtral MoE family (BASELINE config 5) ----
+MIXTRAL_8X7B = _register(ModelConfig(
+    name="mixtral-8x7b", vocab_size=32_000, embed_dim=4096, num_layers=32,
+    num_heads=32, num_kv_heads=8, head_dim=128, mlp_dim=14_336,
+    max_seq_len=32_768, rope_theta=1_000_000.0,
+    num_experts=8, num_selected=2))
+
+# ---- single-chip bench config (~420M params, fits v5e 16 GB with Adam) ----
+LLAMA3_BENCH = _register(ModelConfig(
+    name="llama3-bench", vocab_size=32_768, embed_dim=1024, num_layers=24,
+    num_heads=16, num_kv_heads=8, head_dim=64, mlp_dim=4096,
+    max_seq_len=2048))
+
+# ---- CPU-mesh test miniatures (dims divisible by 2-way tp/sp/fsdp) ----
+LLAMA_TEST = _register(ModelConfig(
+    name="llama-test", vocab_size=256, embed_dim=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+    max_seq_len=128, dtype="float32", remat=False))
+
+MIXTRAL_TEST = _register(ModelConfig(
+    name="mixtral-test", vocab_size=256, embed_dim=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+    max_seq_len=128, num_experts=4, num_selected=2,
+    dtype="float32", remat=False))
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown model {name!r}; know {sorted(CONFIGS)}")
+    cfg = CONFIGS[name]
+    return replace(cfg, **overrides) if overrides else cfg

@@ -1,0 +1,200 @@
+"""Llama-3-family decoder: pure-JAX pytree model, TPU-first.
+
+Design choices (vs. a torch-style nn.Module translation):
+- Params are a plain dict pytree; every leaf has a logical-axis tuple
+  (``logical_axes``) the parallel layer maps to mesh shardings. One model
+  definition serves dp/fsdp/tp/sp/ep — parallelism is data layout, not code.
+- The layer stack is a single stacked tensor per weight ([L, ...]) consumed
+  by ``lax.scan``: O(1) trace/compile time in depth, which is what keeps
+  70B-class compiles tractable.
+- ``jax.checkpoint`` on the block body (config.remat) rematerializes
+  activations in backward — the standard HBM-for-FLOPs trade on TPU.
+- Master weights live in f32; compute casts to bf16 at use so matmuls hit
+  the MXU at full rate; softmax/norm reductions stay f32.
+- MoE (num_experts > 0) swaps the dense SwiGLU for the GShard-style
+  expert layer in ``ops/moe.py`` (Mixtral family).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import causal_attention
+from ..ops.moe import moe_layer
+from ..ops.norms import rms_norm
+from ..ops.rotary import apply_rotary, rotary_tables
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+# attention_fn(q, k, v, positions) -> out; positions is [B, S] int32 global.
+AttentionFn = Callable[
+    [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def _dense_attention(q, k, v, positions):
+    return causal_attention(q, k, v, positions, positions)
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    """Normal(0.02) init; residual-out projections scaled by 1/sqrt(2L)."""
+    wd = config.weight_dtype
+    d, dh = config.embed_dim, config.head_dim
+    h, hkv = config.num_heads, config.num_kv_heads
+    ll, f, v = config.num_layers, config.mlp_dim, config.vocab_size
+    std, out_std = 0.02, 0.02 / (2 * ll) ** 0.5
+    keys = iter(jax.random.split(key, 16))
+
+    def norm(shape):
+        return jnp.ones(shape, dtype=wd)
+
+    def rnd(shape, s=std):
+        return (jax.random.normal(next(keys), shape, dtype=jnp.float32) * s
+                ).astype(wd)
+
+    layers: Params = {
+        "attn_norm": norm((ll, d)),
+        "wq": rnd((ll, d, h, dh)),
+        "wk": rnd((ll, d, hkv, dh)),
+        "wv": rnd((ll, d, hkv, dh)),
+        "wo": rnd((ll, h, dh, d), out_std),
+        "mlp_norm": norm((ll, d)),
+    }
+    if config.is_moe:
+        e = config.num_experts
+        layers.update({
+            "router": rnd((ll, d, e)),
+            "moe_w1": rnd((ll, e, d, f)),
+            "moe_w3": rnd((ll, e, d, f)),
+            "moe_w2": rnd((ll, e, f, d), out_std),
+        })
+    else:
+        layers.update({
+            "w1": rnd((ll, d, f)),
+            "w3": rnd((ll, d, f)),
+            "w2": rnd((ll, f, d), out_std),
+        })
+    return {
+        "embed": rnd((v, d)),
+        "layers": layers,
+        "final_norm": norm((d,)),
+        "lm_head": rnd((d, v)),
+    }
+
+
+def logical_axes(config: ModelConfig) -> Params:
+    """Same structure as init_params, leaves = logical-axis tuples."""
+    layers: Params = {
+        "attn_norm": ("layers", "norm"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "norm"),
+    }
+    if config.is_moe:
+        layers.update({
+            "router": ("layers", "embed", None),
+            "moe_w1": ("layers", "expert", "embed", "mlp"),
+            "moe_w3": ("layers", "expert", "embed", "mlp"),
+            "moe_w2": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        layers.update({
+            "w1": ("layers", "embed", "mlp"),
+            "w3": ("layers", "embed", "mlp"),
+            "w2": ("layers", "mlp", "embed"),
+        })
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def _block(
+    x: jnp.ndarray,  # [B, S, D] activation dtype
+    layer: Params,  # one layer's weights (no leading L dim)
+    config: ModelConfig,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,
+    attention_fn: AttentionFn,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    ad = config.activation_dtype
+
+    def w(name):
+        return layer[name].astype(ad)
+
+    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, w("wq"))
+    k = jnp.einsum("bsd,dhk->bshk", h, w("wk"))
+    v = jnp.einsum("bsd,dhk->bshk", h, w("wv"))
+    q = apply_rotary(q, cos, sin, positions)
+    k = apply_rotary(k, cos, sin, positions)
+    attn = attention_fn(q, k, v, positions)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, w("wo"))
+
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    if config.is_moe:
+        moe_params = {
+            "router": layer["router"],
+            "w1": w("moe_w1"), "w3": w("moe_w3"), "w2": w("moe_w2"),
+        }
+        y, aux = moe_layer(
+            h, moe_params, config.num_selected, config.capacity_factor)
+    else:
+        gate = jax.nn.silu(
+            jnp.einsum("bsd,df->bsf", h, w("w3")).astype(jnp.float32)
+        ).astype(ad)
+        up = jnp.einsum("bsd,df->bsf", h, w("w1"))
+        y = jnp.einsum("bsf,fd->bsd", gate * up, w("w2"))
+        aux = jnp.zeros((), dtype=jnp.float32)
+    return x + y, aux
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32
+    config: ModelConfig,
+    attention_fn: Optional[AttentionFn] = None,
+    positions: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (logits [B, S, V] f32, moe aux loss scalar)."""
+    attention_fn = attention_fn or _dense_attention
+    b, s = tokens.shape
+    ad = config.activation_dtype
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32), (b, s))
+    cos, sin = rotary_tables(
+        config.head_dim, config.max_seq_len, config.rope_theta)
+
+    x = params["embed"].astype(ad)[tokens]
+
+    def body(carry, layer):
+        out, aux = _block(
+            carry, layer, config, cos, sin, positions, attention_fn)
+        return out, aux
+
+    if config.remat:
+        body = jax.checkpoint(body)
+    if config.scan_layers:
+        x, auxs = lax.scan(body, x, params["layers"])
+        aux_total = auxs.sum()
+    else:
+        aux_total = jnp.zeros((), dtype=jnp.float32)
+        for i in range(config.num_layers):
+            layer_i = jax.tree.map(lambda p: p[i], params["layers"])
+            x, aux = body(x, layer_i)
+            aux_total = aux_total + aux
+
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(ad),
+        preferred_element_type=jnp.float32)
+    return logits, aux_total

@@ -1,0 +1,20 @@
+"""Mixtral MoE family — the expert-parallel gate workload (BASELINE config 5).
+
+Architecturally this is the Llama decoder with the dense SwiGLU swapped for
+the top-2-routed expert layer; the implementation therefore *is*
+``models.llama`` with an MoE config (num_experts > 0), re-exported here so
+the family has a stable import path. Expert weights carry the "expert"
+logical axis → the ``expert`` mesh axis; the router all-to-all is XLA's
+lowering of the dispatch/combine einsums in ``ops/moe.py``.
+"""
+
+from __future__ import annotations
+
+from .config import ModelConfig, get_config
+from .llama import forward, init_params, logical_axes
+
+__all__ = ["forward", "init_params", "logical_axes", "config_8x7b", "ModelConfig"]
+
+
+def config_8x7b(**overrides) -> ModelConfig:
+    return get_config("mixtral-8x7b", **overrides)

@@ -1,0 +1,24 @@
+"""TPU-first neural-net ops for the bundled workloads.
+
+Everything here is jit-traceable pure JAX (static shapes, ``lax`` control
+flow) so XLA can fuse elementwise work into the MXU matmuls; the
+sequence-parallel path (``ring_attention``) is a ``shard_map`` program whose
+KV rotation lowers to ``ppermute`` neighbor exchanges on the ICI ring.
+"""
+
+from .norms import rms_norm
+from .rotary import apply_rotary, rotary_tables
+from .attention import causal_attention
+from .ring_attention import make_ring_attention, ring_attention_inner
+from .moe import moe_layer, top_k_router
+
+__all__ = [
+    "rms_norm",
+    "apply_rotary",
+    "rotary_tables",
+    "causal_attention",
+    "make_ring_attention",
+    "ring_attention_inner",
+    "moe_layer",
+    "top_k_router",
+]

@@ -1,0 +1,50 @@
+"""Dense causal attention with grouped-query (GQA) support.
+
+Pure einsum formulation: on TPU, XLA lowers the two einsums onto the MXU and
+fuses the mask/softmax between them, which is already near-roofline for
+moderate sequence lengths; ``ops/flash_attention.py`` provides the Pallas
+blockwise kernel for long sequences. Softmax runs in f32 (bf16 logits
+overflow/underflow long before that matters on the MXU inputs).
+
+Positions are explicit so the same code serves the sequence-parallel path
+(``ring_attention`` calls this per KV block with shifted key positions).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
+
+
+def causal_attention(
+    q: jnp.ndarray,  # [B, Sq, Hq, D]
+    k: jnp.ndarray,  # [B, Sk, Hkv, D]
+    v: jnp.ndarray,  # [B, Sk, Hkv, D]
+    q_positions: Optional[jnp.ndarray] = None,  # [B, Sq] int32
+    k_positions: Optional[jnp.ndarray] = None,  # [B, Sk] int32
+) -> jnp.ndarray:
+    """Returns [B, Sq, Hq, D]. Token i attends to keys with pos <= pos_i."""
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
+    if k_positions is None:
+        k_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
+
+    qg = q.reshape(b, sq, hkv, group, d)
+    scale = d ** -0.5
+    # [B, Hkv, G, Sq, Sk]
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * scale
+    mask = (q_positions[:, None, None, :, None]
+            >= k_positions[:, None, None, None, :])
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
+    return out.reshape(b, sq, hq, d)

@@ -1,0 +1,88 @@
+"""Mixture-of-Experts layer (Mixtral-style top-k routing, GShard dispatch).
+
+Expert parallelism is expressed the TPU way: expert-indexed weight tensors
+``[E, ...]`` sharded over the ``expert`` mesh axis, with dispatch/combine as
+einsums against one-hot capacity tensors. Under ``jit`` + NamedSharding, XLA
+lowers those einsums to the router all-to-all over ICI (BASELINE config 5's
+Mixtral-8x7B expert-parallel gate) — no hand-written collective needed.
+
+Capacity-based routing (tokens beyond an expert's slot budget are dropped and
+pass through the residual connection) keeps every shape static for XLA, which
+is the whole game on TPU: dynamic per-expert token counts would force
+recompilation or host round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top_k_router(
+    x: jnp.ndarray,  # [T, D]
+    router_w: jnp.ndarray,  # [D, E]
+    num_selected: int,
+    capacity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (dispatch [T,E,C] bool-ish f32, combine [T,E,C] f32, aux_loss).
+
+    Slot assignment is priority-ordered: every token's first choice is
+    seated before any token's second choice, matching GShard semantics.
+    """
+    t, _ = x.shape
+    e = router_w.shape[1]
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = lax.top_k(probs, num_selected)  # [T, K]
+    top_p = top_p / top_p.sum(axis=-1, keepdims=True)
+
+    # Load-balancing aux loss (Switch/Mixtral): E * <frac routed> . <mean prob>
+    first_choice = jax.nn.one_hot(top_i[:, 0], e, dtype=jnp.float32)
+    frac_routed = first_choice.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux_loss = e * jnp.sum(frac_routed * mean_prob)
+
+    dispatch = jnp.zeros((t, e, capacity), dtype=jnp.float32)
+    combine = jnp.zeros((t, e, capacity), dtype=jnp.float32)
+    counts = jnp.zeros((e,), dtype=jnp.int32)
+    for j in range(num_selected):
+        mask_j = jax.nn.one_hot(top_i[:, j], e, dtype=jnp.int32)  # [T, E]
+        pos = jnp.cumsum(mask_j, axis=0) - 1 + counts[None, :]  # slot index
+        counts = counts + mask_j.sum(axis=0)
+        # mask_j is exactly one-hot per token, so this picks the position at
+        # the chosen expert; one_hot of an index >= capacity is the zero row,
+        # which is precisely the "token dropped" semantics.
+        slot_idx = (pos * mask_j).sum(axis=-1)  # [T]
+        slot = jax.nn.one_hot(slot_idx, capacity, dtype=jnp.float32)  # [T, C]
+        d_j = mask_j.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * top_p[:, j][:, None, None]
+    return dispatch, combine, aux_loss
+
+
+def moe_layer(
+    x: jnp.ndarray,  # [B, S, D]
+    params: Dict[str, jnp.ndarray],  # router [D,E], w1/w3 [E,D,F], w2 [E,F,D]
+    num_selected: int = 2,
+    capacity_factor: float = 1.25,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """SwiGLU experts; returns (y [B,S,D], aux_loss scalar)."""
+    b, s, d = x.shape
+    e = params["router"].shape[1]
+    t = b * s
+    capacity = max(1, int(capacity_factor * num_selected * t / e))
+    x2 = x.reshape(t, d)
+    dispatch, combine, aux = top_k_router(
+        x2, params["router"], num_selected, capacity)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x2.astype(jnp.float32))
+    expert_in = expert_in.astype(x.dtype)
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w1"])
+    g = jnp.einsum("ecd,edf->ecf", expert_in, params["w3"])
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * h
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    y2 = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+    return y2.reshape(b, s, d).astype(x.dtype), aux

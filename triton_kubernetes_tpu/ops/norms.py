@@ -1,0 +1,16 @@
+"""RMSNorm — computed in f32 regardless of input dtype (bf16 activations
+lose too much precision in the variance reduction), cast back on the way out.
+XLA fuses this into neighboring ops; no custom kernel needed."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,126 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context path (BASELINE stretch workloads; no reference analog —
+SURVEY.md §5 records the reference has no sequence scaling at all). Each
+device on the ``seq`` mesh axis holds a contiguous sequence shard of Q, K, V.
+K/V blocks rotate around the ring via ``lax.ppermute`` (neighbor exchange on
+the ICI torus — the cheapest collective TPUs have) while every device
+accumulates its queries' attention over each visiting block with the online
+(flash) softmax merge, in f32. After ``n_shards`` steps every Q block has
+seen every KV block exactly once: the result is bitwise-equivalent math to
+dense causal attention, with per-device memory O(S/n) instead of O(S).
+
+Communication-compute overlap note: the ppermute is issued as part of the
+scan body, so XLA's latency-hiding scheduler can overlap the next block's
+transfer with the current block's matmuls.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import NEG_INF
+
+
+def _block_flash(
+    q: jnp.ndarray,  # [B, Sq, Hq, D]
+    k: jnp.ndarray,  # [B, Sk, Hkv, D]
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,  # [Sq]
+    k_pos: jnp.ndarray,  # [Sk]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One KV block's contribution: (block_max, block_sumexp, block_out).
+
+    block_max/sumexp: [B, Hkv, G, Sq] f32; block_out: [B, Sq, Hkv, G, D] f32
+    (unnormalized, scaled by exp(logits - block_max))."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+    logits = logits * (d ** -0.5)
+    mask = q_pos[None, None, None, :, None] >= k_pos[None, None, None, None, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+    m = logits.max(axis=-1)  # [B, Hkv, G, Sq]
+    p = jnp.exp(logits - m[..., None])
+    # Zero fully-masked rows (m == NEG_INF would give exp(0)=1 per entry).
+    p = jnp.where(mask, p, 0.0)
+    s = p.sum(axis=-1)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return m, s, o
+
+
+def ring_attention_inner(
+    q: jnp.ndarray,  # [B, S_loc, Hq, D] — local shard
+    k: jnp.ndarray,  # [B, S_loc, Hkv, D]
+    v: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Body to run inside shard_map; ``axis_name`` is the sequence axis."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_loc, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    q_pos = idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+
+    m0 = jnp.full((b, hkv, g, s_loc), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, s_loc), dtype=jnp.float32)
+    o0 = jnp.zeros((b, s_loc, hkv, g, d), dtype=jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, o = carry
+        # After t rotations, device idx holds the block born on idx - t.
+        src = (idx - t) % n
+        k_pos = src * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+        bm, bs, bo = _block_flash(q, k_blk, v_blk, q_pos, k_pos)
+        new_m = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - new_m)
+        beta = jnp.exp(bm - new_m)
+        l = l * alpha + bs * beta
+        # [B, Sq, Hkv, G, 1] scaling of the f32 accumulator
+        o = o * jnp.moveaxis(alpha, 3, 1)[..., None] \
+            + bo * jnp.moveaxis(beta, 3, 1)[..., None]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, new_m, l, o), None
+
+    (k_f, v_f, m, l, o), _ = lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n, dtype=jnp.int32))
+    del k_f, v_f
+    out = o / jnp.moveaxis(l, 3, 1)[..., None]
+    return out.reshape(b, s_loc, hq, d).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+):
+    """Returns attention(q, k, v) -> out, shard_mapped over the full mesh.
+
+    q/k/v layout: [batch over ``batch_axes``, seq over ``seq_axis``, heads
+    over ``head_axis``, head_dim replicated]. Everything except the ring
+    exchange is embarrassingly parallel across the other axes.
+    """
+    spec = P(batch_axes, seq_axis, head_axis, None)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ring_attention_inner(q, k, v, seq_axis)
+
+    return attn

@@ -1,0 +1,43 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Tables are precomputed outside the scanned layer stack (they are shared by
+every layer) and passed in, so the per-layer trace stays small. Positions are
+explicit — required for sequence parallelism, where each shard's tokens start
+at a nonzero global offset.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rotary_tables(
+    head_dim: int,
+    max_positions: int,
+    theta: float = 500_000.0,
+    dtype: jnp.dtype = jnp.float32,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(cos, sin) tables of shape [max_positions, head_dim//2]."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = jnp.arange(max_positions, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(
+    x: jnp.ndarray,  # [B, S, H, D]
+    cos: jnp.ndarray,  # [max_pos, D//2]
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S] int32 global positions
+) -> jnp.ndarray:
+    """Rotate pairs (x[..., :D/2], x[..., D/2:]) — the "split-half" RoPE
+    convention (matches Llama reference weights after permutation)."""
+    c = cos[positions][:, :, None, :]  # [B, S, 1, D//2]
+    s = sin[positions][:, :, None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate([xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,44 @@
+"""Device-mesh and sharding layer for the bundled TPU workloads.
+
+The reference has no parallelism runtime at all (SURVEY.md §2.5: zero
+NCCL/MPI/tensor code in gadkins/triton-kubernetes); its only "fan-out" is
+creating N identical VMs (create/node.go:266-323). The TPU-native equivalent
+this package provides is the standard JAX SPMD stack: a named
+``jax.sharding.Mesh`` over the slice's ICI torus, logical-axis→mesh-axis
+rules, and ``NamedSharding`` helpers that the bundled models/trainer use to
+lay out params and activations so collectives ride ICI.
+"""
+
+from .mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_STAGE,
+    AXIS_TENSOR,
+    MESH_AXES,
+    MeshConfig,
+    create_mesh,
+)
+from .sharding import (
+    DEFAULT_RULES,
+    logical_sharding,
+    logical_to_spec,
+    shard_pytree,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_EXPERT",
+    "AXIS_FSDP",
+    "AXIS_SEQ",
+    "AXIS_STAGE",
+    "AXIS_TENSOR",
+    "MESH_AXES",
+    "MeshConfig",
+    "create_mesh",
+    "DEFAULT_RULES",
+    "logical_to_spec",
+    "logical_sharding",
+    "shard_pytree",
+]

@@ -1,0 +1,145 @@
+"""Named device meshes over TPU slices.
+
+Axis vocabulary (a superset of MaxText's, minus host-offload axes):
+
+- ``data``   — pure data parallelism (params replicated). Rides DCN across
+  slices; lowest-bandwidth axis, so it is the *outermost* mesh dim.
+- ``stage``  — pipeline-parallel stage axis (DCN- or ICI-mapped).
+- ``fsdp``   — fully-sharded data parallelism: batch AND params sharded.
+- ``seq``    — sequence/context parallelism (ring attention).
+- ``expert`` — expert parallelism for MoE layers.
+- ``tensor`` — tensor (Megatron-style) parallelism; highest-bandwidth axis,
+  innermost so it maps onto the tightest ICI ring.
+
+Unused axes just have size 1 — shardings that name them become no-ops, so a
+single model definition serves every parallelism configuration.
+
+The reference tool has no analog of any of this (SURVEY.md §2.5); the mesh is
+the TPU-native replacement for what a GPU stack would assemble out of
+NCCL process groups.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_STAGE = "stage"
+AXIS_FSDP = "fsdp"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+AXIS_TENSOR = "tensor"
+
+# Outermost (lowest bandwidth, DCN-friendly) → innermost (tightest ICI ring).
+MESH_AXES: Tuple[str, ...] = (
+    AXIS_DATA, AXIS_STAGE, AXIS_FSDP, AXIS_SEQ, AXIS_EXPERT, AXIS_TENSOR)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Requested parallelism degrees. ``-1`` on at most one axis means
+    "absorb all remaining devices" (mirrors MaxText's convention)."""
+
+    data: int = 1
+    stage: int = 1
+    fsdp: int = -1
+    seq: int = 1
+    expert: int = 1
+    tensor: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {
+            AXIS_DATA: self.data,
+            AXIS_STAGE: self.stage,
+            AXIS_FSDP: self.fsdp,
+            AXIS_SEQ: self.seq,
+            AXIS_EXPERT: self.expert,
+            AXIS_TENSOR: self.tensor,
+        }
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        """Fill in the -1 axis and validate the product against n_devices."""
+        sizes = self.sizes()
+        wildcard = [a for a, s in sizes.items() if s == -1]
+        if len(wildcard) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wildcard}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcard:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"fixed axes product {fixed} does not divide {n_devices} devices")
+            sizes[wildcard[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices but {n_devices} are available")
+        for axis, s in sizes.items():
+            if s < 1:
+                raise ValueError(f"axis {axis!r} resolved to {s}")
+        return sizes
+
+
+def create_mesh(
+    config: MeshConfig | None = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 6-axis named Mesh over ``devices`` (default: all).
+
+    Uses ``mesh_utils.create_device_mesh`` when possible so the axis order
+    maps onto the physical ICI torus (innermost axis = nearest neighbors);
+    falls back to a plain reshape for virtual/CPU device sets.
+    """
+    config = config or MeshConfig()
+    devs = list(devices) if devices is not None else list(jax.devices())
+    sizes = config.resolve(len(devs))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(shape, devices=np.asarray(devs))
+    except Exception:
+        arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def batch_shard_axes() -> Tuple[str, ...]:
+    """Mesh axes over which the global batch dimension is split."""
+    return (AXIS_DATA, AXIS_FSDP)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1) if hasattr(mesh.shape, "get") else dict(
+        zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+
+@dataclass(frozen=True)
+class ParallelismPlan:
+    """A resolved plan: mesh config + the knobs the trainer needs to know
+    about (whether ring attention is on, how many microbatches for PP)."""
+
+    mesh_config: MeshConfig = field(default_factory=MeshConfig)
+    ring_attention: bool = False  # shard sequence via ops.ring_attention
+    microbatches: int = 1  # pipeline microbatches (>=stage count when stage>1)
+
+    def validate(self, n_devices: int) -> Dict[str, int]:
+        sizes = self.mesh_config.resolve(n_devices)
+        if sizes[AXIS_SEQ] > 1 and not self.ring_attention:
+            raise ValueError(
+                "seq axis >1 requires ring_attention=True (dense attention "
+                "cannot shard the sequence dimension)")
+        if sizes[AXIS_STAGE] > 1 and self.microbatches % sizes[AXIS_STAGE] != 0:
+            raise ValueError(
+                f"microbatches ({self.microbatches}) must be a multiple of "
+                f"pipeline stages ({sizes[AXIS_STAGE]})")
+        return sizes
+
+
+def describe_mesh(mesh: Mesh) -> str:
+    parts = [f"{a}={s}" for a, s in zip(mesh.axis_names, mesh.devices.shape)
+             if s > 1]
+    return "mesh(" + (", ".join(parts) or "single-device") + ")"

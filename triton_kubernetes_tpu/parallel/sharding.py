@@ -1,0 +1,126 @@
+"""Logical-axis → mesh-axis rules and NamedSharding helpers.
+
+Models annotate every parameter/activation with *logical* axis names
+("embed", "heads", "mlp", …); this module maps those onto the physical mesh
+axes via a rules table (the flax ``logical_axis_rules`` idea, implemented
+standalone so models stay pure-JAX pytrees).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_STAGE,
+    AXIS_TENSOR,
+)
+
+MeshAxes = Union[str, Tuple[str, ...], None]
+
+# The default layout. Key facts baked in:
+# - batch splits over (data, fsdp): FSDP shards both params and batch.
+# - params' embed dim shards over fsdp  → all-gathered per layer during the
+#   forward pass (XLA inserts the collectives), classic FSDP/ZeRO-3.
+# - heads/mlp/vocab shard over tensor   → Megatron-style TP, innermost ICI.
+# - activations' sequence dim shards over seq → ring attention.
+# - MoE expert dim shards over expert.
+DEFAULT_RULES: Dict[str, MeshAxes] = {
+    "batch": (AXIS_DATA, AXIS_FSDP),
+    "sequence": AXIS_SEQ,
+    "embed": AXIS_FSDP,
+    "heads": AXIS_TENSOR,
+    "kv_heads": AXIS_TENSOR,
+    "head_dim": None,
+    "mlp": AXIS_TENSOR,
+    "vocab": AXIS_TENSOR,
+    "expert": AXIS_EXPERT,
+    "layers": None,  # scanned layer dim stays replicated
+    "stage": AXIS_STAGE,
+    "norm": None,
+}
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, MeshAxes]] = None,
+    mesh: Optional[Mesh] = None,
+) -> PartitionSpec:
+    """Translate ("embed", "mlp") → PartitionSpec("fsdp", "tensor").
+
+    Mesh axes already used by an earlier dim are dropped (a mesh axis may
+    appear at most once in a PartitionSpec); axes absent from ``mesh`` are
+    also dropped so the same rules work on sub-meshes.
+    """
+    rules = DEFAULT_RULES if rules is None else rules
+    available = set(mesh.axis_names) if mesh is not None else None
+    used: set = set()
+    entries = []
+    for ax in logical_axes:
+        if ax is None:
+            entries.append(None)
+            continue
+        if ax not in rules:
+            raise KeyError(f"no sharding rule for logical axis {ax!r}")
+        mapped = rules[ax]
+        if mapped is None:
+            entries.append(None)
+            continue
+        axes = (mapped,) if isinstance(mapped, str) else tuple(mapped)
+        keep = tuple(
+            a for a in axes
+            if a not in used and (available is None or a in available))
+        used.update(keep)
+        if not keep:
+            entries.append(None)
+        elif len(keep) == 1:
+            entries.append(keep[0])
+        else:
+            entries.append(keep)
+    # Trim trailing Nones for readability; PartitionSpec pads implicitly.
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def logical_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, MeshAxes]] = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules, mesh=mesh))
+
+
+def spec_tree_from_logical(
+    logical_tree: Any,
+    rules: Optional[Dict[str, MeshAxes]] = None,
+    mesh: Optional[Mesh] = None,
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: logical_to_spec(axes, rules, mesh=mesh),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(a, (str, type(None))) for a in x),
+    )
+
+
+def shard_pytree(
+    tree: Any,
+    logical_tree: Any,
+    mesh: Mesh,
+    rules: Optional[Dict[str, MeshAxes]] = None,
+) -> Any:
+    """Device-put a pytree of arrays according to its logical-axis pytree."""
+    specs = spec_tree_from_logical(logical_tree, rules, mesh=mesh)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        tree,
+        specs,
+    )

@@ -1,0 +1,20 @@
+"""Training runtime for the bundled workloads (MaxText-equivalent slice).
+
+The reference ships no workload runtime (SURVEY.md §2.5); BASELINE.md's
+acceptance gates are training jobs on the provisioned slices, so this
+package provides the trainer those jobs run: sharded train step, MFU
+accounting, data pipeline, and orbax checkpointing.
+"""
+
+from .mfu import flops_per_token, mfu, tokens_per_sec_for_mfu
+from .trainer import TrainState, make_optimizer, make_train_step, init_state
+
+__all__ = [
+    "flops_per_token",
+    "mfu",
+    "tokens_per_sec_for_mfu",
+    "TrainState",
+    "make_optimizer",
+    "make_train_step",
+    "init_state",
+]

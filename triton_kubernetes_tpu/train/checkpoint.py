@@ -1,0 +1,47 @@
+"""Orbax checkpointing for train state.
+
+The reference's only checkpoint/backup story is Heptio Ark over the whole
+cluster (SURVEY.md §5) and the provisioning doc itself; workload-level
+checkpoint/resume is new here. Orbax writes sharded arrays directly from
+device memory (each host saves its shards — no gather), which is the only
+viable path at 70B-class sizes, and restores into an abstract target tree
+carrying the desired shardings.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True)
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """``state_like``: concrete or abstract (jax.eval_shape output whose
+        leaves carry shardings) tree matching what was saved."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

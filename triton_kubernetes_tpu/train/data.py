@@ -1,0 +1,78 @@
+"""Data pipeline for the bundled trainer.
+
+Two sources, both yielding ``{"tokens": [B, S+1] int32}`` host batches that
+the trainer shards over (data, fsdp):
+
+- ``synthetic_batches`` — deterministic structured sequences (an order-2
+  Markov walk over the vocab). Structured rather than uniform noise so
+  "loss decreases" is a meaningful test/bench signal: a real model can
+  learn the transition table, uniform noise it cannot.
+- ``PackedDataset`` — zero-copy np.memmap over a flat binary token file
+  (the MaxText-style pretokenized format): fixed-length windows, no Python
+  per-token work, so host input never gates the device step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator
+
+import numpy as np
+
+
+def synthetic_batches(
+    vocab_size: int,
+    batch_size: int,
+    seq_len: int,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Order-2 Markov sequences: next = (a*prev + b*prev2 + noise) % V."""
+    rng = np.random.default_rng(seed)
+    a, b = 31, 17
+    while True:
+        out = np.empty((batch_size, seq_len + 1), dtype=np.int32)
+        prev = rng.integers(0, vocab_size, size=batch_size)
+        prev2 = rng.integers(0, vocab_size, size=batch_size)
+        for t in range(seq_len + 1):
+            noise = rng.integers(0, 4, size=batch_size)
+            cur = (a * prev + b * prev2 + noise) % vocab_size
+            out[:, t] = cur
+            prev2, prev = prev, cur
+        yield {"tokens": out}
+
+
+class PackedDataset:
+    """Flat binary token file (little-endian int32 or uint16) → windows."""
+
+    def __init__(self, path: str, seq_len: int, dtype: str = "int32"):
+        self.seq_len = seq_len
+        self.tokens = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+        if len(self.tokens) < seq_len + 1:
+            raise ValueError(
+                f"{path}: {len(self.tokens)} tokens < window {seq_len + 1}")
+
+    def __len__(self) -> int:
+        return (len(self.tokens) - 1) // self.seq_len
+
+    def batches(
+        self, batch_size: int, seed: int = 0, shuffle: bool = True,
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        n = len(self)
+        rng = np.random.default_rng(seed)
+        while True:
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            for i in range(0, n - batch_size + 1, batch_size):
+                idx = order[i:i + batch_size]
+                rows = [
+                    np.asarray(
+                        self.tokens[j * self.seq_len:
+                                    j * self.seq_len + self.seq_len + 1],
+                        dtype=np.int32)
+                    for j in idx
+                ]
+                yield {"tokens": np.stack(rows)}
+
+
+def write_packed(path: str, tokens: np.ndarray, dtype: str = "int32") -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.asarray(tokens, dtype=np.dtype(dtype)).tofile(path)

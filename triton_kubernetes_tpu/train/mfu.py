@@ -1,0 +1,48 @@
+"""Model-FLOPs-utilization accounting.
+
+Convention: the PaLM-appendix formula — a training step costs
+``6 * N_active`` matmul FLOPs per token (fwd + bwd) plus attention's
+``12 * L * H * d_head * S`` per token, halved for causal masking. Peak
+figures come from ``topology.slices.TPU_GENERATIONS`` (public bf16 specs),
+so the BASELINE "≥40% MFU on v5p" gate is computed against the same table
+the provisioner uses to label node pools.
+"""
+
+from __future__ import annotations
+
+from ..models.config import ModelConfig
+from ..topology.slices import SliceSpec
+
+
+def flops_per_token(config: ModelConfig, seq_len: int, causal: bool = True) -> float:
+    """Training (fwd+bwd) FLOPs per token."""
+    matmul = 6.0 * config.active_params()
+    attn = (12.0 * config.num_layers * config.num_heads
+            * config.head_dim * seq_len)
+    if causal:
+        attn *= 0.5
+    return matmul + attn
+
+
+def mfu(
+    tokens_per_sec: float,
+    config: ModelConfig,
+    seq_len: int,
+    peak_tflops_total: float,
+) -> float:
+    """Fraction of peak achieved, e.g. 0.4 == the BASELINE v5p gate."""
+    achieved = tokens_per_sec * flops_per_token(config, seq_len)
+    return achieved / (peak_tflops_total * 1e12)
+
+
+def mfu_on_slice(
+    tokens_per_sec: float, config: ModelConfig, seq_len: int, spec: SliceSpec,
+) -> float:
+    return mfu(tokens_per_sec, config, seq_len, spec.peak_bf16_tflops)
+
+
+def tokens_per_sec_for_mfu(
+    target_mfu: float, config: ModelConfig, seq_len: int, peak_tflops_total: float,
+) -> float:
+    """Inverse: the throughput a slice must sustain to hit ``target_mfu``."""
+    return target_mfu * peak_tflops_total * 1e12 / flops_per_token(config, seq_len)

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 from typing import List
 
-from ..config import MissingInputError
 from .common import WorkflowContext, WorkflowError, select_manager
 from .manager import _validate_name
 from .node import add_nodes_for_label

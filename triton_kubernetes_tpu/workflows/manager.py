@@ -9,8 +9,6 @@ from __future__ import annotations
 
 import re
 
-from ..config import MissingInputError
-from ..state import StateDocument
 from .common import WorkflowContext, WorkflowError
 from .providers import MANAGER_PROVIDERS
 

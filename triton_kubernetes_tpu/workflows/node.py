@@ -47,7 +47,7 @@ def add_nodes_for_label(ctx: WorkflowContext, state: StateDocument,
     with _scoped_overrides(ctx, overrides):
         if provider == "gcp-tpu":
             pool_name = r.value("hostname", "TPU Pool Name", default="pool0")
-            key = node_fn(ctx, state, cluster_key, str(pool_name), "worker")
+            node_fn(ctx, state, cluster_key, str(pool_name), "worker")
             return [str(pool_name)]
         host_label = r.choose("rancher_host_label", "Host Role",
                               [(l, l) for l in HOST_LABEL_CHOICES],

@@ -10,7 +10,7 @@ whole slice node pool (nodes-per-slice is derived, never asked).
 
 from __future__ import annotations
 
-from ...state import StateDocument
+from ...state import StateDocument, parse_cluster_key
 from ...topology import TPU_GENERATIONS, SliceSpec, default_topology, parse_accelerator
 from ..common import WorkflowContext, module_source
 from .gcp import REGIONS, _creds
@@ -70,7 +70,7 @@ def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
                        default=default_topology(gen, chips))
     # Validate the pair early — fail at prompt time, not apply time.
     SliceSpec.from_accelerator(str(accelerator), str(topology) or None)
-    _, cluster_name = cluster_key.split("_", 2)[1:]
+    _, cluster_name = parse_cluster_key(cluster_key)
     cfg = {
         "source": module_source(ctx, "gcp-tpu-nodepool"),
         "pool_name": pool_name,
