@@ -1,0 +1,30 @@
+# Build/test entry points (reference Makefile analog: build, test, package).
+GIT_SHA := $(shell git rev-parse --short HEAD 2>/dev/null || echo dev)
+PY := python
+
+.PHONY: test test-fast build native bench clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow" -x
+
+# Wheel + sdist with the git SHA stamped into `version` output
+# (the reference's -ldflags -X cmd.cliVersion analog, Makefile:2 there).
+build:
+	sed -i.bak 's/^GIT_SHA = .*/GIT_SHA = "$(GIT_SHA)"/' triton_kubernetes_tpu/cli/main.py
+	$(PY) -m pip wheel --no-deps --no-build-isolation -w dist . \
+	  || { mv triton_kubernetes_tpu/cli/main.py.bak triton_kubernetes_tpu/cli/main.py; exit 1; }
+	mv triton_kubernetes_tpu/cli/main.py.bak triton_kubernetes_tpu/cli/main.py
+
+# Native data-pipeline extension (optional; trainer falls back to pure
+# Python when the shared library is absent).
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -rf dist build *.egg-info native/*.so

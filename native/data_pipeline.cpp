@@ -1,0 +1,199 @@
+// Host-side token data pipeline for the TPU trainer.
+//
+// The hot path of input feeding — shard indexing, epoch shuffling, and
+// batch assembly with background prefetch — runs natively so the Python
+// trainer loop never blocks on data between steps (the framework's
+// native-runtime component; the compute path stays JAX/XLA).
+//
+// Data format: a directory of *.bin shards, each a raw little-endian int32
+// token stream. A "sequence" is seq_len+1 consecutive tokens (inputs +
+// shifted targets); sequences never straddle shard boundaries.
+//
+// Determinism contract (mirrored exactly by the pure-Python fallback in
+// triton_kubernetes_tpu/train/data.py): per-epoch order is a Fisher-Yates
+// shuffle of the global sequence index driven by xorshift64*, seeded with
+// (seed ^ epoch * 0x9e3779b97f4a7c15). Keep both implementations in sync.
+//
+// C ABI (ctypes):
+//   void* dp_open(const char* dir, int batch, int seq_len, uint64_t seed);
+//   long  dp_num_sequences(void* h);
+//   int   dp_next(void* h, int32_t* out);   // fills batch*(seq_len+1); returns epoch
+//   void  dp_close(void* h);
+//   const char* dp_error();                 // last open error, thread-local
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <filesystem>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace fs = std::filesystem;
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Shard {
+  std::vector<int32_t> tokens;
+};
+
+static inline uint64_t xorshift64star(uint64_t& s) {
+  s ^= s >> 12;
+  s ^= s << 25;
+  s ^= s >> 27;
+  return s * 0x2545F4914F6CDD1DULL;
+}
+
+struct Pipeline {
+  int batch = 0;
+  int seq_plus1 = 0;
+  uint64_t seed = 0;
+
+  std::vector<Shard> shards;
+  // Global sequence index: (shard, offset) pairs, flattened.
+  std::vector<std::pair<uint32_t, uint32_t>> index;
+
+  // Prefetch ring.
+  std::deque<std::pair<std::vector<int32_t>, int>> ring;  // (batch, epoch)
+  size_t ring_depth = 4;
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+
+  // Producer-side cursor.
+  std::vector<uint32_t> order;
+  size_t cursor = 0;
+  int epoch = 0;
+
+  void reshuffle() {
+    order.resize(index.size());
+    for (uint32_t i = 0; i < order.size(); ++i) order[i] = i;
+    uint64_t s = seed ^ (static_cast<uint64_t>(epoch) * 0x9e3779b97f4a7c15ULL);
+    if (s == 0) s = 0x9e3779b97f4a7c15ULL;
+    // Fisher-Yates, high-to-low, j = rand % (i+1).
+    for (size_t i = order.size(); i-- > 1;) {
+      uint64_t r = xorshift64star(s);
+      size_t j = static_cast<size_t>(r % (i + 1));
+      std::swap(order[i], order[j]);
+    }
+    cursor = 0;
+  }
+
+  void produce_loop() {
+    const size_t batch_elems = static_cast<size_t>(batch) * seq_plus1;
+    while (!stop.load()) {
+      std::vector<int32_t> out(batch_elems);
+      int batch_epoch;
+      {
+        // Assemble one batch from the deterministic cursor.
+        batch_epoch = epoch;
+        for (int b = 0; b < batch; ++b) {
+          if (cursor >= order.size()) {
+            ++epoch;
+            reshuffle();
+            // A batch spanning an epoch boundary is tagged with the epoch
+            // it started in.
+          }
+          auto [shard_i, off] = index[order[cursor++]];
+          const auto& toks = shards[shard_i].tokens;
+          std::memcpy(out.data() + static_cast<size_t>(b) * seq_plus1,
+                      toks.data() + off, sizeof(int32_t) * seq_plus1);
+        }
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_full.wait(lk, [&] { return ring.size() < ring_depth || stop.load(); });
+      if (stop.load()) return;
+      ring.emplace_back(std::move(out), batch_epoch);
+      cv_empty.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* dp_error() { return g_error.c_str(); }
+
+void* dp_open(const char* dir, int batch, int seq_len, uint64_t seed) {
+  g_error.clear();
+  if (batch <= 0 || seq_len <= 0) {
+    g_error = "batch and seq_len must be positive";
+    return nullptr;
+  }
+  auto p = new Pipeline();
+  p->batch = batch;
+  p->seq_plus1 = seq_len + 1;
+  p->seed = seed;
+
+  std::vector<fs::path> files;
+  std::error_code ec;
+  for (auto& e : fs::directory_iterator(dir, ec)) {
+    if (e.path().extension() == ".bin") files.push_back(e.path());
+  }
+  if (ec) {
+    g_error = "cannot read directory: " + std::string(dir);
+    delete p;
+    return nullptr;
+  }
+  std::sort(files.begin(), files.end());  // shard order is lexicographic
+
+  for (auto& f : files) {
+    std::ifstream in(f, std::ios::binary | std::ios::ate);
+    if (!in) continue;
+    auto bytes = static_cast<size_t>(in.tellg());
+    in.seekg(0);
+    Shard sh;
+    sh.tokens.resize(bytes / sizeof(int32_t));
+    in.read(reinterpret_cast<char*>(sh.tokens.data()),
+            static_cast<std::streamsize>(sh.tokens.size() * sizeof(int32_t)));
+    uint32_t shard_i = static_cast<uint32_t>(p->shards.size());
+    uint32_t n_seq = static_cast<uint32_t>(sh.tokens.size() / p->seq_plus1);
+    for (uint32_t k = 0; k < n_seq; ++k)
+      p->index.emplace_back(shard_i, k * p->seq_plus1);
+    p->shards.push_back(std::move(sh));
+  }
+  if (p->index.empty()) {
+    g_error = "no sequences found (need *.bin shards each >= (seq_len+1)*4 bytes)";
+    delete p;
+    return nullptr;
+  }
+  p->reshuffle();
+  p->worker = std::thread([p] { p->produce_loop(); });
+  return p;
+}
+
+long dp_num_sequences(void* h) {
+  return static_cast<long>(static_cast<Pipeline*>(h)->index.size());
+}
+
+int dp_next(void* h, int32_t* out) {
+  auto* p = static_cast<Pipeline*>(h);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_empty.wait(lk, [&] { return !p->ring.empty(); });
+  auto [buf, ep] = std::move(p->ring.front());
+  p->ring.pop_front();
+  p->cv_full.notify_one();
+  lk.unlock();
+  std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
+  return ep;
+}
+
+void dp_close(void* h) {
+  auto* p = static_cast<Pipeline*>(h);
+  p->stop.store(true);
+  p->cv_full.notify_all();
+  p->cv_empty.notify_all();
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
+}
+
+}  // extern "C"

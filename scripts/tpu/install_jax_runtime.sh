@@ -1,0 +1,39 @@
+#!/bin/sh
+# Pinned JAX/libtpu runtime installer for TPU VM hosts.
+#
+# Reference analog: scripts/docker/17.03.sh — the version-pinned,
+# multi-distro engine installer every provisioned VM curls at first boot.
+# Here the "engine" is the jax[tpu] runtime; GKE node pools use the
+# container image (images/jax-tpu-runtime.yaml) instead, so this script only
+# serves the bare TPU-VM path.
+#
+# Usage: sh install_jax_runtime.sh [jax_version]
+set -eu
+
+JAX_VERSION="${1:-0.6.2}"
+PYTHON="${PYTHON:-python3}"
+
+echo "==> checking python"
+command -v "$PYTHON" >/dev/null 2>&1 || {
+    echo "error: $PYTHON not found; install python >= 3.11 first" >&2
+    exit 1
+}
+"$PYTHON" - <<'EOF'
+import sys
+assert sys.version_info >= (3, 11), f"python >= 3.11 required, have {sys.version}"
+EOF
+
+echo "==> installing jax[tpu]==$JAX_VERSION"
+"$PYTHON" -m pip install --upgrade pip
+"$PYTHON" -m pip install "jax[tpu]==$JAX_VERSION" \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+echo "==> verifying device enumeration"
+"$PYTHON" - <<'EOF'
+import jax
+devices = jax.devices()
+assert devices and devices[0].platform == "tpu", f"no TPU devices: {devices}"
+print(f"ok: {len(devices)} TPU device(s): {devices[0].device_kind}")
+EOF
+
+echo "==> done"

@@ -1,0 +1,61 @@
+"""Every silent-install example must drive a non-interactive create end to end
+(the reference ships equivalent YAMLs under examples/silent-install; here they
+are executable against the in-process executor, so they can never rot)."""
+
+import json
+import os
+
+import pytest
+
+from triton_kubernetes_tpu.cli.main import main
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "silent-install")
+
+
+@pytest.fixture()
+def run(tmp_path):
+    """CLI runner pinned to an isolated local backend, fake GCP creds, and a
+    generated SSH key (the triton key-id fingerprint derivation needs one)."""
+    creds = tmp_path / "sa.json"
+    creds.write_text(json.dumps({"project_id": "example-project"}))
+
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    key = ed25519.Ed25519PrivateKey.generate()
+    key_path = tmp_path / "id_test"
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.OpenSSH,
+        serialization.NoEncryption()))
+
+    def _run(config_rel, verb, extra=()):
+        argv = ["--non-interactive",
+                "--config", os.path.join(EXAMPLES, config_rel),
+                "--set", f"backend_root={tmp_path / 'backend'}",
+                "--set", f"gcp_path_to_credentials={creds}",
+                "--set", f"triton_key_path={key_path}",
+                *extra, "create", verb]
+        return main(argv)
+    return _run
+
+
+def test_bare_metal_pair(run):
+    assert run("bare-metal/manager-bare-metal.yaml", "manager") == 0
+    assert run("bare-metal/cluster-bare-metal.yaml", "cluster") == 0
+
+
+def test_triton_pair(run):
+    assert run("triton/manager-on-triton.yaml", "manager") == 0
+    assert run("triton/cluster-triton-ha.yaml", "cluster") == 0
+
+
+def test_gcp_pair(run):
+    assert run("gcp/manager-on-gcp.yaml", "manager") == 0
+    assert run("gcp/cluster-gcp-ha.yaml", "cluster") == 0
+
+
+def test_gcp_tpu_slices(run):
+    assert run("gcp/manager-on-gcp.yaml", "manager") == 0
+    assert run("gcp-tpu/cluster-tpu-v5p-64.yaml", "cluster") == 0
+    assert run("gcp-tpu/cluster-tpu-v5e-8.yaml", "cluster") == 0

@@ -1,0 +1,54 @@
+"""Pallas flash attention vs the einsum reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.ops.attention import causal_attention
+from triton_kubernetes_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(b, sq, sk, hq, hkv, d, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, sq, hq, d), dtype)
+    k = jax.random.normal(ks[1], (b, sk, hkv, d), dtype)
+    v = jax.random.normal(ks[2], (b, sk, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 1)])
+def test_matches_einsum_reference(hq, hkv):
+    q, k, v = _qkv(2, 128, 128, hq, hkv, 64)
+    got = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    want = causal_attention(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_non_divisible_seq_is_padded():
+    q, k, v = _qkv(1, 100, 100, 2, 2, 32)
+    got = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    want = causal_attention(q, k, v)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_gradients_match_reference():
+    q, k, v = _qkv(1, 64, 64, 2, 2, 32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, 32, 32, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_bad_gqa_ratio_rejected():
+    q, k, v = _qkv(1, 64, 64, 3, 2, 32)
+    with pytest.raises(ValueError, match="not a multiple"):
+        flash_attention(q, k, v, interpret=True)

@@ -1,0 +1,60 @@
+"""Image build pipeline (packer/packer-config analog): !include, variable
+substitution, validation, Dockerfile rendering — incl. the two shipped
+templates under images/."""
+
+import os
+
+import pytest
+
+from triton_kubernetes_tpu.images import (
+    ImageConfigError,
+    load_template,
+    render_dockerfile,
+)
+
+IMAGES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "images")
+
+
+def test_shipped_templates_load_and_render():
+    for name in ("jax-tpu-runtime.yaml", "tpu-health-probe.yaml"):
+        cfg = load_template(os.path.join(IMAGES, name))
+        assert cfg["image"].startswith("tk8s/")
+        # variables substituted: no moustaches survive anywhere
+        df = render_dockerfile(cfg)
+        assert "{{" not in df
+        assert df.startswith("FROM python:")
+
+
+def test_include_and_substitution(tmp_path):
+    (tmp_path / "vars.yaml").write_text("ver: '9.9'\n")
+    (tmp_path / "t.yaml").write_text(
+        "image: x/y\nvariables: !include vars.yaml\n"
+        "base: 'img:{{ver}}'\npip: ['pkg=={{ver}}']\n")
+    cfg = load_template(str(tmp_path / "t.yaml"))
+    assert cfg["base"] == "img:9.9"
+    assert cfg["pip"] == ["pkg==9.9"]
+
+
+def test_missing_include_errors(tmp_path):
+    (tmp_path / "t.yaml").write_text(
+        "image: x\nvariables: !include nope.yaml\nbase: b\n")
+    with pytest.raises(ImageConfigError, match="not found"):
+        load_template(str(tmp_path / "t.yaml"))
+
+
+def test_missing_required_key_errors(tmp_path):
+    (tmp_path / "t.yaml").write_text("image: x\n")
+    with pytest.raises(ImageConfigError, match="base"):
+        load_template(str(tmp_path / "t.yaml"))
+
+
+def test_dockerfile_sections(tmp_path):
+    (tmp_path / "t.yaml").write_text(
+        "image: x\nbase: b\npackages: [curl]\npip: [jax]\n"
+        "env: {A: '1'}\nentrypoint: [run, me]\n")
+    df = render_dockerfile(load_template(str(tmp_path / "t.yaml")))
+    assert "apt-get install -y --no-install-recommends curl" in df
+    assert "pip install --no-cache-dir 'jax'" in df
+    assert "ENV A=1" in df
+    assert 'ENTRYPOINT ["run", "me"]' in df

@@ -1,0 +1,122 @@
+"""Structured logging + span tracing (triton_kubernetes_tpu/utils/logging.py).
+
+The reference has zero observability (SURVEY.md §5); these tests pin the
+rebuild's replacement contract: levels, JSON-lines mode, span timing and
+nesting, and the CLI --json flag end to end.
+"""
+
+import io
+import json
+
+import pytest
+
+from triton_kubernetes_tpu.utils import Logger, configure, get_logger
+
+
+def _lines(buf: io.StringIO):
+    return [ln for ln in buf.getvalue().splitlines() if ln]
+
+
+def test_text_mode_levels_and_filtering():
+    buf = io.StringIO()
+    log = Logger(stream=buf, level="info")
+    log.debug("hidden")
+    log.info("hello")
+    log.warn("careful")
+    log.error("boom")
+    lines = _lines(buf)
+    assert lines == ["hello", "warn: careful", "error: boom"]
+
+
+def test_json_mode_records():
+    buf = io.StringIO()
+    log = Logger(stream=buf, json_mode=True, level="debug")
+    log.info("applying", doc="dev")
+    (rec,) = [json.loads(ln) for ln in _lines(buf)]
+    assert rec["msg"] == "applying"
+    assert rec["level"] == "info"
+    assert rec["doc"] == "dev"
+    assert isinstance(rec["ts"], float)
+
+
+def test_span_timing_and_nesting():
+    buf = io.StringIO()
+    log = Logger(stream=buf, json_mode=True, level="debug")
+    with log.span("apply", doc="dev") as outer:
+        with log.span("module.cluster-manager") as inner:
+            log.info("working")
+    assert inner.duration_s is not None and outer.duration_s >= inner.duration_s
+    recs = [json.loads(ln) for ln in _lines(buf)]
+    working = next(r for r in recs if r["msg"] == "working")
+    assert working["span"] == "apply/module.cluster-manager"
+    ends = [r for r in recs if r["msg"] == "done"]
+    assert len(ends) == 2
+    assert all("duration_s" in r for r in ends)
+
+
+def test_span_failure_logs_error_and_reraises():
+    buf = io.StringIO()
+    log = Logger(stream=buf, json_mode=True)
+    with pytest.raises(ValueError):
+        with log.span("apply"):
+            raise ValueError("kaboom")
+    recs = [json.loads(ln) for ln in _lines(buf)]
+    failed = next(r for r in recs if r["msg"] == "failed")
+    assert failed["level"] == "error"
+    assert "kaboom" in failed["error"]
+    # Stack unwound: a fresh record carries no span.
+    log.info("after")
+    assert "span" not in json.loads(_lines(buf)[-1])
+
+
+def test_configure_swaps_default_logger():
+    buf = io.StringIO()
+    log = configure(stream=buf, json_mode=True)
+    assert get_logger() is log
+    configure()  # restore a plain default for other tests
+    assert get_logger() is not log
+
+
+def test_cli_json_mode_emits_span_records(tmp_path, capsys):
+    from triton_kubernetes_tpu.cli.main import main
+
+    rc = main([
+        "--json", "--log-level", "debug", "--non-interactive",
+        "--set", "backend_provider=local",
+        "--set", f"backend_root={tmp_path}",
+        "--set", "name=obsv",
+        "--set", "manager_cloud_provider=bare-metal",
+        "--set", "host=10.0.0.1",
+        "create", "manager",
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.err
+    recs = [json.loads(ln) for ln in captured.err.splitlines()
+            if ln.startswith("{")]
+    apply_done = [r for r in recs
+                  if r["msg"] == "done" and r.get("span") == "apply"]
+    assert apply_done and "duration_s" in apply_done[0]
+    module_spans = [r for r in recs if "module.cluster-manager" in
+                    str(r.get("span", ""))]
+    assert module_spans, recs
+    configure()  # reset default logger
+
+
+def test_executor_logs_through_default_logger(tmp_path):
+    """LocalExecutor() with no explicit log fn routes through get_logger()."""
+    from triton_kubernetes_tpu.executor import LocalExecutor
+    from triton_kubernetes_tpu.state import StateDocument
+
+    buf = io.StringIO()
+    configure(stream=buf, json_mode=True, level="debug")
+    try:
+        doc = StateDocument("obs-ex")
+        doc.set("terraform.backend",
+                {"local": {"path": str(tmp_path / "tfstate.json")}})
+        ex = LocalExecutor()
+        ex.apply(doc)
+        recs = [json.loads(ln) for ln in _lines(buf)]
+        assert any(r["msg"] == "done" and r.get("span") == "apply"
+                   for r in recs)
+    finally:
+        configure()

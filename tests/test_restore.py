@@ -1,0 +1,124 @@
+"""``restore backup`` workflow + executor restore path.
+
+No reference analog (the reference CLI never restores — SURVEY.md §5); these
+tests pin the new contract: restore requires an applied backup, replays a
+Velero Restore manifest onto the cluster, and errors cleanly otherwise.
+"""
+
+import pytest
+import yaml
+
+from triton_kubernetes_tpu.executor.engine import _MEMORY_STATES, OutputError
+from triton_kubernetes_tpu.workflows import WorkflowError, new_backup, new_cluster, restore_backup
+
+from test_workflows import CLUSTER_HA_SILENT, _create_manager, make_ctx
+
+
+@pytest.fixture(autouse=True)
+def _clean_memory_executor_state():
+    yield
+    _MEMORY_STATES.clear()
+
+
+def _backup_ctx(backend, **extra):
+    return make_ctx({
+        "cluster_manager": "mgr1", "cluster_name": "ha",
+        "backup_cloud_provider": "gcs",
+        "gcp_path_to_credentials": "/tmp/c.json", "gcs_bucket": "bkt",
+        **extra,
+    }, backend=backend)
+
+
+def test_restore_replays_backup():
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    rctx = _backup_ctx(ctx.backend)
+    new_backup(rctx)
+
+    name = restore_backup(_backup_ctx(ctx.backend))
+    assert name == "ha-restore"
+
+    # The restore resource exists and the Restore manifest landed on the
+    # cluster.
+    state = rctx.backend.state("mgr1")
+    cloud = rctx.executor.cloud_view(state)
+    rres = cloud.get_resource("restore", "ha-restore")
+    assert rres is not None and rres["kind"] == "gcs"
+    cluster_id = rctx.executor.output(
+        state, "cluster_bare-metal_ha")["cluster_id"]
+    manifests = cloud.get_manifests(cluster_id, "Restore")
+    assert any(m["metadata"]["name"] == "ha-restore" for m in manifests)
+
+
+def test_restore_without_backup_errors():
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    with pytest.raises(WorkflowError, match="has no backup"):
+        restore_backup(_backup_ctx(ctx.backend))
+
+
+def test_restore_unapplied_backup_errors():
+    """A backup present in the doc but never applied is not restorable."""
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    state = ctx.backend.state("mgr1")
+    state.add_backup("cluster_bare-metal_ha", {
+        "source": "modules/k8s-backup-gcs", "cluster_name": "ha",
+        "cluster_id": "c-1", "gcp_path_to_credentials": "/tmp/c.json",
+        "gcs_bucket": "bkt"})
+    ctx.backend.persist(state)
+    with pytest.raises(OutputError, match="no applied module"):
+        restore_backup(_backup_ctx(ctx.backend))
+
+
+def test_destroy_after_restore_cleans_restore_resource():
+    """The restore's resources are recorded on the backup module, so a
+    targeted destroy of the backup removes them (no orphans)."""
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    new_backup(_backup_ctx(ctx.backend))
+    restore_backup(_backup_ctx(ctx.backend))
+
+    state = ctx.backend.state("mgr1")
+    ex = _backup_ctx(ctx.backend).executor
+    assert ex.cloud_view(state).get_resource("restore", "ha-restore")
+    ex.destroy(state, targets=["backup_cluster_bare-metal_ha"])
+    assert ex.cloud_view(state).get_resource("restore", "ha-restore") is None
+    assert ex.cloud_view(state).get_resource("backup", "ha-backup") is None
+
+
+def test_restore_declined_confirmation_is_noop():
+    ctx = _create_manager()
+    new_cluster(make_ctx(CLUSTER_HA_SILENT, backend=ctx.backend))
+    new_backup(_backup_ctx(ctx.backend))
+    assert restore_backup(_backup_ctx(ctx.backend, confirm=False)) == ""
+
+
+def test_cli_restore_verb(tmp_path, capsys):
+    from triton_kubernetes_tpu.backends import MemoryBackend
+    from triton_kubernetes_tpu.cli.main import main
+    from triton_kubernetes_tpu.executor import LocalExecutor
+
+    be = MemoryBackend()
+    ex = LocalExecutor()
+    assert main([
+        "--non-interactive",
+        "--set", "manager_cloud_provider=bare-metal", "--set", "name=mgr1",
+        "--set", "host=10.0.0.10", "create", "manager",
+    ], backend=be, executor=ex) == 0
+
+    cluster_yaml = tmp_path / "cluster.yaml"
+    cluster_yaml.write_text(yaml.safe_dump(CLUSTER_HA_SILENT))
+    assert main(["--non-interactive", "--config", str(cluster_yaml),
+                 "create", "cluster"], backend=be, executor=ex) == 0
+
+    backup_flags = ["--set", "cluster_manager=mgr1",
+                    "--set", "cluster_name=ha",
+                    "--set", "backup_cloud_provider=gcs",
+                    "--set", "gcp_path_to_credentials=/tmp/c.json",
+                    "--set", "gcs_bucket=bkt"]
+    assert main(["--non-interactive", *backup_flags,
+                 "create", "backup"], backend=be, executor=ex) == 0
+    assert main(["--non-interactive", *backup_flags,
+                 "restore", "backup"], backend=be, executor=ex) == 0
+    assert "restored: ha-restore" in capsys.readouterr().out

@@ -1,0 +1,49 @@
+"""SSH fingerprint derivation (util/ssh_utils.go:13-42 analog)."""
+
+import base64
+import hashlib
+
+import pytest
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ed25519, rsa
+
+from triton_kubernetes_tpu.utils.ssh import (
+    SSHKeyError,
+    public_key_fingerprint_from_private_key,
+)
+
+
+def _expected_fp(private_key) -> str:
+    pub = private_key.public_key().public_bytes(
+        serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
+    digest = hashlib.md5(base64.b64decode(pub.split()[1])).hexdigest()
+    return ":".join(digest[i:i + 2] for i in range(0, 32, 2))
+
+
+@pytest.mark.parametrize("keygen,fmt", [
+    (lambda: ed25519.Ed25519PrivateKey.generate(),
+     serialization.PrivateFormat.OpenSSH),
+    (lambda: rsa.generate_private_key(public_exponent=65537, key_size=2048),
+     serialization.PrivateFormat.TraditionalOpenSSL),
+])
+def test_fingerprint_formats(tmp_path, keygen, fmt):
+    key = keygen()
+    path = tmp_path / "key"
+    path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, fmt, serialization.NoEncryption()))
+    fp = public_key_fingerprint_from_private_key(str(path))
+    assert fp == _expected_fp(key)
+    assert len(fp.split(":")) == 16  # md5: 16 colon-separated byte pairs
+
+
+def test_missing_file_errors(tmp_path):
+    with pytest.raises(SSHKeyError, match="cannot read"):
+        public_key_fingerprint_from_private_key(str(tmp_path / "nope"))
+
+
+def test_garbage_key_errors(tmp_path):
+    path = tmp_path / "garbage"
+    path.write_text("not a key")
+    with pytest.raises(SSHKeyError, match="unsupported"):
+        public_key_fingerprint_from_private_key(str(path))

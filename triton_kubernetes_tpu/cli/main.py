@@ -36,6 +36,7 @@ from ..executor.engine import ApplyError, OutputError
 from ..executor.terraform import TerraformNotFoundError
 from ..modules.base import ModuleError
 from ..state import ClusterKeyError
+from ..utils import configure
 from ..workflows import (
     WorkflowContext,
     WorkflowError,
@@ -48,6 +49,7 @@ from ..workflows import (
     new_cluster,
     new_manager,
     new_node,
+    restore_backup,
 )
 
 GIT_SHA = "dev"  # stamped by packaging (Makefile -ldflags analog, Makefile:2)
@@ -84,6 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fail instead of prompting for missing inputs")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="KEY=VALUE", help="config override (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="structured JSON-lines log output")
+    p.add_argument("--log-level", choices=["debug", "info", "warn", "error"],
+                   default="info", help="log verbosity (default: info)")
 
     sub = p.add_subparsers(dest="command")
 
@@ -95,6 +101,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     get = sub.add_parser("get", help="display resource information")
     get.add_argument("kind", choices=["manager", "cluster"])
+
+    restore = sub.add_parser("restore", help="restore from a backup")
+    restore.add_argument("kind", choices=["backup"])
 
     sub.add_parser("version", help="print version")
     return p
@@ -114,6 +123,8 @@ def main(argv: Optional[List[str]] = None,
         build_parser().print_help()
         return 1
 
+    logger = configure(json_mode=args.json, level=args.log_level)
+
     config = Config(config_file=args.config)
     for item in args.overrides:
         key, sep, value = item.partition("=")
@@ -131,7 +142,7 @@ def main(argv: Optional[List[str]] = None,
     try:
         be = backend if backend is not None else choose_backend(resolver)
         ex = executor if executor is not None else LocalExecutor(
-            log=lambda msg: print(msg))
+            log=logger.info, logger=logger)
         ctx = WorkflowContext(backend=be, executor=ex, resolver=resolver)
 
         if args.command == "create":
@@ -147,11 +158,15 @@ def main(argv: Optional[List[str]] = None,
         elif args.command == "get":
             outputs = {"manager": get_manager, "cluster": get_cluster}[args.kind](ctx)
             print(json.dumps(outputs, indent=2, sort_keys=True))
+        elif args.command == "restore":
+            result = restore_backup(ctx)
+            if result:
+                print(f"restored: {result}")
     except (WorkflowError, MissingInputError, ValidationError,
             ClusterKeyError, ApplyError, OutputError, ModuleError,
             StateLockedError, StateNotFoundError, TerraformNotFoundError,
             EOFError) as e:
-        print(f"error: {e}", file=sys.stderr)
+        logger.error(str(e), kind=type(e).__name__)
         return 1
     except KeyboardInterrupt:
         print("\naborted", file=sys.stderr)

@@ -139,8 +139,12 @@ def delete_executor_state(doc: StateDocument) -> None:
 class LocalExecutor:
     """Drives modules in-process. The default executor everywhere."""
 
-    def __init__(self, log: Optional[Callable[[str], None]] = None):
-        self.log = log or (lambda msg: None)
+    def __init__(self, log: Optional[Callable[[str], None]] = None,
+                 logger=None):
+        from ..utils import get_logger
+
+        self.logger = logger if logger is not None else get_logger()
+        self.log = log or (lambda msg: self.logger.info(msg))
 
     # ------------------------------------------------------------------- plan
     def plan(self, doc: StateDocument, targets: Optional[List[str]] = None) -> Plan:
@@ -190,7 +194,8 @@ class LocalExecutor:
         # before the error stay on record (terraform persists errored applies;
         # dropping the record would orphan real resources behind a real driver).
         try:
-            with tempfile.TemporaryDirectory(prefix="tk-tpu-apply-") as workdir:
+            with self.logger.span("apply", doc=doc.name), \
+                    tempfile.TemporaryDirectory(prefix="tk-tpu-apply-") as workdir:
                 for name in order:
                     action = plan.actions.get(name, PlanAction.NOOP)
                     if action not in (PlanAction.CREATE, PlanAction.UPDATE):
@@ -202,9 +207,10 @@ class LocalExecutor:
                         resolved = resolve(cfg, outputs)
                     except KeyError as e:
                         raise ApplyError(f"module {name!r}: {e}") from e
-                    self.log(f"module.{name}: {action.value} ({module.SOURCE})")
                     ctx = DriverContext(cloud=cloud, workdir=workdir, module_key=name)
-                    mod_outputs, resources = module.apply(resolved, ctx)
+                    with self.logger.span(f"module.{name}", action=action.value,
+                                          source=module.SOURCE):
+                        mod_outputs, resources = module.apply(resolved, ctx)
                     missing = [o for o in module.OUTPUTS if o not in mod_outputs]
                     if missing:
                         raise ApplyError(
@@ -242,7 +248,8 @@ class LocalExecutor:
         # Reverse dependency order: dependents first.
         cfgs = {n: est.modules[n].get("config", {}) for n in est.modules}
         order = [n for n in topo_order(cfgs) if n in names]
-        with tempfile.TemporaryDirectory(prefix="tk-tpu-destroy-") as workdir:
+        with self.logger.span("destroy", doc=doc.name, targets=len(order)), \
+                tempfile.TemporaryDirectory(prefix="tk-tpu-destroy-") as workdir:
             for name in reversed(order):
                 self._destroy_one(name, est, cloud, workdir)
         est.cloud = cloud.to_dict()
@@ -268,6 +275,43 @@ class LocalExecutor:
             for rdict in reversed(rec.get("resources", [])):
                 cloud.delete_resource(rdict["type"], rdict["name"])
         del est.modules[name]
+
+    # ---------------------------------------------------------------- restore
+    def restore(self, doc: StateDocument, backup_key: str) -> str:
+        """Replay an applied backup module onto its cluster. No reference
+        analog (the reference CLI never restores, SURVEY.md §5); modeled as an
+        imperative action against applied state, like output() but mutating
+        the cloud."""
+        est = load_executor_state(doc)
+        rec = est.modules.get(backup_key)
+        if rec is None:
+            raise OutputError(f"no applied module {backup_key!r}")
+        module = get_module(rec.get("config", {}).get("source", ""))
+        if not hasattr(module, "restore"):
+            raise ApplyError(
+                f"module {backup_key!r} ({module.SOURCE}) is not restorable")
+        outputs = {n: r.get("outputs", {}) for n, r in est.modules.items()}
+        resolved_rec = dict(rec)
+        try:
+            resolved_rec["config"] = resolve(rec.get("config", {}), outputs)
+        except KeyError as e:
+            raise ApplyError(f"module {backup_key!r}: {e}") from e
+        cloud = CloudSimulator(est.cloud)
+        with self.logger.span("restore", doc=doc.name, backup=backup_key), \
+                tempfile.TemporaryDirectory(prefix="tk-tpu-restore-") as workdir:
+            ctx = DriverContext(cloud=cloud, workdir=workdir,
+                                module_key=backup_key)
+            name, resources = module.restore(resolved_rec, ctx)
+        # Record the restore's resources on the backup module so a targeted
+        # destroy of the backup (or whole-doc destroy) cleans them up too —
+        # unrecorded resources would be orphaned behind a real driver.
+        existing = {(r["type"], r["name"]) for r in rec.get("resources", [])}
+        rec.setdefault("resources", []).extend(
+            r.to_dict() for r in resources
+            if (r.type, r.name) not in existing)
+        est.cloud = cloud.to_dict()
+        save_executor_state(doc, est)
+        return name
 
     # ----------------------------------------------------------------- output
     def output(self, doc: StateDocument, module_key: str) -> Dict[str, Any]:

@@ -1,0 +1,13 @@
+"""Image build pipeline (reference packer/ analog, TPU-era).
+
+The reference bakes VM images from YAML templates converted to packer JSON by
+``packer/packer-config`` (~100-LoC Python with ``!include`` support). The TPU
+rebuild's images are **containers** — the jax/libtpu runtime image that the
+device DaemonSet and workload JobSets run — so the pipeline converts the same
+style of YAML (+ ``!include``) into a container build config and renders a
+Dockerfile.
+"""
+
+from .pipeline import ImageConfigError, load_template, render_dockerfile
+
+__all__ = ["ImageConfigError", "load_template", "render_dockerfile"]

@@ -1,0 +1,116 @@
+"""YAML → container build config converter.
+
+Reference analog: packer/packer-config (yaml→json with ``!include``
+support). Same contract, new target: instead of packer builder JSON this
+emits a dict with ``image``/``base``/``packages``/``pip``/``env``/
+``entrypoint`` and can render it as a Dockerfile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+import yaml
+
+
+class ImageConfigError(ValueError):
+    pass
+
+
+class _IncludeLoader(yaml.SafeLoader):
+    """SafeLoader + ``!include other.yaml`` resolved relative to the
+    including file (packer-config's !include semantics)."""
+
+
+def _include(loader: _IncludeLoader, node: yaml.Node) -> Any:
+    rel = loader.construct_scalar(node)
+    base = os.path.dirname(getattr(loader, "_filename", "."))
+    path = os.path.join(base, rel)
+    if not os.path.isfile(path):
+        raise ImageConfigError(f"!include target not found: {path}")
+    return _load_file(path)
+
+
+_IncludeLoader.add_constructor("!include", _include)
+
+
+def _load_file(path: str) -> Any:
+    with open(path) as f:
+        loader = _IncludeLoader(f)
+        loader._filename = path
+        try:
+            return loader.get_single_data()
+        finally:
+            loader.dispose()
+
+
+_REQUIRED = ("image", "base")
+
+
+def load_template(path: str) -> Dict[str, Any]:
+    """Load + validate one image template. ``variables:`` (possibly included)
+    are substituted into string values as ``{{name}}``."""
+    data = _load_file(path)
+    if not isinstance(data, dict):
+        raise ImageConfigError(f"{path}: template must be a mapping")
+    variables = data.pop("variables", {}) or {}
+    if not isinstance(variables, dict):
+        raise ImageConfigError(f"{path}: variables must be a mapping")
+
+    def subst(v: Any) -> Any:
+        if isinstance(v, str):
+            for k, val in variables.items():
+                v = v.replace("{{%s}}" % k, str(val))
+            return v
+        if isinstance(v, list):
+            return [subst(x) for x in v]
+        if isinstance(v, dict):
+            return {k: subst(x) for k, x in v.items()}
+        return v
+
+    data = subst(data)
+    for key in _REQUIRED:
+        if key not in data:
+            raise ImageConfigError(f"{path}: missing required key {key!r}")
+    data.setdefault("packages", [])
+    data.setdefault("pip", [])
+    data.setdefault("env", {})
+    data.setdefault("entrypoint", [])
+    return data
+
+
+def render_dockerfile(config: Dict[str, Any]) -> str:
+    lines = [f"FROM {config['base']}"]
+    if config["packages"]:
+        pkgs = " ".join(config["packages"])
+        lines.append(
+            "RUN apt-get update && apt-get install -y --no-install-recommends "
+            f"{pkgs} && rm -rf /var/lib/apt/lists/*")
+    if config["pip"]:
+        lines.append("RUN pip install --no-cache-dir " +
+                     " ".join(f"'{p}'" for p in config["pip"]))
+    for k, v in config["env"].items():
+        lines.append(f"ENV {k}={v}")
+    for script in config.get("scripts", []):
+        lines.append(f"COPY {script} /tmp/build/")
+        lines.append(f"RUN sh /tmp/build/{os.path.basename(script)}")
+    if config["entrypoint"]:
+        lines.append("ENTRYPOINT " + json.dumps(config["entrypoint"]))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin script shell
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="convert an image YAML template to build JSON/Dockerfile")
+    p.add_argument("template")
+    p.add_argument("--dockerfile", action="store_true",
+                   help="emit a Dockerfile instead of JSON")
+    args = p.parse_args(argv)
+    cfg = load_template(args.template)
+    print(render_dockerfile(cfg) if args.dockerfile
+          else json.dumps(cfg, indent=2, sort_keys=True))
+    return 0

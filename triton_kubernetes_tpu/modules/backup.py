@@ -46,6 +46,25 @@ class _BackupBase(Module):
         ctx.cloud.create_resource("backup", name, kind=self.KIND, location=loc)
         return {"backup_location": loc}, [Resource("backup", name)]
 
+    def restore(self, record: Dict[str, Any], ctx: DriverContext
+                ) -> Tuple[str, List[Resource]]:
+        """Replay this backup onto its cluster (Velero Restore). Not in the
+        reference — its CLI only creates backups (SURVEY.md §5). Returns the
+        restore name plus the resources created, which the executor appends
+        to this module's applied record so a later destroy cleans them up."""
+        config = record.get("config", {})
+        loc = record.get("outputs", {}).get("backup_location",
+                                            self.location(config))
+        name = f"{config['cluster_name']}-restore"
+        ctx.cloud.apply_manifest(config["cluster_id"], {
+            "apiVersion": "velero.io/v1", "kind": "Restore",
+            "metadata": {"name": name, "namespace": "velero"},
+            "spec": {"backupName": f"{config['cluster_name']}-backup",
+                     "backupStorageLocation": loc},
+        })
+        ctx.cloud.create_resource("restore", name, kind=self.KIND, location=loc)
+        return name, [Resource("restore", name)]
+
 
 @register
 class GcsBackup(_BackupBase):

@@ -1,0 +1,171 @@
+"""Blockwise causal flash attention as a Pallas TPU kernel.
+
+The einsum attention in ``ops/attention.py`` materializes the [Sq, Sk] logits
+in HBM-sized intermediates; fine up to moderate S, but the HBM traffic grows
+O(S^2). This kernel streams K/V blocks through VMEM with the online-softmax
+recurrence (FlashAttention-2 style), keeping the working set at
+O(block_q x block_k) and the accumulator in f32 VMEM scratch:
+
+  grid = (batch, q_head, Sq/bq, Sk/bk), k-block innermost ->
+    s    = q . k^T * scale          (MXU, f32 accumulate)
+    m'   = max(m, rowmax(s));  p = exp(s - m');  c = exp(m - m')
+    l    = l*c + rowsum(p);    acc = acc*c + p . v
+  last k-block: out = acc / l
+
+GQA maps query head h to KV head h // (Hq // Hkv) in the BlockSpec index
+maps, so K/V blocks are fetched once per group without materializing the
+head-repeated K/V (the einsum path pays that broadcast).
+
+Backward: custom VJP that recomputes attention with the einsum formulation
+(standard remat trade — no O(S^2) residuals saved from the forward; the
+recompute is itself fused by XLA). A full flash backward kernel can replace
+it without changing the API.
+
+Causal skip: k-blocks strictly above the diagonal are predicated out with
+``pl.when`` — their FLOPs are never issued, halving compute for long S.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import causal_attention
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, block_q: int, block_k: int,
+                  sk: int, num_k_blocks: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # k-blocks fully above the causal diagonal contribute nothing: the
+    # earliest query row of this q-block is qi*block_q, the first key of the
+    # k-block is ki*block_k.
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # Causal + padding mask (padded keys past sk never contribute).
+        s = jnp.where((q_pos >= k_pos) & (k_pos < sk), s, NEG_INF)
+
+        m_prev = m_ref[:]                          # [bq, 128] lane-replicated
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)          # broadcast -> [bq, 128]
+        p = jnp.exp(s - m_new[:, :1])               # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)              # [bq, 128]
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        # Fully-masked rows (q padding) have l == 0; emit 0, not NaN.
+        l = l_ref[:, :1]
+        o_ref[0] = jnp.where(
+            l > 0, acc_ref[:] / l, 0.0).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool):
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    group = hq // hkv
+    scale = d ** -0.5
+
+    # [B, S, H, D] -> [B*H, S, D]: one flat batch·head grid axis gives
+    # Mosaic a clean (parallel, parallel, arbitrary) pipeline.
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * hq, sq, d)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * hkv, sk, d)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * hkv, sk, d)
+
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
+    sq_p, sk_p = sq + pad_q, sk + pad_k
+    num_k_blocks = sk_p // block_k
+
+    grid = (b * hq, sq_p // block_q, num_k_blocks)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        sk=sk, num_k_blocks=num_k_blocks)
+
+    def kv_index(bh, qi, ki):
+        # bh = b*Hq + h  ->  flat KV row b*Hkv + h//group.
+        return ((bh // hq) * hkv + (bh % hq) // group, ki, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq_p, d), q.dtype),
+        scratch_shapes=[
+            # m/l lane-replicated at 128 to match the f32 VMEM tile.
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    out = out[:, :sq, :].reshape(b, hq, sq, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Causal GQA attention, [B, S, H, D] in/out (ops/attention.py contract,
+    standard positions). ``interpret=True`` runs the kernel in the Pallas
+    interpreter (CPU tests)."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    return _flash_forward(q, k, v, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, block_q, block_k, interpret):
+    return flash_attention(q, k, v, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: causal_attention(q_, k_, v_), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -76,3 +76,178 @@ class PackedDataset:
 def write_packed(path: str, tokens: np.ndarray, dtype: str = "int32") -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     np.asarray(tokens, dtype=np.dtype(dtype)).tofile(path)
+
+
+# --------------------------------------------------------------------------
+# Sharded pipeline: native C++ fast path + exactly-mirrored Python fallback.
+# --------------------------------------------------------------------------
+
+_M64 = (1 << 64) - 1
+_GOLD = 0x9E3779B97F4A7C15
+
+
+def _xorshift64star(state: int):
+    """One step of xorshift64*; returns (new_state, output). MUST stay in
+    lockstep with native/data_pipeline.cpp:xorshift64star."""
+    state ^= state >> 12
+    state ^= (state << 25) & _M64
+    state ^= state >> 27
+    return state, (state * 0x2545F4914F6CDD1D) & _M64
+
+
+def epoch_order(n: int, seed: int, epoch: int) -> np.ndarray:
+    """The deterministic per-epoch sequence order shared by the native and
+    Python pipelines: Fisher-Yates driven by xorshift64* seeded with
+    seed ^ epoch*GOLD (native/data_pipeline.cpp:Pipeline::reshuffle)."""
+    order = list(range(n))
+    s = (seed ^ ((epoch * _GOLD) & _M64)) & _M64
+    if s == 0:
+        s = _GOLD
+    for i in range(n - 1, 0, -1):
+        s, r = _xorshift64star(s)
+        j = r % (i + 1)
+        order[i], order[j] = order[j], order[i]
+    return np.asarray(order, dtype=np.int64)
+
+
+def _find_native_lib() -> str | None:
+    cand = os.environ.get("TK8S_NATIVE_LIB")
+    if cand and os.path.isfile(cand):
+        return cand
+    here = os.path.dirname(os.path.abspath(__file__))
+    cand = os.path.join(here, "..", "..", "native", "libtkdata.so")
+    return cand if os.path.isfile(cand) else None
+
+
+class ShardedTokenPipeline:
+    """Batches from a directory of ``*.bin`` int32 token shards.
+
+    Uses the native C++ pipeline (``native/libtkdata.so``: shard indexing,
+    epoch shuffle, batch assembly, background prefetch) when the library is
+    present; otherwise a pure-Python implementation with bit-identical
+    output. ``native=True`` requires the library, ``native=False`` forces
+    the fallback, ``None`` auto-detects.
+
+    ``next()`` returns ``(tokens[batch, seq_len+1] int32, epoch)`` where
+    epoch is the epoch the batch *started* in.
+    """
+
+    def __init__(self, directory: str, batch_size: int, seq_len: int,
+                 seed: int = 0, native: bool | None = None):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.seed = seed
+        self._handle = None
+        self._lib = None
+
+        lib_path = _find_native_lib() if native is not False else None
+        if native is True and lib_path is None:
+            raise RuntimeError(
+                "native pipeline requested but native/libtkdata.so not "
+                "built (run `make native`)")
+        if lib_path is not None:
+            self._open_native(lib_path, directory)
+        else:
+            self._open_python(directory)
+
+    # -------------------------------------------------------------- native
+    def _open_native(self, lib_path: str, directory: str) -> None:
+        import ctypes
+
+        lib = ctypes.CDLL(lib_path)
+        lib.dp_open.restype = ctypes.c_void_p
+        lib.dp_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                                ctypes.c_uint64]
+        lib.dp_next.restype = ctypes.c_int
+        lib.dp_next.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_int32)]
+        lib.dp_num_sequences.restype = ctypes.c_long
+        lib.dp_num_sequences.argtypes = [ctypes.c_void_p]
+        lib.dp_close.argtypes = [ctypes.c_void_p]
+        lib.dp_error.restype = ctypes.c_char_p
+
+        handle = lib.dp_open(directory.encode(), self.batch_size,
+                             self.seq_len, self.seed)
+        if not handle:
+            raise ValueError(lib.dp_error().decode() or
+                             f"dp_open failed for {directory}")
+        self._lib = lib
+        self._handle = handle
+        self._n = int(lib.dp_num_sequences(handle))
+        self.native = True
+
+    # -------------------------------------------------------------- python
+    def _open_python(self, directory: str) -> None:
+        width = self.seq_len + 1
+        self._shards = []
+        self._index = []  # (shard_i, offset)
+        try:
+            names = sorted(os.listdir(directory))
+        except OSError as e:
+            raise ValueError(f"cannot read directory: {directory}") from e
+        for name in names:
+            if not name.endswith(".bin"):
+                continue
+            toks = np.memmap(os.path.join(directory, name),
+                             dtype=np.int32, mode="r")
+            shard_i = len(self._shards)
+            for k in range(len(toks) // width):
+                self._index.append((shard_i, k * width))
+            self._shards.append(toks)
+        if not self._index:
+            raise ValueError(
+                "no sequences found (need *.bin shards each >= "
+                "(seq_len+1)*4 bytes)")
+        self._n = len(self._index)
+        self._epoch = 0
+        self._order = epoch_order(self._n, self.seed, 0)
+        self._cursor = 0
+        self.native = False
+
+    def __len__(self) -> int:
+        return self._n
+
+    def next(self):
+        width = self.seq_len + 1
+        if self._handle is not None:
+            out = np.empty((self.batch_size, width), dtype=np.int32)
+            import ctypes
+
+            epoch = self._lib.dp_next(
+                self._handle,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            return out, epoch
+        out = np.empty((self.batch_size, width), dtype=np.int32)
+        batch_epoch = self._epoch
+        for b in range(self.batch_size):
+            if self._cursor >= self._n:
+                self._epoch += 1
+                self._order = epoch_order(self._n, self.seed, self._epoch)
+                self._cursor = 0
+            shard_i, off = self._index[int(self._order[self._cursor])]
+            self._cursor += 1
+            out[b] = self._shards[shard_i][off:off + width]
+        return out, batch_epoch
+
+    def batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Trainer-compatible iterator view (drops the epoch tag)."""
+        while True:
+            tokens, _ = self.next()
+            yield {"tokens": tokens}
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dp_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

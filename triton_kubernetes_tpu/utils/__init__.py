@@ -1,0 +1,10 @@
+"""Shared utilities: structured logging and step tracing."""
+
+from .logging import (
+    Logger,
+    Span,
+    configure,
+    get_logger,
+)
+
+__all__ = ["Logger", "Span", "configure", "get_logger"]

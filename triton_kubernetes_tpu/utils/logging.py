@@ -1,0 +1,134 @@
+"""Structured logging + step tracing.
+
+The reference has no observability at all — provisioning output is raw stdio
+passthrough (shell/run_shell_cmd.go:10-12) and there are no log levels, files,
+or timings (SURVEY.md §5). This module is the rebuild's replacement: leveled,
+structured logs with an optional JSON-lines mode (`--json`), plus ``Span`` —
+a context manager that times a provisioning phase and logs begin/end events
+with durations. Spans nest; children carry their parent chain in the
+``span`` field so a JSON consumer can reconstruct the phase tree.
+
+No external deps: this is a deliberate small core, not a logging framework.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, TextIO
+
+LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
+
+
+class Logger:
+    """Leveled logger writing text or JSON lines to a stream.
+
+    Text mode is what a human watches during ``create cluster``; JSON mode
+    (one object per line: ts, level, msg, plus event fields) is for driving
+    the CLI from automation, the analog of the silent-install contract.
+    """
+
+    def __init__(self, stream: Optional[TextIO] = None, *,
+                 json_mode: bool = False, level: str = "info"):
+        # None = "current sys.stderr", resolved at emit time so the logger
+        # follows stream redirection (pytest capsys, daemonized CLIs).
+        self._stream = stream
+        self.json_mode = json_mode
+        self.level_no = LEVELS[level]
+        self._lock = threading.Lock()
+        self._span_stack = threading.local()
+
+    # ------------------------------------------------------------------ emit
+    def log(self, level: str, msg: str, **fields: Any) -> None:
+        if LEVELS[level] < self.level_no:
+            return
+        spans = self._spans()
+        if self.json_mode:
+            rec: Dict[str, Any] = {"ts": round(time.time(), 3),
+                                   "level": level, "msg": msg}
+            if spans:
+                rec["span"] = "/".join(s.name for s in spans)
+            rec.update(fields)
+            line = json.dumps(rec, sort_keys=True, default=str)
+        else:
+            prefix = "".join(f"[{s.name}] " for s in spans[-1:])
+            extras = " ".join(f"{k}={v}" for k, v in fields.items())
+            line = f"{prefix}{msg}" + (f"  ({extras})" if extras else "")
+            if level in ("warn", "error"):
+                line = f"{level}: {line}"
+        with self._lock:
+            print(line, file=self._stream if self._stream is not None
+                  else sys.stderr)
+
+    def debug(self, msg: str, **f: Any) -> None:
+        self.log("debug", msg, **f)
+
+    def info(self, msg: str, **f: Any) -> None:
+        self.log("info", msg, **f)
+
+    def warn(self, msg: str, **f: Any) -> None:
+        self.log("warn", msg, **f)
+
+    def error(self, msg: str, **f: Any) -> None:
+        self.log("error", msg, **f)
+
+    # ----------------------------------------------------------------- spans
+    def span(self, name: str, **fields: Any) -> "Span":
+        return Span(self, name, fields)
+
+    def _spans(self) -> List["Span"]:
+        stack = getattr(self._span_stack, "stack", None)
+        if stack is None:
+            stack = []
+            self._span_stack.stack = stack
+        return stack
+
+
+class Span:
+    """A timed phase. Logs ``begin``/``end`` (with duration) at info level;
+    failures log ``end`` at error level with the exception message, then
+    re-raise. Nested spans appear as ``parent/child`` in JSON output."""
+
+    def __init__(self, logger: Logger, name: str, fields: Dict[str, Any]):
+        self.logger = logger
+        self.name = name
+        self.fields = fields
+        self.t0 = 0.0
+        self.duration_s: Optional[float] = None
+
+    def __enter__(self) -> "Span":
+        self.logger._spans().append(self)
+        self.t0 = time.monotonic()
+        self.logger.debug("begin", **self.fields)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.duration_s = round(time.monotonic() - self.t0, 3)
+        try:
+            if exc is None:
+                self.logger.info("done", duration_s=self.duration_s,
+                                 **self.fields)
+            else:
+                self.logger.error("failed", duration_s=self.duration_s,
+                                  error=str(exc), **self.fields)
+        finally:
+            stack = self.logger._spans()
+            if stack and stack[-1] is self:
+                stack.pop()
+
+
+_default = Logger()
+
+
+def configure(*, stream: Optional[TextIO] = None, json_mode: bool = False,
+              level: str = "info") -> Logger:
+    """Reconfigure the process-default logger (CLI startup)."""
+    global _default
+    _default = Logger(stream=stream, json_mode=json_mode, level=level)
+    return _default
+
+
+def get_logger() -> Logger:
+    return _default

@@ -1,0 +1,48 @@
+"""SSH key utilities.
+
+Reference analog: util/ssh_utils.go:13-42 — derive the md5 fingerprint of the
+public key from a private key file (the Triton CloudAPI key-id convention:
+colon-separated md5 of the OpenSSH public-key blob).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+from typing import Optional
+
+
+class SSHKeyError(ValueError):
+    pass
+
+
+def public_key_fingerprint_from_private_key(
+        path: str, passphrase: Optional[bytes] = None) -> str:
+    from cryptography.hazmat.primitives import serialization
+
+    path = os.path.expanduser(path)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise SSHKeyError(f"cannot read private key {path}: {e}") from e
+
+    key = None
+    for loader in (serialization.load_ssh_private_key,
+                   serialization.load_pem_private_key):
+        try:
+            key = loader(data, password=passphrase)
+            break
+        except ValueError:
+            continue
+        except TypeError as e:  # encrypted key without passphrase
+            raise SSHKeyError(f"private key {path} needs a passphrase") from e
+    if key is None:
+        raise SSHKeyError(f"unsupported private key format: {path}")
+
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
+    blob = base64.b64decode(pub.split()[1])
+    digest = hashlib.md5(blob).hexdigest()
+    return ":".join(digest[i:i + 2] for i in range(0, len(digest), 2))

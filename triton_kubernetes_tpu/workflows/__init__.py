@@ -13,6 +13,7 @@ from .manager import new_manager
 from .cluster import new_cluster
 from .node import new_node
 from .backup import new_backup
+from .restore import restore_backup
 from .destroy import delete_cluster, delete_manager, delete_node
 from .get import get_cluster, get_manager
 
@@ -25,6 +26,7 @@ __all__ = [
     "get_cluster",
     "get_manager",
     "new_backup",
+    "restore_backup",
     "new_cluster",
     "new_manager",
     "new_node",

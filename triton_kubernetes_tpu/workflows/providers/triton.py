@@ -4,7 +4,7 @@ create/cluster_triton.go:16-140, create/node_triton.go:23-328 analogs)."""
 from __future__ import annotations
 
 from ...state import StateDocument
-from ..common import WorkflowContext
+from ..common import WorkflowContext, WorkflowError
 from .base import base_cluster_config, base_manager_config, base_node_config
 
 TRITON_URLS = [
@@ -19,11 +19,23 @@ NETWORKS = ["Joyent-SDC-Public", "Joyent-SDC-Private"]
 
 def _creds(ctx: WorkflowContext) -> dict:
     r = ctx.resolver
+    key_path = r.value("triton_key_path", "Triton Key Path",
+                       default="~/.ssh/id_rsa")
+    key_id = r.value("triton_key_id", "Triton Key ID", default="")
+    if not key_id:
+        # Derive the md5 fingerprint from the private key, the reference's
+        # fallback (util/ssh_utils.go:13-42 via create/manager_triton.go).
+        from ...utils.ssh import SSHKeyError, public_key_fingerprint_from_private_key
+
+        try:
+            key_id = public_key_fingerprint_from_private_key(str(key_path))
+        except SSHKeyError as e:
+            raise WorkflowError(
+                f"triton_key_id not set and it could not be derived: {e}")
     return {
         "triton_account": r.value("triton_account", "Triton Account Name"),
-        "triton_key_path": r.value("triton_key_path", "Triton Key Path",
-                                   default="~/.ssh/id_rsa"),
-        "triton_key_id": r.value("triton_key_id", "Triton Key ID", default=""),
+        "triton_key_path": key_path,
+        "triton_key_id": key_id,
         "triton_url": r.choose("triton_url", "Triton URL",
                                [(u, u) for u in TRITON_URLS],
                                default=TRITON_URLS[0]),

@@ -1,0 +1,31 @@
+"""``restore backup`` workflow.
+
+No reference analog — the reference CLI creates backups but never restores
+them (SURVEY.md §5: "restore is not implemented in the CLI — backup create
+only"). Flow mirrors the other cluster-scoped verbs: pick manager, pick
+cluster, require an existing backup, confirm, replay.
+"""
+
+from __future__ import annotations
+
+from .common import WorkflowContext, WorkflowError, select_cluster, select_manager
+
+
+def restore_backup(ctx: WorkflowContext) -> str:
+    manager = select_manager(
+        ctx, "No cluster managers, please create a cluster manager "
+             "before restoring a backup.")
+    state = ctx.backend.state(manager)
+    cluster_name, cluster_key = select_cluster(ctx, state)
+
+    backup_key = state.backup(cluster_key)
+    if backup_key is None:
+        raise WorkflowError(f"Cluster '{cluster_name}' has no backup.")
+
+    if not ctx.resolver.confirm(
+            "confirm", f"Proceed? This will restore '{cluster_name}' "
+                       "from its backup"):
+        return ""
+
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    return ctx.executor.restore(state, backup_key)
