@@ -37,6 +37,16 @@ class ModelConfig:
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"  # master weights
     remat: bool = True  # checkpoint each block: trade FLOPs for HBM
+    # "full": recompute the whole block in backward (max HBM savings);
+    # "dots": save MXU outputs, recompute only elementwise (norms, rotary,
+    # silu) — near-zero recompute FLOPs, still drops fused temporaries.
+    remat_policy: str = "full"
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got "
+                f"{self.remat_policy!r}")
     scan_layers: bool = True  # lax.scan over the layer stack
 
     @property
@@ -99,11 +109,13 @@ MIXTRAL_8X7B = _register(ModelConfig(
     max_seq_len=32_768, rope_theta=1_000_000.0,
     num_experts=8, num_selected=2))
 
-# ---- single-chip bench config (~420M params, fits v5e 16 GB with Adam) ----
+# ---- single-chip bench config (~420M params, fits v5e 16 GB with Adam).
+# head_dim 128 like the real Llama-3 family: full MXU lanes in the flash
+# kernels and half the flat batch*head grid rows vs 16x64 at equal FLOPs.
 LLAMA3_BENCH = _register(ModelConfig(
     name="llama3-bench", vocab_size=32_768, embed_dim=1024, num_layers=24,
-    num_heads=16, num_kv_heads=8, head_dim=64, mlp_dim=4096,
-    max_seq_len=2048))
+    num_heads=8, num_kv_heads=4, head_dim=128, mlp_dim=4096,
+    max_seq_len=2048, remat_policy="dots"))
 
 # ---- CPU-mesh test miniatures (dims divisible by 2-way tp/sp/fsdp) ----
 LLAMA_TEST = _register(ModelConfig(

@@ -182,7 +182,9 @@ def forward(
         return out, aux
 
     if config.remat:
-        body = jax.checkpoint(body)
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if config.remat_policy == "dots" else None)
+        body = jax.checkpoint(body, policy=policy)
     if config.scan_layers:
         x, auxs = lax.scan(body, x, params["layers"])
         aux_total = auxs.sum()

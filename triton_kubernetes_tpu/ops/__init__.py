@@ -8,7 +8,7 @@ KV rotation lowers to ``ppermute`` neighbor exchanges on the ICI ring.
 
 from .norms import rms_norm
 from .rotary import apply_rotary, rotary_tables
-from .attention import causal_attention
+from .attention import auto_attention, causal_attention
 from .flash_attention import flash_attention
 from .ring_attention import make_ring_attention, ring_attention_inner
 from .moe import moe_layer, top_k_router
@@ -17,6 +17,7 @@ __all__ = [
     "rms_norm",
     "apply_rotary",
     "rotary_tables",
+    "auto_attention",
     "causal_attention",
     "flash_attention",
     "make_ring_attention",

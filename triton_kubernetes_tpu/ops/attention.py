@@ -12,11 +12,29 @@ Positions are explicit so the same code serves the sequence-parallel path
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
+
+
+def auto_attention(platform: Optional[str] = None) -> Optional[Callable]:
+    """Best full-sequence causal attention for the current backend.
+
+    On TPU returns the Pallas flash kernel (ops/flash_attention.py) — the
+    einsum path materializes [Sq, Sk] f32 logits in HBM, which dominates the
+    step at training sequence lengths. Elsewhere returns None, i.e. the
+    model's dense einsum default. Only valid for standard positions
+    (0..S-1); sequence-parallel callers pass their own ring attention fn.
+    """
+    platform = platform or jax.default_backend()
+    if platform == "tpu":
+        from .flash_attention import flash_attention
+
+        return lambda q, k, v, positions: flash_attention(q, k, v)
+    return None
 
 
 def causal_attention(

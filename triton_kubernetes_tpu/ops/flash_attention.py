@@ -1,28 +1,33 @@
-"""Blockwise causal flash attention as a Pallas TPU kernel.
+"""Blockwise causal flash attention (fwd + bwd) as Pallas TPU kernels.
 
 The einsum attention in ``ops/attention.py`` materializes the [Sq, Sk] logits
 in HBM-sized intermediates; fine up to moderate S, but the HBM traffic grows
-O(S^2). This kernel streams K/V blocks through VMEM with the online-softmax
+O(S^2). These kernels stream K/V blocks through VMEM with the online-softmax
 recurrence (FlashAttention-2 style), keeping the working set at
-O(block_q x block_k) and the accumulator in f32 VMEM scratch:
+O(block_q x block_k) with f32 VMEM scratch accumulators.
 
-  grid = (batch, q_head, Sq/bq, Sk/bk), k-block innermost ->
+Forward — grid (batch*q_head, Sq/bq, Sk/bk), k-block innermost:
     s    = q . k^T * scale          (MXU, f32 accumulate)
     m'   = max(m, rowmax(s));  p = exp(s - m');  c = exp(m - m')
     l    = l*c + rowsum(p);    acc = acc*c + p . v
-  last k-block: out = acc / l
+  last k-block: out = acc / l, and the row logsumexp L = m + log(l) is
+  written as a residual so backward never re-runs the online recurrence.
 
-GQA maps query head h to KV head h // (Hq // Hkv) in the BlockSpec index
-maps, so K/V blocks are fetched once per group without materializing the
-head-repeated K/V (the einsum path pays that broadcast).
+Backward — two passes, both recomputing p = exp(s - L) blockwise:
+  dQ pass, grid (batch*q_head, Sq/bq, Sk/bk), k innermost:
+    dp = dO . v^T;  ds = p * (dp - D) * scale;  dq += ds . k
+    where D = rowsum(dO * O) is precomputed outside (one fused elementwise).
+  dK/dV pass, grid (batch*q_head, Sk/bk, Sq/bq), q innermost:
+    dv += p^T . dO;  dk += ds^T . q
+  GQA: dK/dV accumulate per *query* head and are group-summed outside the
+  kernel ([B, Hq] -> [B, Hkv]); K/V blocks are index-mapped to the KV head
+  (h // group) so the head-repeated K/V is never materialized in HBM.
 
-Backward: custom VJP that recomputes attention with the einsum formulation
-(standard remat trade — no O(S^2) residuals saved from the forward; the
-recompute is itself fused by XLA). A full flash backward kernel can replace
-it without changing the API.
-
-Causal skip: k-blocks strictly above the diagonal are predicated out with
+Causal skip: blocks strictly above the diagonal are predicated out with
 ``pl.when`` — their FLOPs are never issued, halving compute for long S.
+
+Numerics: logits/softmax in f32; the recomputed probabilities are cast to
+the input dtype (bf16) for the MXU dots, matching the forward.
 """
 
 from __future__ import annotations
@@ -34,12 +39,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import causal_attention
-
 NEG_INF = -1e30
 
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+
+def _causal_mask(s, qi, ki, block_q, block_k, sk):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    # Causal + padding mask (padded keys past sk never contribute).
+    return jnp.where((q_pos >= k_pos) & (k_pos < sk), s, NEG_INF)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, block_q: int, block_k: int,
                   sk: int, num_k_blocks: int):
     qi = pl.program_id(1)
@@ -62,13 +77,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        # Causal + padding mask (padded keys past sk never contribute).
-        s = jnp.where((q_pos >= k_pos) & (k_pos < sk), s, NEG_INF)
+        s = _causal_mask(s, qi, ki, block_q, block_k, sk)
 
         m_prev = m_ref[:]                          # [bq, 128] lane-replicated
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
@@ -83,54 +92,76 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(ki == num_k_blocks - 1)
     def _finish():
-        # Fully-masked rows (q padding) have l == 0; emit 0, not NaN.
+        # Padded *query* rows still attend real keys (finite softmax); their
+        # outputs are garbage but get sliced off by the wrapper, and their
+        # gradients vanish because dO's zero-padding zeroes dp/ds/p.dO in
+        # the backward kernels. The l == 0 guard below is defensive only
+        # (a row with every key masked, e.g. sk rounded to 0 blocks).
         l = l_ref[:, :1]
         o_ref[0] = jnp.where(
             l > 0, acc_ref[:] / l, 0.0).astype(o_ref.dtype)
+        # Row stats ride in an 8-lane trailer dim (the f32 sublane tile) —
+        # Mosaic rejects (1, block_q) 2D row blocks.
+        lse = jnp.where(l > 0, m_ref[:, :1] + jnp.log(l), jnp.inf)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
-def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool):
-    b, sq, hq, d = q.shape
-    _, sk, hkv, _ = k.shape
+def _pad_seq(x, block):
+    pad = (-x.shape[1]) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _to_flat(x):
+    """[B, S, H, D] -> [B*H, S, D]: one flat batch-head grid axis gives
+    Mosaic a clean (parallel, parallel, arbitrary) pipeline."""
+    b, s, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+
+
+def _kv_index(hq: int, hkv: int):
     group = hq // hkv
-    scale = d ** -0.5
 
-    # [B, S, H, D] -> [B*H, S, D]: one flat batch·head grid axis gives
-    # Mosaic a clean (parallel, parallel, arbitrary) pipeline.
-    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * hq, sq, d)
-    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * hkv, sk, d)
-    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * hkv, sk, d)
+    def index(bh, i, j, *, axis):
+        # bh = b*Hq + h  ->  flat KV row b*Hkv + h//group.
+        row = (bh // hq) * hkv + (bh % hq) // group
+        return (row, (j if axis == 2 else i), 0)
 
-    pad_q = (-sq) % block_q
-    pad_k = (-sk) % block_k
-    if pad_q:
-        qt = jnp.pad(qt, ((0, 0), (0, pad_q), (0, 0)))
-    if pad_k:
-        kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))
-        vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
-    sq_p, sk_p = sq + pad_q, sk + pad_k
+    return index
+
+
+def _flash_forward_flat(qt, kt, vt, hq, hkv, sq, sk,
+                        block_q, block_k, interpret):
+    """Flat [B*H, S_padded, D] in; returns (out, lse) still padded/flat."""
+    bhq, sq_p, d = qt.shape
+    sk_p = kt.shape[1]
     num_k_blocks = sk_p // block_k
-
-    grid = (b * hq, sq_p // block_q, num_k_blocks)
+    grid = (bhq, sq_p // block_q, num_k_blocks)
+    scale = d ** -0.5
     kernel = functools.partial(
         _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
         sk=sk, num_k_blocks=num_k_blocks)
+    kv = _kv_index(hq, hkv)
 
-    def kv_index(bh, qi, ki):
-        # bh = b*Hq + h  ->  flat KV row b*Hkv + h//group.
-        return ((bh // hq) * hkv + (bh % hq) // group, ki, 0)
-
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d),
+                         functools.partial(kv, axis=2)),
+            pl.BlockSpec((1, block_k, d),
+                         functools.partial(kv, axis=2)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, sq_p, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhq, sq_p, d), qt.dtype),
+            jax.ShapeDtypeStruct((bhq, sq_p, 8), jnp.float32),
+        ],
         scratch_shapes=[
             # m/l lane-replicated at 128 to match the f32 VMEM tile.
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -141,31 +172,210 @@ def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
-
-    out = out[:, :sq, :].reshape(b, hq, sq, d)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref,
+               acc_ref, *, scale: float, block_q: int, block_k: int,
+               sk: int, num_k_blocks: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _causal_mask(s, qi, ki, block_q, block_k, sk)
+        p = jnp.exp(s - lse_ref[0][:, :1])            # [bq, bk], normalized
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - dvec_ref[0][:, :1]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale: float, block_q: int, block_k: int,
+                sk: int, num_q_blocks: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _causal_mask(s, qi, ki, block_q, block_k, sk)
+        p = jnp.exp(s - lse_ref[0][:, :1])             # [bq, bk]
+        pt = p.astype(do.dtype)
+        dv_acc[:] += jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - dvec_ref[0][:, :1]) * scale).astype(q.dtype)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(qt, kt, vt, out_flat, lse, g,
+                    b, sq, sk, hq, hkv, d, block_q, block_k, interpret):
+    """Residuals arrive already flat/padded from the forward ([B*H, S_p, D])
+    so only the cotangent g needs the layout change here."""
+    group = hq // hkv
+    scale = d ** -0.5
+    bhq, sq_p, _ = qt.shape
+    sk_p = kt.shape[1]
+    num_q_blocks = sq_p // block_q
+    num_k_blocks = sk_p // block_k
+
+    dot = _pad_seq(_to_flat(g), block_q)
+
+    # D_i = rowsum(dO * O): one fused elementwise+reduce on the flat layout,
+    # carried in the same 8-lane trailer layout as lse.
+    dvec = jnp.einsum("rsd,rsd->rs", dot.astype(jnp.float32),
+                      out_flat.astype(jnp.float32))
+    dvec = jnp.broadcast_to(dvec[:, :, None], (bhq, sq_p, 8))
+
+    kv = _kv_index(hq, hkv)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    row_spec = pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), functools.partial(kv, axis=2))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, sk=sk,
+                          num_k_blocks=num_k_blocks),
+        grid=(bhq, num_q_blocks, num_k_blocks),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bhq, sq_p, d), qt.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dvec)
+
+    # dK/dV accumulate per query head (grid rows = B*Hq); the group-sum to
+    # KV heads happens below in plain XLA on [B, Hq, Sk, D].
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, 8), lambda bh, ki, qi: (bh, qi, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), functools.partial(kv, axis=1))
+    kout_spec = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, sk=sk,
+                          num_q_blocks=num_q_blocks),
+        grid=(bhq, num_k_blocks, num_q_blocks),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[kout_spec, kout_spec],
+        # Per-query-head partials stay f32 so the GQA group-sum below
+        # accumulates at full precision; cast to the input dtype after.
+        out_shape=[
+            jax.ShapeDtypeStruct((bhq, sk_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((bhq, sk_p, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dvec)
+
+    dq = dq[:, :sq, :].reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    # Flat rows are (b, h)-major with h = kv_head*group + g, so the group
+    # dim folds out contiguously before the f32 sum down to Hkv (a size-1
+    # group sum is a free reshape, so no special case for MHA).
+    dk = dk[:, :sk, :].reshape(b, hkv, group, sk, d).sum(2)
+    dv = dv[:, :sk, :].reshape(b, hkv, group, sk, d).sum(2)
+    return (dq, dk.transpose(0, 2, 1, 3).astype(kt.dtype),
+            dv.transpose(0, 2, 1, 3).astype(vt.dtype))
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.lru_cache(maxsize=64)
+def _make_flash(b, sq, sk, hq, hkv, d, block_q, block_k, interpret):
+    """Per-(shape, blocks) custom_vjp instance. The static dims live in this
+    closure, which lets the forward save its residuals in the flat padded
+    layout — the backward reuses them directly instead of re-transposing
+    and re-padding q/k/v (three full-tensor HBM copies per layer saved)."""
+
+    @jax.custom_vjp
+    def fa(q, k, v):
+        return fwd(q, k, v)[0]
+
+    def fwd(q, k, v):
+        qt = _pad_seq(_to_flat(q), block_q)
+        kt = _pad_seq(_to_flat(k), block_k)
+        vt = _pad_seq(_to_flat(v), block_k)
+        out_flat, lse = _flash_forward_flat(
+            qt, kt, vt, hq, hkv, sq, sk, block_q, block_k, interpret)
+        out = out_flat[:, :sq, :].reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+        return out, (qt, kt, vt, out_flat, lse)
+
+    def bwd(residuals, g):
+        qt, kt, vt, out_flat, lse = residuals
+        return _flash_backward(
+            qt, kt, vt, out_flat, lse, g,
+            b, sq, sk, hq, hkv, d, block_q, block_k, interpret)
+
+    fa.defvjp(fwd, bwd)
+    return fa
+
+
+def flash_attention(q, k, v, block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
     """Causal GQA attention, [B, S, H, D] in/out (ops/attention.py contract,
-    standard positions). ``interpret=True`` runs the kernel in the Pallas
+    standard positions). ``interpret=True`` runs the kernels in the Pallas
     interpreter (CPU tests)."""
-    hq, hkv = q.shape[2], k.shape[2]
+    if block_q is None:
+        block_q = DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
-    return _flash_forward(q, k, v, block_q, block_k, interpret)
-
-
-def _fwd(q, k, v, block_q, block_k, interpret):
-    return flash_attention(q, k, v, block_q, block_k, interpret), (q, k, v)
-
-
-def _bwd(block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: causal_attention(q_, k_, v_), q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_fwd, _bwd)
+    block_q = min(block_q, _round_up(sq, 128))
+    block_k = min(block_k, _round_up(sk, 128))
+    return _make_flash(b, sq, sk, hq, hkv, d, block_q, block_k,
+                       interpret)(q, k, v)

@@ -27,8 +27,34 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama
 from ..models.config import ModelConfig
-from ..parallel.mesh import AXIS_DATA, AXIS_FSDP
+from ..ops.attention import auto_attention
+from ..parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
 from ..parallel.sharding import DEFAULT_RULES, spec_tree_from_logical
+
+
+def _resolve_attention(attention_fn, mesh: Mesh):
+    """None -> the best backend kernel (flash on TPU, dense einsum else).
+    Sequence-parallel callers pass ring attention explicitly.
+
+    On a multi-device mesh the pallas call must be wrapped in shard_map —
+    GSPMD cannot partition a Mosaic custom-call, so an unwrapped kernel
+    would silently all-gather q/k/v and run replicated per chip. Attention
+    is independent across batch and heads, so the per-shard view over
+    (data+fsdp batch, tensor heads) is exact; a seq>1 mesh without an
+    explicit ring attention fn keeps the partitionable einsum path.
+    """
+    if attention_fn is not None:
+        return attention_fn
+    flash = auto_attention(mesh.devices.flat[0].platform)
+    if flash is None or mesh.size == 1:
+        return flash
+    if mesh.shape[AXIS_SEQ] > 1:
+        return None
+    spec = P((AXIS_DATA, AXIS_FSDP), None, AXIS_TENSOR, None)
+    kernel = jax.shard_map(
+        lambda q, k, v: flash(q, k, v, None), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    return lambda q, k, v, positions: kernel(q, k, v)
 
 
 @flax.struct.dataclass
@@ -130,6 +156,7 @@ def make_train_step(
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict]]:
     """Returns jitted (state, batch) -> (state, metrics); donates state."""
     b_sharding = NamedSharding(mesh, batch_spec())
+    attention_fn = _resolve_attention(attention_fn, mesh)
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
         tokens = jax.lax.with_sharding_constraint(batch["tokens"], b_sharding)
@@ -151,6 +178,7 @@ def make_train_step(
 
 def make_eval_step(config: ModelConfig, mesh: Mesh, attention_fn=None):
     b_sharding = NamedSharding(mesh, batch_spec())
+    attention_fn = _resolve_attention(attention_fn, mesh)
 
     def step(params, batch):
         tokens = jax.lax.with_sharding_constraint(batch["tokens"], b_sharding)
