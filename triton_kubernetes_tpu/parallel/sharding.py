@@ -41,7 +41,10 @@ DEFAULT_RULES: Dict[str, MeshAxes] = {
     "mlp": AXIS_TENSOR,
     "vocab": AXIS_TENSOR,
     "expert": AXIS_EXPERT,
-    "layers": None,  # scanned layer dim stays replicated
+    # The scanned layer dim shards over the pipeline-stage axis: each stage
+    # group holds its contiguous L/S chunk (a no-op on stage=1 meshes), so
+    # train/pipeline.py's [L] -> [S, L/S] reshape is layout-preserving.
+    "layers": AXIS_STAGE,
     "stage": AXIS_STAGE,
     "norm": None,
 }

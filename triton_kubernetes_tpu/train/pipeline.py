@@ -1,0 +1,159 @@
+"""GPipe-style SPMD pipeline parallelism over the ``stage`` mesh axis.
+
+TPU-first formulation (no per-stage programs, no send/recv): the stacked
+layer tensors [L, ...] are reshaped to [S, L/S, ...] with the leading dim
+sharded over ``stage``; a ``vmap`` over that dim makes each device group
+compute only its own stage, and the microbatch hand-off is a shifted
+``concatenate`` on the stage-sharded dim — GSPMD lowers exactly that shift
+to a ``collective-permute`` between neighboring stages (the ICI/DCN
+transfer), so the whole schedule stays one jitted SPMD program.
+
+Schedule: plain GPipe with M microbatches over S stages, T = M + S - 1
+ticks. At tick t, stage s processes microbatch t - s; ticks where t - s
+falls outside [0, M) are bubbles computing on zero activations (RMS-norm is
+eps-guarded, so bubbles are finite and their outputs are never collected).
+Efficiency is M / (M + S - 1); pick microbatches >= 4 * stages to amortize.
+
+No reference analog: the reference provisions clusters and has no ML
+runtime (SURVEY.md §2.5); this implements the pipeline-parallel axis the
+TPU build adds on top (BASELINE.json north star).
+
+Constraints (this round): sequence parallelism (ring attention) cannot be
+combined with the pipeline — ``shard_map`` inside the stage ``vmap`` is
+not supported. ``seq`` must be 1 when ``stage`` > 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..models.config import ModelConfig
+from ..ops.norms import rms_norm
+from ..ops.rotary import rotary_tables
+from ..parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_STAGE, mesh_axis_size
+
+
+def _stage_params(layers, num_stages: int):
+    """[L, ...] stacked leaves -> [S, L/S, ...]."""
+    def split(leaf):
+        l = leaf.shape[0]
+        if l % num_stages:
+            raise ValueError(
+                f"num_layers ({l}) must divide evenly into "
+                f"{num_stages} pipeline stages")
+        return leaf.reshape(num_stages, l // num_stages, *leaf.shape[1:])
+
+    return jax.tree.map(split, layers)
+
+
+def pipeline_forward(
+    params,
+    tokens: jnp.ndarray,  # [B, S_len] int32
+    config: ModelConfig,
+    num_stages: int,
+    microbatches: int,
+    attention_fn=None,
+    positions: Optional[jnp.ndarray] = None,
+    mesh: Optional[Mesh] = None,
+):
+    """Pipelined equivalent of ``models.llama.forward``.
+
+    Returns (logits [B, S_len, V] f32, moe aux loss scalar). Numerically
+    identical to the sequential forward (same params, same layer order) up
+    to reduction-order noise.
+    """
+    attention_fn = attention_fn or llama._dense_attention
+    b, s = tokens.shape
+    if b % microbatches:
+        raise ValueError(
+            f"batch ({b}) must divide into {microbatches} microbatches")
+    if microbatches % num_stages:
+        raise ValueError(
+            f"microbatches ({microbatches}) must be a multiple of "
+            f"stages ({num_stages})")
+    mb = b // microbatches
+    ad = config.activation_dtype
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    cos, sin = rotary_tables(
+        config.head_dim, config.max_seq_len, config.rope_theta)
+
+    stage_layers = _stage_params(params["layers"], num_stages)
+    pos_mb = positions.reshape(microbatches, mb, s)
+
+    def stage_apply(layers_s, x, pos):
+        """One stage: scan its L/S layers over the microbatch activation."""
+        def body(carry, layer):
+            out, aux = llama._block(
+                carry, layer, config, cos, sin, pos, attention_fn)
+            return out, aux
+
+        if config.remat:
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if config.remat_policy == "dots" else None)
+            body = jax.checkpoint(body, policy=policy)
+        x, auxs = lax.scan(body, x, layers_s)
+        return x, auxs.sum()
+
+    # Microbatch embeddings up front: [M, mb, s, d] (same total bytes as the
+    # unpipelined activation), padded with S-1 zero ticks for the drain.
+    x = params["embed"].astype(ad)[tokens]
+    x = x.reshape(microbatches, mb, s, -1)
+    ticks = microbatches + num_stages - 1
+    pad = jnp.zeros((num_stages - 1,) + x.shape[1:], x.dtype)
+    injects = jnp.concatenate([x, pad], axis=0)          # [T, mb, s, d]
+    pos_pad = jnp.concatenate(
+        [pos_mb, jnp.zeros((num_stages - 1, mb, s), pos_mb.dtype)], axis=0)
+
+    if mesh is not None:
+        buf_sharding = NamedSharding(mesh, P(AXIS_STAGE, (AXIS_DATA, AXIS_FSDP)))
+        constrain = lambda a: lax.with_sharding_constraint(a, buf_sharding)
+    else:
+        constrain = lambda a: a  # shape-only run (tests, no mesh in scope)
+    stage_idx = jnp.arange(num_stages)
+
+    def tick(carry, xs):
+        buf, pos_buf, outputs, aux_total = carry
+        inject, pos_t, t = xs
+        # Shift the stage buffer: stage 0 takes the new microbatch, stage
+        # s takes stage s-1's previous output (collective-permute on ICI).
+        # Positions ride along so each stage sees its own microbatch's.
+        buf = constrain(jnp.concatenate([inject[None], buf[:-1]], axis=0))
+        pos_buf = jnp.concatenate([pos_t[None], pos_buf[:-1]], axis=0)
+        out, aux = jax.vmap(stage_apply)(stage_layers, buf, pos_buf)
+        out = constrain(out)
+        # Only stages holding a real microbatch (0 <= t - s < M) count.
+        valid = ((t - stage_idx >= 0)
+                 & (t - stage_idx < microbatches)).astype(aux.dtype)
+        aux_total = aux_total + (aux * valid).sum()
+        # Collect the last stage's finished microbatch (index t - (S-1);
+        # clamped writes before the fill tick are overwritten at t = S-1).
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, out[-1], jnp.clip(t - (num_stages - 1), 0, None), 0)
+        return (out, pos_buf, outputs, aux_total), None
+
+    buf0 = jnp.zeros((num_stages, mb, s, x.shape[-1]), x.dtype)
+    pos0 = jnp.zeros((num_stages, mb, s), pos_mb.dtype)
+    out0 = jnp.zeros_like(x)
+    (_, _, outputs, aux_total), _ = lax.scan(
+        tick, (buf0, pos0, out0, jnp.zeros((), jnp.float32)),
+        (injects, pos_pad, jnp.arange(ticks)))
+
+    h = outputs.reshape(b, s, -1)
+    h = rms_norm(h, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", h, params["lm_head"].astype(ad),
+        preferred_element_type=jnp.float32)
+    # Each microbatch's aux is a mean-over-its-tokens estimate of the same
+    # batch-level balance loss; average them to match the sequential scale.
+    return logits, aux_total / microbatches
+
+
+def pipeline_degree(mesh: Mesh) -> int:
+    return mesh_axis_size(mesh, AXIS_STAGE)

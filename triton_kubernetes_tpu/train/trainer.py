@@ -30,6 +30,7 @@ from ..models.config import ModelConfig
 from ..ops.attention import auto_attention
 from ..parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
 from ..parallel.sharding import DEFAULT_RULES, spec_tree_from_logical
+from .pipeline import pipeline_degree, pipeline_forward
 
 
 def _resolve_attention(attention_fn, mesh: Mesh):
@@ -48,7 +49,10 @@ def _resolve_attention(attention_fn, mesh: Mesh):
     flash = auto_attention(mesh.devices.flat[0].platform)
     if flash is None or mesh.size == 1:
         return flash
-    if mesh.shape[AXIS_SEQ] > 1:
+    if mesh.shape[AXIS_SEQ] > 1 or pipeline_degree(mesh) > 1:
+        # seq>1 without an explicit ring fn, and the GPipe path (attention
+        # runs inside the stage vmap, where shard_map can't nest), both
+        # keep the partitionable einsum attention.
         return None
     spec = P((AXIS_DATA, AXIS_FSDP), None, AXIS_TENSOR, None)
     kernel = jax.shard_map(
@@ -137,10 +141,18 @@ def loss_fn(
     tokens: jnp.ndarray,  # [B, S+1]
     config: ModelConfig,
     attention_fn=None,
+    num_stages: int = 1,
+    microbatches: int = 1,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = llama.forward(params, inputs, config,
-                                attention_fn=attention_fn)
+    if num_stages > 1:
+        logits, aux = pipeline_forward(
+            params, inputs, config, num_stages, microbatches,
+            attention_fn=attention_fn, mesh=mesh)
+    else:
+        logits, aux = llama.forward(params, inputs, config,
+                                    attention_fn=attention_fn)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     ce = ce.mean()
     total = ce + config.aux_loss_weight * aux
@@ -153,16 +165,30 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     attention_fn=None,
     rules=None,
+    microbatches: int = 0,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict]]:
-    """Returns jitted (state, batch) -> (state, metrics); donates state."""
+    """Returns jitted (state, batch) -> (state, metrics); donates state.
+
+    On a mesh with ``stage`` > 1 the forward runs the GPipe schedule in
+    ``train.pipeline``; ``microbatches`` defaults to the stage count (set it
+    higher to shrink the pipeline bubble).
+    """
     b_sharding = NamedSharding(mesh, batch_spec())
+    num_stages = pipeline_degree(mesh)
+    if num_stages > 1 and mesh.shape[AXIS_SEQ] > 1:
+        raise ValueError(
+            "pipeline (stage > 1) cannot combine with sequence parallelism "
+            "(seq > 1): ring attention's shard_map cannot nest inside the "
+            "stage vmap")
     attention_fn = _resolve_attention(attention_fn, mesh)
+    microbatches = microbatches or num_stages
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
         tokens = jax.lax.with_sharding_constraint(batch["tokens"], b_sharding)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (_, metrics), grads = grad_fn(
-            state.params, tokens, config, attention_fn)
+            state.params, tokens, config, attention_fn,
+            num_stages, microbatches, mesh)
         updates, new_opt = optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -176,13 +202,17 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
-def make_eval_step(config: ModelConfig, mesh: Mesh, attention_fn=None):
+def make_eval_step(config: ModelConfig, mesh: Mesh, attention_fn=None,
+                   microbatches: int = 0):
     b_sharding = NamedSharding(mesh, batch_spec())
     attention_fn = _resolve_attention(attention_fn, mesh)
+    num_stages = pipeline_degree(mesh)
+    microbatches = microbatches or num_stages
 
     def step(params, batch):
         tokens = jax.lax.with_sharding_constraint(batch["tokens"], b_sharding)
-        _, metrics = loss_fn(params, tokens, config, attention_fn)
+        _, metrics = loss_fn(params, tokens, config, attention_fn,
+                             num_stages, microbatches, mesh)
         return metrics
 
     return jax.jit(step)
