@@ -14,6 +14,14 @@ from .config import (
     get_config,
 )
 from .llama import forward, init_params, logical_axes
+from .generate import (
+    KVCache,
+    decode_step,
+    generate,
+    init_cache,
+    prefill,
+    sample_token,
+)
 from . import mixtral
 
 __all__ = [
@@ -24,4 +32,10 @@ __all__ = [
     "init_params",
     "logical_axes",
     "mixtral",
+    "KVCache",
+    "init_cache",
+    "prefill",
+    "decode_step",
+    "generate",
+    "sample_token",
 ]

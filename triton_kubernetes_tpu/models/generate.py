@@ -1,0 +1,200 @@
+"""KV-cache autoregressive generation for the bundled model families.
+
+TPU-first decode loop: everything is static-shaped — the KV cache is
+allocated at ``max_len`` up front ([L, B, max_len, Hkv, Dh]) and written
+with ``dynamic_update_slice``; the decode loop is one ``lax.scan`` whose
+carry is (cache, last token, position, rng), so the whole
+prefill-then-N-steps program jits once and never retraces as text grows.
+Unwritten cache slots need no explicit mask: attention scores use explicit
+key positions (``arange(max_len)``), and the causal test ``q_pos >= k_pos``
+already excludes every slot past the current position.
+
+The per-layer math reuses ``llama._qkv`` / ``llama._mlp`` (same weight
+pytree, same block order), so greedy decode reproduces the training
+forward's argmax exactly — see tests/test_generate.py. For MoE families
+use a dropless config at inference (``capacity_factor >= num_experts /
+num_selected``): capacity-based token dropping depends on how many tokens
+route together, which differs between single-token decode and full-sequence
+prefill and would make cached decode diverge from the training forward.
+
+No reference analog: the reference is an infrastructure CLI (SURVEY.md
+§2.5); serving is part of the workload stack the TPU build adds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import causal_attention
+from ..ops.rotary import rotary_tables
+from .config import ModelConfig
+from . import llama
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, max_len, Hkv, Dh] activation dtype
+    v: jnp.ndarray  # [L, B, max_len, Hkv, Dh]
+    length: jnp.ndarray  # [] int32 — tokens written so far
+
+
+def init_cache(config: ModelConfig, batch: int, max_len: int) -> KVCache:
+    shape = (config.num_layers, batch, max_len,
+             config.num_kv_heads, config.head_dim)
+    z = jnp.zeros(shape, config.activation_dtype)
+    return KVCache(k=z, v=z, length=jnp.zeros((), jnp.int32))
+
+
+def prefill(
+    params,
+    tokens: jnp.ndarray,  # [B, P] int32 prompt
+    config: ModelConfig,
+    cache: KVCache,
+    last_logits_only: bool = False,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the prompt through the stack, filling cache[:, :, :P].
+
+    Returns (logits f32, cache) — [B, P, V], or [B, 1, V] when
+    ``last_logits_only`` (generation only samples the last position, and
+    the full-prompt unembed is B*P*V f32, easily the largest buffer of a
+    long-prompt prefill). Prompt attention is plain causal over the prompt
+    itself (nothing cached yet).
+    """
+    b, p = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+    cos, sin = rotary_tables(
+        config.head_dim, config.max_seq_len, config.rope_theta)
+    x = params["embed"].astype(config.activation_dtype)[tokens]
+
+    def body(carry, layer_and_cache):
+        x = carry
+        layer, ck, cv = layer_and_cache
+        q, k, v = llama._qkv(x, layer, config, cos, sin, positions)
+        attn = causal_attention(q, k, v, positions, positions)
+        x = llama.project_out(x, attn, layer, config)
+        y, _ = llama._mlp(x, layer, config)
+        ck = lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
+        return x + y, (ck, cv)
+
+    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    if last_logits_only:
+        x = x[:, -1:, :]
+    logits = llama.unembed(x, params, config)
+    return logits, KVCache(k=ck, v=cv, length=jnp.asarray(p, jnp.int32))
+
+
+def decode_step(
+    params,
+    token: jnp.ndarray,  # [B] int32 — the latest token
+    config: ModelConfig,
+    cache: KVCache,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """One autoregressive step: returns (logits [B, V] f32, updated cache)."""
+    b = token.shape[0]
+    ad = config.activation_dtype
+    max_len = cache.k.shape[2]
+    pos = cache.length  # scalar: where this token goes
+    positions = jnp.broadcast_to(pos, (b, 1)).astype(jnp.int32)
+    k_positions = jnp.broadcast_to(
+        jnp.arange(max_len, dtype=jnp.int32), (b, max_len))
+    cos, sin = rotary_tables(
+        config.head_dim, config.max_seq_len, config.rope_theta)
+    x = params["embed"].astype(ad)[token[:, None]]  # [B, 1, D]
+
+    def body(carry, layer_and_cache):
+        x = carry
+        layer, ck, cv = layer_and_cache
+        q, k, v = llama._qkv(x, layer, config, cos, sin, positions)
+        ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+        # Slots past pos have k_pos > q_pos and mask themselves out.
+        attn = causal_attention(q, ck, cv, positions, k_positions)
+        x = llama.project_out(x, attn, layer, config)
+        y, _ = llama._mlp(x, layer, config)
+        return x + y, (ck, cv)
+
+    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    logits = llama.unembed(x, params, config)[:, 0, :]
+    return logits, KVCache(k=ck, v=cv, length=pos + 1)
+
+
+def sample_token(
+    logits: jnp.ndarray,  # [B, V] f32
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+) -> jnp.ndarray:
+    """Greedy when temperature == 0; else temperature (+ optional top-k)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    params,
+    prompt: jnp.ndarray,  # [B, P] int32
+    config: ModelConfig,
+    max_new_tokens: int,
+    key: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    eos_id: Optional[int] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Prefill + N decode steps; returns {"tokens": [B, N], "done": [B]}.
+
+    Static-shaped: always runs ``max_new_tokens`` steps; once a sequence
+    emits ``eos_id`` its subsequent slots repeat eos (the done mask sticks).
+    """
+    b, p = prompt.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    max_len = p + max_new_tokens
+    if max_len > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({config.max_seq_len})")
+    if config.is_moe and (config.capacity_factor
+                          < config.num_experts / config.num_selected):
+        raise ValueError(
+            "MoE generation needs dropless routing (capacity-based token "
+            f"dropping is sequence-length-dependent, so cached decode would "
+            f"diverge from the training forward): set capacity_factor >= "
+            f"num_experts/num_selected = "
+            f"{config.num_experts / config.num_selected}, got "
+            f"{config.capacity_factor}")
+
+    def sample(logits, done, key):
+        tok = sample_token(logits, key, temperature, top_k)
+        if eos_id is not None:
+            tok = jnp.where(done, eos_id, tok)
+            done = done | (tok == eos_id)
+        return tok, done
+
+    cache = init_cache(config, b, max_len)
+    logits, cache = prefill(params, prompt, config, cache,
+                            last_logits_only=True)
+    key, sub = jax.random.split(key)
+    tok0, done0 = sample(logits[:, -1, :], jnp.zeros((b,), bool), sub)
+
+    def step(carry, _):
+        tok, cache, done, key = carry
+        logits, cache = decode_step(params, tok, config, cache)
+        key, sub = jax.random.split(key)
+        nxt, done = sample(logits, done, sub)
+        return (nxt, cache, done, key), nxt
+
+    # N-1 decode steps: the first token comes from prefill's logits, and no
+    # decode runs whose logits would never be sampled.
+    (_, _, done, _), rest = lax.scan(
+        step, (tok0, cache, done0, key), None, length=max_new_tokens - 1)
+    tokens = jnp.concatenate([tok0[:, None], jnp.transpose(rest)], axis=1) \
+        if max_new_tokens > 1 else tok0[:, None]
+    return {"tokens": tokens, "done": done}

@@ -116,6 +116,44 @@ def logical_axes(config: ModelConfig) -> Params:
     }
 
 
+def _qkv(x: jnp.ndarray, layer: Params, config: ModelConfig,
+         cos: jnp.ndarray, sin: jnp.ndarray, positions: jnp.ndarray):
+    """Projected + rotary-encoded q/k/v for a block input ([B, S, ...])."""
+    ad = config.activation_dtype
+    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(ad))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(ad))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(ad))
+    q = apply_rotary(q, cos, sin, positions)
+    k = apply_rotary(k, cos, sin, positions)
+    return q, k, v
+
+
+def _mlp(x: jnp.ndarray, layer: Params, config: ModelConfig,
+         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Post-attention half of the block: norm + SwiGLU (dense or MoE).
+    Returns (residual delta, aux loss)."""
+    ad = config.activation_dtype
+
+    def w(name):
+        return layer[name].astype(ad)
+
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    if config.is_moe:
+        moe_params = {
+            "router": layer["router"],
+            "w1": w("moe_w1"), "w3": w("moe_w3"), "w2": w("moe_w2"),
+        }
+        return moe_layer(
+            h, moe_params, config.num_selected, config.capacity_factor)
+    gate = jax.nn.silu(
+        jnp.einsum("bsd,df->bsf", h, w("w3")).astype(jnp.float32)
+    ).astype(ad)
+    up = jnp.einsum("bsd,df->bsf", h, w("w1"))
+    y = jnp.einsum("bsf,fd->bsd", gate * up, w("w2"))
+    return y, jnp.zeros((), dtype=jnp.float32)
+
+
 def _block(
     x: jnp.ndarray,  # [B, S, D] activation dtype
     layer: Params,  # one layer's weights (no leading L dim)
@@ -125,35 +163,10 @@ def _block(
     positions: jnp.ndarray,
     attention_fn: AttentionFn,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    ad = config.activation_dtype
-
-    def w(name):
-        return layer[name].astype(ad)
-
-    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, w("wq"))
-    k = jnp.einsum("bsd,dhk->bshk", h, w("wk"))
-    v = jnp.einsum("bsd,dhk->bshk", h, w("wv"))
-    q = apply_rotary(q, cos, sin, positions)
-    k = apply_rotary(k, cos, sin, positions)
+    q, k, v = _qkv(x, layer, config, cos, sin, positions)
     attn = attention_fn(q, k, v, positions)
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, w("wo"))
-
-    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
-    if config.is_moe:
-        moe_params = {
-            "router": layer["router"],
-            "w1": w("moe_w1"), "w3": w("moe_w3"), "w2": w("moe_w2"),
-        }
-        y, aux = moe_layer(
-            h, moe_params, config.num_selected, config.capacity_factor)
-    else:
-        gate = jax.nn.silu(
-            jnp.einsum("bsd,df->bsf", h, w("w3")).astype(jnp.float32)
-        ).astype(ad)
-        up = jnp.einsum("bsd,df->bsf", h, w("w1"))
-        y = jnp.einsum("bsf,fd->bsd", gate * up, w("w2"))
-        aux = jnp.zeros((), dtype=jnp.float32)
+    x = project_out(x, attn, layer, config)
+    y, aux = _mlp(x, layer, config)
     return x + y, aux
 
 
@@ -195,8 +208,20 @@ def forward(
             x, aux = body(x, layer_i)
             aux_total = aux_total + aux
 
+    return unembed(x, params, config), aux_total
+
+
+def unembed(x: jnp.ndarray, params: Params, config: ModelConfig):
+    """Final norm + lm_head: [B, S, D] -> f32 logits [B, S, V]."""
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["lm_head"].astype(ad),
+    return jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(config.activation_dtype),
         preferred_element_type=jnp.float32)
-    return logits, aux_total
+
+
+def project_out(x: jnp.ndarray, attn: jnp.ndarray, layer: Params,
+                config: ModelConfig) -> jnp.ndarray:
+    """Attention output projection + residual add."""
+    return x + jnp.einsum(
+        "bshk,hkd->bsd", attn,
+        layer["wo"].astype(config.activation_dtype))

@@ -34,7 +34,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama
 from ..models.config import ModelConfig
-from ..ops.norms import rms_norm
 from ..ops.rotary import rotary_tables
 from ..parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_STAGE, mesh_axis_size
 
@@ -146,10 +145,7 @@ def pipeline_forward(
         (injects, pos_pad, jnp.arange(ticks)))
 
     h = outputs.reshape(b, s, -1)
-    h = rms_norm(h, params["final_norm"], config.norm_eps)
-    logits = jnp.einsum(
-        "bsd,dv->bsv", h, params["lm_head"].astype(ad),
-        preferred_element_type=jnp.float32)
+    logits = llama.unembed(h, params, config)
     # Each microbatch's aux is a mean-over-its-tokens estimate of the same
     # batch-level balance loss; average them to match the sequential scale.
     return logits, aux_total / microbatches
