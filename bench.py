@@ -22,21 +22,10 @@ import jax.numpy as jnp
 
 
 def _peak_tflops(device) -> float:
-    from triton_kubernetes_tpu.topology.slices import TPU_GENERATIONS
+    from triton_kubernetes_tpu.topology.slices import peak_bf16_tflops_for_kind
 
-    kind = device.device_kind.lower()
-    for gen in TPU_GENERATIONS.values():
-        if gen.name in kind.replace(" ", "").replace("tpu", ""):
-            return gen.peak_bf16_tflops
-    if "v5 lite" in kind or "v5e" in kind:
-        return TPU_GENERATIONS["v5e"].peak_bf16_tflops
-    if "v5p" in kind or "v5" in kind:
-        return TPU_GENERATIONS["v5p"].peak_bf16_tflops
-    if "v4" in kind:
-        return TPU_GENERATIONS["v4"].peak_bf16_tflops
-    if "v6" in kind:
-        return TPU_GENERATIONS["v6e"].peak_bf16_tflops
-    return 1.0  # CPU etc: MFU denominator is meaningless, report vs 1 TFLOP
+    # CPU etc: MFU denominator is meaningless, report vs 1 TFLOP.
+    return peak_bf16_tflops_for_kind(device.device_kind) or 1.0
 
 
 def main() -> None:

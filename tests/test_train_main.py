@@ -1,0 +1,88 @@
+"""python -m triton_kubernetes_tpu.train — the JobSet worker entrypoint."""
+
+import json
+
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.train.__main__ import main
+from triton_kubernetes_tpu.train.data import write_packed
+
+
+def _run(capsys, argv):
+    rc = main(argv)
+    err = capsys.readouterr().err
+    return rc, err
+
+
+def test_synthetic_smoke(cpu_mesh_devices, capsys):
+    rc, err = _run(capsys, [
+        "--model", "llama-test", "--steps", "4", "--batch-size", "4",
+        "--seq-len", "32", "--fsdp", "4", "--tensor", "2",
+        "--log-every", "2", "--json-logs"])
+    assert rc == 0
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    train = [l for l in lines if l["msg"] == "train"]
+    assert train and train[-1]["step"] == 4
+    assert np.isfinite(train[-1]["loss"])
+    assert any(l["msg"] == "trainer done" for l in lines)
+
+
+def test_pipelined_and_ring_flags(cpu_mesh_devices, capsys):
+    rc, err = _run(capsys, [
+        "--model", "llama-test", "--steps", "2", "--batch-size", "4",
+        "--seq-len", "32", "--stage", "2", "--fsdp", "2", "--tensor", "2",
+        "--microbatches", "2", "--log-every", "1", "--json-logs"])
+    assert rc == 0
+    rc, err = _run(capsys, [
+        "--model", "llama-test", "--steps", "2", "--batch-size", "4",
+        "--seq-len", "32", "--seq", "2", "--fsdp", "2", "--tensor", "2",
+        "--ring-attention", "--log-every", "1", "--json-logs"])
+    assert rc == 0
+
+
+def test_data_dir_and_checkpoint_resume(cpu_mesh_devices, tmp_path, capsys):
+    rng = np.random.default_rng(0)
+    write_packed(str(tmp_path / "shard0.bin"),
+                 rng.integers(0, 256, size=4096).astype(np.int32))
+    ckpt = tmp_path / "ckpt"
+    common = [
+        "--model", "llama-test", "--batch-size", "4", "--seq-len", "16",
+        "--fsdp", "4", "--tensor", "2", "--data-dir", str(tmp_path),
+        "--checkpoint-dir", str(ckpt), "--log-every", "1", "--json-logs"]
+    rc, err = _run(capsys, common + ["--steps", "2"])
+    assert rc == 0
+    # Resume continues from step 2 and trains to 4.
+    rc, err = _run(capsys, common + ["--steps", "4", "--resume"])
+    assert rc == 0
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    assert any(l["msg"] == "resumed" and l["step"] == 2 for l in lines)
+    train = [l for l in lines if l["msg"] == "train"]
+    assert train[-1]["step"] == 4
+
+
+def test_bad_batch_divisibility(cpu_mesh_devices, capsys):
+    rc, _ = _run(capsys, [
+        "--model", "llama-test", "--steps", "1", "--batch-size", "3",
+        "--seq-len", "16", "--fsdp", "4", "--tensor", "2", "--json-logs"])
+    assert rc == 2
+
+
+def test_ring_plus_stage_rejected(cpu_mesh_devices, capsys):
+    rc, _ = _run(capsys, [
+        "--model", "llama-test", "--steps", "1", "--batch-size", "4",
+        "--seq-len", "16", "--stage", "2", "--fsdp", "4",
+        "--ring-attention", "--json-logs"])
+    assert rc == 2
+
+
+def test_auto_batch_scales_with_mesh(cpu_mesh_devices, capsys):
+    """Bare invocation must work on any slice: batch defaults to 4 per
+    data*fsdp shard (the docs' job_command runs with no flags)."""
+    rc, err = _run(capsys, [
+        "--model", "llama-test", "--steps", "1", "--seq-len", "16",
+        "--fsdp", "4", "--tensor", "2", "--log-every", "1", "--json-logs"])
+    assert rc == 0
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    start = [l for l in lines if l["msg"] == "trainer starting"][0]
+    assert start["batch"] == 16  # 4 shards x 4

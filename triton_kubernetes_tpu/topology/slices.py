@@ -32,6 +32,26 @@ TPU_GENERATIONS: Dict[str, TpuGeneration] = {
 }
 
 
+def peak_bf16_tflops_for_kind(device_kind: str) -> float:
+    """Per-chip bf16 peak for a jax ``device_kind`` string (e.g. 'TPU v5
+    lite', 'TPU v5p chip', 'TPU v4'). Returns 0.0 when unrecognized so MFU
+    reporting can be skipped rather than wrong."""
+    kind = device_kind.lower()
+    compact = kind.replace(" ", "").replace("tpu", "")
+    for gen in TPU_GENERATIONS.values():
+        if gen.name in compact:
+            return gen.peak_bf16_tflops
+    if "v5 lite" in kind or "v5e" in kind:
+        return TPU_GENERATIONS["v5e"].peak_bf16_tflops
+    if "v5p" in kind or "v5" in kind:
+        return TPU_GENERATIONS["v5p"].peak_bf16_tflops
+    if "v4" in kind:
+        return TPU_GENERATIONS["v4"].peak_bf16_tflops
+    if "v6" in kind:
+        return TPU_GENERATIONS["v6e"].peak_bf16_tflops
+    return 0.0
+
+
 def parse_accelerator(name: str) -> Tuple[TpuGeneration, int]:
     """``"v5p-64"`` -> (v5p generation, 64 chips)."""
     gen_name, sep, count = name.partition("-")

@@ -1,0 +1,219 @@
+"""Trainer entrypoint: ``python -m triton_kubernetes_tpu.train``.
+
+This is the command the provisioned JobSets run (docs/guide/gcp-tpu,
+modules/gcp_tpu.py training-job manifests): every worker starts the same
+program, ``jax.distributed`` initializes from the env the JobSet injects
+(``JAX_COORDINATOR_ADDRESS`` + ``TPU_WORKER_ID``/job completion index), and
+the whole slice executes one SPMD program over the requested mesh.
+
+Single-process runs (laptop smoke, one-host slice) skip distributed init
+automatically. Data comes from the native sharded token pipeline when
+``--data-dir`` is given (falls back to the pure-Python reader), else from
+the synthetic Markov generator, so the entrypoint always has something to
+train on — the BASELINE "cluster-up then train" gates assume that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m triton_kubernetes_tpu.train",
+        description="Bundled sharded trainer for the provisioned TPU slice.")
+    p.add_argument("--model", default="llama3-bench",
+                   help="config name from models.CONFIGS")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=0,
+                   help="global batch across all chips "
+                        "(0 = 4 per data*fsdp shard, fits any slice)")
+    p.add_argument("--seq-len", type=int, default=0,
+                   help="0 = the model's max_seq_len")
+    p.add_argument("--learning-rate", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=100)
+    # Mesh axes: -1 absorbs remaining devices (at most one axis).
+    p.add_argument("--data", type=int, default=1)
+    p.add_argument("--stage", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=-1)
+    p.add_argument("--seq", type=int, default=1)
+    p.add_argument("--expert", type=int, default=1)
+    p.add_argument("--tensor", type=int, default=1)
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="pipeline microbatches (0 = stage count)")
+    p.add_argument("--ring-attention", action="store_true",
+                   help="sequence-parallel attention (required when seq>1)")
+    p.add_argument("--data-dir", default="",
+                   help="dir of *.bin token shards; empty = synthetic data")
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="steps between saves (0 = only at the end)")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--json-logs", action="store_true")
+    p.add_argument("--distributed", choices=["auto", "on", "off"],
+                   default="auto")
+    p.add_argument("--dry-run", action="store_true",
+                   help="build everything, run one step, exit")
+    return p
+
+
+def _maybe_init_distributed(mode: str, log) -> None:
+    """JobSet workers carry JAX_COORDINATOR_ADDRESS + TPU_WORKER_ID
+    (topology/jobset.py:53-70); initialize jax.distributed from them."""
+    import jax
+
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    if mode == "off" or (mode == "auto" and not coord):
+        return
+    if not coord:
+        # --distributed on without the JobSet env: let jax auto-detect
+        # (it knows the GKE TPU pod metadata).
+        log.log("info", "jax.distributed init (auto-detect)")
+        jax.distributed.initialize()
+        return
+    worker = int(os.environ.get(
+        "TPU_WORKER_ID", os.environ.get("JOB_COMPLETION_INDEX", "0")))
+    num = int(os.environ.get("NUM_TPU_WORKERS", "0")) or None
+    log.log("info", "jax.distributed init",
+            coordinator=coord, process_id=worker, num_processes=num)
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=num, process_id=worker)
+
+
+def _batches(args, config, batch_size: int, seq_len: int):
+    if args.data_dir:
+        from .data import ShardedTokenPipeline
+
+        return ShardedTokenPipeline(
+            args.data_dir, batch_size, seq_len).batches()
+    from .data import synthetic_batches
+
+    gen = synthetic_batches(config.vocab_size, batch_size, seq_len)
+    return gen
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    from ..utils.logging import Logger
+
+    log = Logger(json_mode=args.json_logs)
+    _maybe_init_distributed(args.distributed, log)
+
+    import jax
+
+    from ..models import get_config
+    from ..ops.ring_attention import make_ring_attention
+    from ..parallel import MeshConfig, create_mesh
+    from ..parallel.mesh import describe_mesh
+    from .trainer import init_state, make_optimizer, make_train_step
+    from .mfu import flops_per_token, mfu as compute_mfu
+
+    config = get_config(args.model)
+    seq_len = args.seq_len or config.max_seq_len
+    mesh_cfg = MeshConfig(
+        data=args.data, stage=args.stage, fsdp=args.fsdp, seq=args.seq,
+        expert=args.expert, tensor=args.tensor)
+    mesh = create_mesh(mesh_cfg)
+    n_devices = mesh.size
+    batch_shards = max(mesh.shape["data"] * mesh.shape["fsdp"], 1)
+    batch_size = args.batch_size or 4 * batch_shards
+    log.log("info", "trainer starting", model=config.name,
+            mesh=describe_mesh(mesh), devices=n_devices,
+            process=jax.process_index(), batch=batch_size,
+            seq_len=seq_len, steps=args.steps)
+
+    if batch_size % batch_shards:
+        log.log("error", "global batch must divide the data*fsdp axes",
+                batch=batch_size, shards=batch_shards)
+        return 2
+    if args.ring_attention and mesh.shape["stage"] > 1:
+        log.log("error", "ring attention cannot combine with pipeline "
+                "stages (shard_map cannot nest inside the stage vmap)")
+        return 2
+
+    attention_fn = None
+    if args.ring_attention or mesh.shape["seq"] > 1:
+        ring = make_ring_attention(mesh)
+        attention_fn = lambda q, k, v, positions: ring(q, k, v)
+
+    opt = make_optimizer(
+        learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
+        decay_steps=max(args.steps, args.warmup_steps + 1))
+    state = init_state(config, mesh, opt)
+    step_fn = make_train_step(
+        config, mesh, opt, attention_fn=attention_fn,
+        microbatches=args.microbatches)
+
+    ckpt = None
+    if args.checkpoint_dir:
+        from .checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(args.checkpoint_dir)
+        if args.resume and ckpt.latest_step() is not None:
+            state = ckpt.restore(state)
+            log.log("info", "resumed", step=int(state.step))
+
+    gen = _batches(args, config, batch_size, seq_len)
+    fpt = flops_per_token(config, seq_len)
+    from ..topology.slices import peak_bf16_tflops_for_kind
+
+    # 0 off-TPU: the mfu field is then omitted rather than wrong.
+    peak = peak_bf16_tflops_for_kind(
+        jax.devices()[0].device_kind) * n_devices
+
+    start_step = int(state.step)
+    if start_step:
+        # Resume: advance the data stream past what the checkpointed run
+        # consumed so no batch is trained twice.
+        log.log("info", "skipping consumed batches", count=start_step)
+        for _ in range(start_step):
+            next(gen)
+    t0 = time.perf_counter()
+    timed_from = start_step
+    tokens_per_step = batch_size * seq_len
+    last_loss = float("nan")
+    for i in range(start_step, args.steps):
+        # Both sources yield int32 numpy [B, S+1]; jit places it on the
+        # mesh directly, no eager host->device staging.
+        state, metrics = step_fn(state, {"tokens": next(gen)["tokens"]})
+        if i == start_step:
+            # Restart the throughput window after the compile step so the
+            # reported tokens/sec is steady-state, not compile-diluted.
+            float(metrics["loss"])
+            t0 = time.perf_counter()
+            timed_from = i + 1
+        if args.dry_run or (i + 1) % args.log_every == 0 \
+                or i + 1 == args.steps:
+            last_loss = float(metrics["loss"])  # device sync
+            dt = time.perf_counter() - t0
+            done = i + 1 - timed_from
+            tps = tokens_per_step * done / max(dt, 1e-9) if done else 0.0
+            fields = dict(step=i + 1, loss=round(last_loss, 4),
+                          tokens_per_sec=round(tps, 1),
+                          tflops=round(tps * fpt / 1e12, 2))
+            if peak:
+                fields["mfu"] = round(compute_mfu(
+                    tps, config, seq_len, peak), 4)
+            log.log("info", "train", **fields)
+        if ckpt and args.checkpoint_every \
+                and (i + 1) % args.checkpoint_every == 0:
+            ckpt.save(i + 1, state)
+            log.log("info", "checkpoint saved", step=i + 1)
+        if args.dry_run:
+            break
+    if ckpt:
+        if ckpt.latest_step() != int(state.step):
+            ckpt.save(int(state.step), state, wait=True)
+            log.log("info", "final checkpoint", step=int(state.step))
+        ckpt.close()
+    log.log("info", "trainer done", final_loss=round(last_loss, 4))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
