@@ -1,0 +1,121 @@
+// Native byte-level BPE encoder for the framework tokenizer.
+//
+// Loads the `tkbpe v1` model file written by
+// triton_kubernetes_tpu/utils/tokenizer.py and encodes byte strings with
+// the same iterative lowest-rank merge, producing bit-identical ids to the
+// Python fallback (pinned by tests/test_tokenizer.py). Training and
+// decoding stay in Python — encode is the only hot path (data prep feeds
+// the trainer; serving feeds generate()).
+//
+// C ABI (ctypes):
+//   void* tok_load(const char* path);        // NULL on error
+//   int   tok_encode(void* h, const char* text, int len,
+//                    int32_t* out, int max_out);  // -1 on error, else n
+//   void  tok_free(void* h);
+//   const char* tok_error();                 // last load error, thread-local
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Model {
+  // (a << 20 | b) -> rank. Ids stay well under 2^20 for sane vocabs.
+  std::unordered_map<uint64_t, int32_t> ranks;
+  int32_t n_merges = 0;
+};
+
+inline uint64_t pair_key(int32_t a, int32_t b) {
+  return (static_cast<uint64_t>(a) << 20) | static_cast<uint64_t>(b);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tok_load(const char* path) {
+  FILE* f = std::fopen(path, "r");
+  if (!f) {
+    g_error = std::string("cannot open ") + path;
+    return nullptr;
+  }
+  char magic[16];
+  int n = 0;
+  if (std::fscanf(f, "%7s%7s%d", magic, magic + 8, &n) != 3 ||
+      std::strcmp(magic, "tkbpe") != 0 || std::strcmp(magic + 8, "v1") != 0 ||
+      n < 0 || n > (1 << 20) - 300) {
+    g_error = std::string("bad header in ") + path;
+    std::fclose(f);
+    return nullptr;
+  }
+  auto* m = new Model;
+  m->n_merges = n;
+  m->ranks.reserve(static_cast<size_t>(n) * 2);
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t a, b;
+    if (std::fscanf(f, "%d%d", &a, &b) != 2 || a < 0 || b < 0 ||
+        a >= 256 + i || b >= 256 + i) {
+      g_error = std::string("bad merge line in ") + path;
+      std::fclose(f);
+      delete m;
+      return nullptr;
+    }
+    m->ranks.emplace(pair_key(a, b), i);
+  }
+  std::fclose(f);
+  return m;
+}
+
+int tok_encode(void* h, const char* text, int len, int32_t* out, int max_out) {
+  if (!h || len < 0) return -1;
+  const auto* m = static_cast<const Model*>(h);
+  std::vector<int32_t> ids(len);
+  for (int i = 0; i < len; ++i)
+    ids[i] = static_cast<uint8_t>(text[i]);
+
+  // Iterative lowest-rank merge: each round finds the best-ranked adjacent
+  // pair present and fuses all its non-overlapping occurrences
+  // left-to-right — identical semantics to the Python fallback.
+  while (ids.size() > 1) {
+    int32_t best_rank = -1;
+    uint64_t best_key = 0;
+    for (size_t i = 0; i + 1 < ids.size(); ++i) {
+      auto it = m->ranks.find(pair_key(ids[i], ids[i + 1]));
+      if (it != m->ranks.end() &&
+          (best_rank < 0 || it->second < best_rank)) {
+        best_rank = it->second;
+        best_key = pair_key(ids[i], ids[i + 1]);
+      }
+    }
+    if (best_rank < 0) break;
+    const int32_t a = static_cast<int32_t>(best_key >> 20);
+    const int32_t b = static_cast<int32_t>(best_key & ((1 << 20) - 1));
+    const int32_t fused = 256 + best_rank;
+    size_t w = 0;
+    for (size_t i = 0; i < ids.size();) {
+      if (i + 1 < ids.size() && ids[i] == a && ids[i + 1] == b) {
+        ids[w++] = fused;
+        i += 2;
+      } else {
+        ids[w++] = ids[i++];
+      }
+    }
+    ids.resize(w);
+  }
+
+  if (static_cast<int>(ids.size()) > max_out) return -1;
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return static_cast<int>(ids.size());
+}
+
+void tok_free(void* h) { delete static_cast<Model*>(h); }
+
+const char* tok_error() { return g_error.c_str(); }
+
+}  // extern "C"

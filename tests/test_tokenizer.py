@@ -1,0 +1,102 @@
+"""Byte-level BPE tokenizer: training, roundtrip, native-vs-Python parity."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from triton_kubernetes_tpu.utils.tokenizer import BpeTokenizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native")
+LIB = os.path.join(NATIVE_DIR, "libtktok.so")
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "pack my box with five dozen liquor jugs",
+    "the five boxing wizards jump quickly",
+    "sphinx of black quartz judge my vow",
+    "how vexingly quick daft zebras jump",
+] * 4
+
+
+def _ensure_lib() -> bool:
+    if os.path.isfile(LIB):
+        return True
+    if shutil.which("g++") is None:
+        return False
+    return subprocess.run(["make", "-C", NATIVE_DIR],
+                          capture_output=True).returncode == 0
+
+
+needs_native = pytest.mark.skipif(
+    not _ensure_lib(), reason="g++ unavailable; native lib not built")
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return BpeTokenizer.train(CORPUS, vocab_size=300)
+
+
+def test_training_learns_merges(tok):
+    assert len(tok.merges) > 10
+    assert tok.vocab_size == 259 + len(tok.merges)
+    # Common text compresses below raw byte length.
+    ids = tok.encode("the quick brown fox")
+    assert len(ids) < len("the quick brown fox")
+
+
+def test_roundtrip_utf8_and_binary(tok):
+    for text in ["hello world", "héllo wörld 😀", "", "a", "日本語テキスト"]:
+        assert tok.decode(tok.encode(text)) == text
+    raw = bytes(range(256))
+    assert tok.decode_bytes(tok.encode(raw)) == raw
+
+
+def test_specials_and_bounds(tok):
+    ids = tok.encode("hi", add_bos=True, add_eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert tok.decode(ids) == "hi"  # specials decode to nothing
+    with pytest.raises(ValueError, match="out of range"):
+        tok.decode_bytes([tok.vocab_size])
+
+
+def test_save_load_identical(tok, tmp_path):
+    path = str(tmp_path / "tok.model")
+    tok.save(path)
+    tok2 = BpeTokenizer.load(path)
+    for text in CORPUS:
+        assert tok2.encode(text, native=False) == tok.encode(
+            text, native=False)
+
+
+def test_training_deterministic():
+    a = BpeTokenizer.train(CORPUS, vocab_size=280)
+    b = BpeTokenizer.train(CORPUS, vocab_size=280)
+    assert a.merges == b.merges
+
+
+@needs_native
+def test_native_matches_python(tok, tmp_path):
+    path = str(tmp_path / "tok.model")
+    tok.save(path)
+    t = BpeTokenizer.load(path)
+    cases = CORPUS + ["héllo wörld 😀", "", "zzz unseen bytes \x00\x7f",
+                      "the the the the"]
+    for text in cases:
+        native = t.encode(text, native=True)
+        python = t.encode(text, native=False)
+        assert native == python, text
+
+
+@needs_native
+def test_native_rejects_garbage_model(tmp_path):
+    bad = tmp_path / "bad.model"
+    bad.write_text("not a model\n")
+    import ctypes
+
+    lib = ctypes.CDLL(LIB)
+    lib.tok_load.restype = ctypes.c_void_p
+    lib.tok_load.argtypes = [ctypes.c_char_p]
+    assert lib.tok_load(str(bad).encode()) is None
