@@ -17,6 +17,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <queue>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -79,33 +80,46 @@ int tok_encode(void* h, const char* text, int len, int32_t* out, int max_out) {
   for (int i = 0; i < len; ++i)
     ids[i] = static_cast<uint8_t>(text[i]);
 
-  // Iterative lowest-rank merge: each round finds the best-ranked adjacent
-  // pair present and fuses all its non-overlapping occurrences
-  // left-to-right — identical semantics to the Python fallback.
-  while (ids.size() > 1) {
-    int32_t best_rank = -1;
-    uint64_t best_key = 0;
-    for (size_t i = 0; i + 1 < ids.size(); ++i) {
-      auto it = m->ranks.find(pair_key(ids[i], ids[i + 1]));
-      if (it != m->ranks.end() &&
-          (best_rank < 0 || it->second < best_rank)) {
-        best_rank = it->second;
-        best_key = pair_key(ids[i], ids[i + 1]);
-      }
+  // Lowest-rank-first merge via lazy min-heap over a doubly-linked list:
+  // O(n log n) instead of the naive rescan-per-round O(n * merges), which
+  // was quadratic on multi-MB documents. Ordering (rank asc, position asc)
+  // reproduces the round-based "fuse all occurrences of the globally best
+  // pair left-to-right" semantics of the Python fallback exactly: fusing a
+  // pair can never create a new occurrence of the same pair (fused id >
+  // both halves), and position order equals left-to-right order, so the
+  // merge sequence is identical.
+  if (len > 1) {
+    std::vector<int32_t> prev(len), next(len);
+    for (int i = 0; i < len; ++i) {
+      prev[i] = i - 1;
+      next[i] = (i + 1 < len) ? i + 1 : -1;
     }
-    if (best_rank < 0) break;
-    const int32_t a = static_cast<int32_t>(best_key >> 20);
-    const int32_t b = static_cast<int32_t>(best_key & ((1 << 20) - 1));
-    const int32_t fused = 256 + best_rank;
+    // (rank, left-position); lazily invalidated.
+    using Entry = std::pair<int32_t, int32_t>;
+    std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap;
+    auto push_pair = [&](int32_t i) {
+      if (i < 0 || next[i] < 0) return;
+      auto it = m->ranks.find(pair_key(ids[i], ids[next[i]]));
+      if (it != m->ranks.end()) heap.emplace(it->second, i);
+    };
+    std::vector<bool> dead(len, false);
+    for (int i = 0; i + 1 < len; ++i) push_pair(i);
+    while (!heap.empty()) {
+      auto [rank, i] = heap.top();
+      heap.pop();
+      if (dead[i] || next[i] < 0) continue;
+      auto it = m->ranks.find(pair_key(ids[i], ids[next[i]]));
+      if (it == m->ranks.end() || it->second != rank) continue;  // stale
+      const int32_t j = next[i];
+      ids[i] = 256 + rank;
+      dead[j] = true;
+      next[i] = next[j];
+      if (next[j] >= 0) prev[next[j]] = i;
+      push_pair(prev[i]);
+      push_pair(i);
+    }
     size_t w = 0;
-    for (size_t i = 0; i < ids.size();) {
-      if (i + 1 < ids.size() && ids[i] == a && ids[i + 1] == b) {
-        ids[w++] = fused;
-        i += 2;
-      } else {
-        ids[w++] = ids[i++];
-      }
-    }
+    for (int32_t i = 0; i >= 0; i = next[i]) ids[w++] = ids[i];
     ids.resize(w);
   }
 

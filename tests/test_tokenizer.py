@@ -100,3 +100,29 @@ def test_native_rejects_garbage_model(tmp_path):
     lib.tok_load.restype = ctypes.c_void_p
     lib.tok_load.argtypes = [ctypes.c_char_p]
     assert lib.tok_load(str(bad).encode()) is None
+
+
+def test_load_rejects_out_of_range_merge(tmp_path):
+    # Forward reference: merge 0 may only use byte ids < 256.
+    fwd = tmp_path / "fwd.model"
+    fwd.write_text("tkbpe v1 2\n97 257\n98 99\n")
+    with pytest.raises(ValueError, match="merge 0"):
+        BpeTokenizer.load(str(fwd))
+    # Negative id must not silently index from the end of the vocab.
+    neg = tmp_path / "neg.model"
+    neg.write_text("tkbpe v1 1\n-1 98\n")
+    with pytest.raises(ValueError, match="merge 0"):
+        BpeTokenizer.load(str(neg))
+
+
+@needs_native
+def test_native_matches_python_large_document(tok, tmp_path):
+    # The heap-based native encoder must stay bit-identical to the Python
+    # round-based merge on document-sized input (exercises stale-heap-entry
+    # invalidation and the overlapping "aaa" self-pair case at scale).
+    path = str(tmp_path / "tok.model")
+    tok.save(path)
+    t = BpeTokenizer.load(path)
+    doc = ("the quick brown fox " * 500) + ("aaaa" * 300) + "".join(
+        CORPUS * 20)
+    assert t.encode(doc, native=True) == t.encode(doc, native=False)

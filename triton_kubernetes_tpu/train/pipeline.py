@@ -63,9 +63,13 @@ def pipeline_forward(
 ):
     """Pipelined equivalent of ``models.llama.forward``.
 
-    Returns (logits [B, S_len, V] f32, moe aux loss scalar). Numerically
-    identical to the sequential forward (same params, same layer order) up
-    to reduction-order noise.
+    Returns (logits [B, S_len, V] f32, moe aux loss scalar). For dense
+    configs this is numerically identical to the sequential forward (same
+    params, same layer order) up to reduction-order noise. For MoE configs
+    it is NOT: capacity-based routing runs per microbatch, so which tokens
+    are dropped (and the aux load-balancing loss) genuinely differ from a
+    full-batch forward — pipelined MoE training uses microbatch-local
+    routing/capacity by design.
     """
     attention_fn = attention_fn or llama._dense_attention
     b, s = tokens.shape

@@ -146,9 +146,17 @@ class BpeTokenizer:
                 raise ValueError(f"{path}: not a {_MAGIC} model file")
             n = int(header[2])
             merges = []
-            for _ in range(n):
-                a, b = f.readline().split()
-                merges.append((int(a), int(b)))
+            for i in range(n):
+                line = f.readline()
+                a, b = (int(x) for x in line.split())
+                # Mirror the native loader (tokenizer.cpp tok_load): each
+                # merge may only reference byte tokens or earlier merges.
+                limit = 256 + i
+                if not (0 <= a < limit and 0 <= b < limit):
+                    raise ValueError(
+                        f"{path}: merge {i} references id out of range "
+                        f"[0, {limit}): {line.strip()!r}")
+                merges.append((a, b))
         tok = cls(merges)
         tok._path = path
         return tok
