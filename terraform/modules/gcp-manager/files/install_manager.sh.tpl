@@ -1,0 +1,34 @@
+#!/bin/bash
+# Start the manager control plane and mint API credentials.
+# Reference analog: files/install_rancher_master.sh.tpl (wait for docker,
+# docker run rancher/rancher) + files/setup_rancher.sh.tpl:22-63 (wait for
+# UI, login, mint token, set server-url) — collapsed into one idempotent
+# script whose credentials land in /root/tk8s_api_key.json for the
+# data.external read-back.
+set -euo pipefail
+
+# Wait for the runtime the startup script installs on first boot.
+for i in $(seq 1 60); do
+  command -v docker >/dev/null 2>&1 && docker info >/dev/null 2>&1 && break
+  sleep 5
+done
+
+if ! sudo docker ps --format '{{.Names}}' | grep -q '^tk8s-manager$'; then
+  sudo docker run -d --restart=unless-stopped --name tk8s-manager \
+    -p 80:80 -p 443:443 \
+    -e TK8S_AGENT_IMAGE='${agent_image}' \
+    '${manager_image}'
+fi
+
+# Wait for the API, then mint an admin token (create-or-get: rerunning the
+# provisioner must not rotate credentials out from under saved state).
+for i in $(seq 1 120); do
+  curl -kfsS "https://${host}/v3" >/dev/null 2>&1 && break
+  sleep 5
+done
+
+if ! sudo test -s /root/tk8s_api_key.json; then
+  sudo docker exec tk8s-manager tk8s-admin init-token \
+    %{ if admin_password != "" ~} --admin-password '${admin_password}' %{ endif ~} \
+    --url "https://${host}" --json | sudo tee /root/tk8s_api_key.json >/dev/null
+fi

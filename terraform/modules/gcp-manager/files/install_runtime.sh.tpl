@@ -1,0 +1,17 @@
+#!/bin/bash
+# First-boot startup script: container runtime + optional registry login +
+# manager image pre-pull. Reference analog: files/install_docker_rancher.sh.tpl
+# (docker install, registry login, pre-pull) — rewritten for the tk8s manager.
+set -euo pipefail
+
+if ! command -v docker >/dev/null 2>&1; then
+  curl -fsSL '${docker_engine_install_url}' | sh
+fi
+systemctl enable --now docker
+
+%{ if private_registry != "" ~}
+docker login '${private_registry}' \
+  -u '${private_registry_username}' -p '${private_registry_password}'
+%{ endif ~}
+
+docker pull '${manager_image}' || true

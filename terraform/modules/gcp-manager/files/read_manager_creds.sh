@@ -1,0 +1,15 @@
+#!/bin/bash
+# data.external program: SSH the manager VM and emit its minted credentials
+# as the {url, access_key, secret_key} JSON terraform expects.
+# Reference analog: files/rancher_server.sh (jq-driven data.external that
+# SSH-cats ~/rancher_api_key).
+set -euo pipefail
+
+eval "$(jq -r '@sh "SSH_USER=\(.ssh_user) KEY_PATH=\(.key_path) HOST=\(.host)"')"
+
+KEY_PATH="${KEY_PATH/#\~/$HOME}"
+CREDS=$(ssh -i "$KEY_PATH" -o StrictHostKeyChecking=no \
+  -o UserKnownHostsFile=/dev/null "$SSH_USER@$HOST" \
+  'sudo cat /root/tk8s_api_key.json')
+
+echo "$CREDS" | jq '{url: .url, access_key: .access_key, secret_key: .secret_key}'

@@ -1,0 +1,20 @@
+#!/bin/bash
+# local-exec: fetch GKE credentials and apply the manager's import manifest
+# into the hosted cluster. Reference analog: modules/gke-rancher-k8s/
+# main.tf:50-82 (gcloud auth activate-service-account -> get-credentials ->
+# curl .../v3/import/<token>.yaml | kubectl apply -f - -> gcloud auth revoke).
+set -euo pipefail
+
+: "${GCP_CREDENTIALS:?}" "${GCP_PROJECT:?}" "${GCP_REGION:?}"
+: "${CLUSTER_NAME:?}" "${MANAGER_URL:?}" "${CLUSTER_ID:?}"
+: "${MANAGER_ACCESS_KEY:?}" "${MANAGER_SECRET_KEY:?}"
+
+export KUBECONFIG=$(mktemp)
+trap 'rm -f "$KUBECONFIG"; gcloud auth revoke --quiet >/dev/null 2>&1 || true' EXIT
+
+gcloud auth activate-service-account --key-file="$GCP_CREDENTIALS" --quiet
+gcloud container clusters get-credentials "$CLUSTER_NAME" \
+  --region "$GCP_REGION" --project "$GCP_PROJECT" --quiet
+
+curl -kfsS -u "$MANAGER_ACCESS_KEY:$MANAGER_SECRET_KEY" \
+  "$MANAGER_URL/v3/import/$CLUSTER_ID.yaml" | kubectl apply -f -

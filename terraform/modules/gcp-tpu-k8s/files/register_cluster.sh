@@ -1,0 +1,28 @@
+#!/bin/bash
+# data.external program: create-or-get a cluster registration on the manager.
+# Reference analog: files/rancher_cluster.sh:17-100 — idempotent POST
+# /v3/cluster + clusterregistrationtoken mint + cacerts checksum. Emits
+# {cluster_id, registration_token, ca_checksum} for the module outputs.
+set -euo pipefail
+
+eval "$(jq -r '@sh "MANAGER_URL=\(.manager_url) ACCESS_KEY=\(.access_key) SECRET_KEY=\(.secret_key) CLUSTER_NAME=\(.cluster_name) KIND=\(.kind)"')"
+
+auth=(-u "$ACCESS_KEY:$SECRET_KEY" -kfsS -H 'Content-Type: application/json')
+
+# Create-or-get: look the cluster up by name first.
+existing=$(curl "${auth[@]}" \
+  "$MANAGER_URL/v3/cluster?name=$CLUSTER_NAME" | jq -r '.data[0].id // empty')
+
+if [ -z "$existing" ]; then
+  existing=$(curl "${auth[@]}" -X POST "$MANAGER_URL/v3/cluster" \
+    -d "{\"name\": \"$CLUSTER_NAME\", \"kind\": \"$KIND\"}" | jq -r '.id')
+fi
+
+token=$(curl "${auth[@]}" -X POST "$MANAGER_URL/v3/clusterregistrationtoken" \
+  -d "{\"clusterId\": \"$existing\"}" | jq -r '.token')
+
+ca=$(curl "${auth[@]}" "$MANAGER_URL/v3/settings/cacerts" \
+  | jq -r '.value' | sha256sum | awk '{print $1}')
+
+jq -n --arg id "$existing" --arg token "$token" --arg ca "$ca" \
+  '{cluster_id: $id, registration_token: $token, ca_checksum: $ca}'

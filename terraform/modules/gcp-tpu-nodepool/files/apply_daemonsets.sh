@@ -1,0 +1,21 @@
+#!/bin/bash
+# local-exec: render the TPU host-software DaemonSets with the in-repo
+# renderer (single source of truth with the in-process executor path) and
+# kubectl-apply them into the GKE cluster.
+set -euo pipefail
+
+: "${GCP_CREDENTIALS:?}" "${GCP_PROJECT:?}" "${GCP_REGION:?}"
+: "${GKE_CLUSTER:?}" "${TPU_ACCELERATOR:?}"
+
+export KUBECONFIG=$(mktemp)
+trap 'rm -f "$KUBECONFIG"' EXIT
+
+gcloud auth activate-service-account --key-file="$GCP_CREDENTIALS" --quiet
+gcloud container clusters get-credentials "$GKE_CLUSTER" \
+  --region "$GCP_REGION" --project "$GCP_PROJECT" --quiet
+
+args=(daemonsets --accelerator "$TPU_ACCELERATOR")
+[ -n "${TPU_TOPOLOGY:-}" ] && args+=(--topology "$TPU_TOPOLOGY")
+[ -n "${RUNTIME_IMAGE:-}" ] && args+=(--image "$RUNTIME_IMAGE")
+
+python -m triton_kubernetes_tpu.topology "${args[@]}" | kubectl apply -f -

@@ -1,0 +1,150 @@
+"""The in-repo Terraform HCL tree: structural validity + parity with the
+in-process Python modules, no terraform binary required (the tree is
+authored in Terraform JSON syntax precisely so these checks can run
+anywhere). A live `terraform validate` test runs when the binary exists.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+from triton_kubernetes_tpu.executor.terraform import (
+    TerraformExecutor, default_modules_root)
+from triton_kubernetes_tpu.modules import get_module
+from triton_kubernetes_tpu.state import StateDocument
+from triton_kubernetes_tpu.topology.slices import TPU_GENERATIONS
+
+ROOT = default_modules_root()
+HCL_MODULES = ["gcp-manager", "gcp-tpu-k8s", "gcp-tpu-nodepool", "tpu-jobset"]
+
+
+def _load(module, fname):
+    path = os.path.join(ROOT, module, fname)
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_tree_exists_and_parses():
+    for m in HCL_MODULES:
+        for fname in ("main.tf.json", "variables.tf.json", "outputs.tf.json"):
+            data = _load(m, fname)
+            assert isinstance(data, dict), f"{m}/{fname}"
+
+
+@pytest.mark.parametrize("name", HCL_MODULES)
+def test_variable_and_output_parity_with_python_modules(name):
+    """Every Python-module variable exists in HCL with matching
+    required-ness, and every declared output is exported — the two
+    execution paths accept the same documents and produce the same
+    contract."""
+    py = get_module(f"modules/{name}")
+    hcl_vars = _load(name, "variables.tf.json")["variable"]
+    hcl_outs = _load(name, "outputs.tf.json")["output"]
+    for var in py.VARIABLES:
+        assert var.name in hcl_vars, f"{name}: variable {var.name} missing"
+        has_default = "default" in hcl_vars[var.name]
+        assert has_default != var.required, (
+            f"{name}: variable {var.name} required-ness mismatch "
+            f"(python required={var.required}, hcl default={has_default})")
+    for out in py.OUTPUTS:
+        assert out in hcl_outs, f"{name}: output {out} missing"
+
+
+def test_scripts_exist_and_are_valid_bash():
+    """Every files/ script referenced from a main.tf.json exists and passes
+    `bash -n` (the templated .tpl files are checked for existence only)."""
+    ref_re = re.compile(r"\$\{path\.module\}/(files/[A-Za-z0-9._/-]+)")
+    for m in HCL_MODULES:
+        text = json.dumps(_load(m, "main.tf.json"))
+        refs = set(ref_re.findall(text)) | {
+            f"files/{f}" for f in re.findall(
+                r'path\.module\}/files/([A-Za-z0-9._-]+)', text)}
+        assert refs, f"{m}: no files/ scripts referenced"
+        for rel in refs:
+            path = os.path.join(ROOT, m, rel)
+            assert os.path.isfile(path), f"{m}: missing {rel}"
+            if path.endswith(".sh"):
+                subprocess.run(["bash", "-n", path], check=True)
+
+
+def test_nodepool_locals_mirror_generation_table():
+    """The HCL generation lookup must track topology/slices.py
+    TPU_GENERATIONS — drift would place pools on wrong machine types."""
+    hcl = _load("gcp-tpu-nodepool", "main.tf.json")
+    table = hcl["locals"]["generations"]
+    assert set(table) == set(TPU_GENERATIONS)
+    for gen_name, gen in TPU_GENERATIONS.items():
+        assert table[gen_name]["machine_type"] == gen.machine_type
+        assert table[gen_name]["gke_accelerator"] == gen.gke_accelerator
+        assert gen.chips_per_host == 4  # hardcoded as local.chips_per_host
+
+
+def test_executor_rewrites_sources_to_local_tree(tmp_path):
+    doc = StateDocument("m1", {"module": {
+        "cluster-manager": {"source": "modules/gcp-manager", "name": "m1"},
+        "cluster_gcp-tpu_dev": {
+            "source": "github.com/x/y//terraform/modules/gcp-tpu-k8s?ref=main",
+            "name": "dev"},
+        "job_train": {"source": "modules/not-on-disk", "name": "t"},
+    }})
+    ex = TerraformExecutor(stream_output=False)
+    prepared = ex._rewrite_sources(doc)
+    assert prepared.get("module.cluster-manager.source") == \
+        os.path.join(ROOT, "gcp-manager")
+    # Reference-style github URL resolves by trailing module name too.
+    assert prepared.get("module.cluster_gcp-tpu_dev.source") == \
+        os.path.join(ROOT, "gcp-tpu-k8s")
+    # Unknown-on-disk sources stay untouched (terraform will fetch them).
+    assert prepared.get("module.job_train.source") == "modules/not-on-disk"
+    # The original doc is never mutated.
+    assert doc.get("module.cluster-manager.source") == "modules/gcp-manager"
+
+
+def test_workdir_emits_golden_main_tf_json(tmp_path):
+    """Pin the emitted root document: rewritten sources + output
+    re-exports — the contract the external terraform binary sees."""
+    doc = StateDocument("m1", {"module": {
+        "cluster-manager": {
+            "source": "modules/gcp-manager", "name": "m1",
+            "gcp_path_to_credentials": "/tmp/creds.json",
+            "gcp_project_id": "p1"},
+    }, "terraform": {"backend": {"local": {"path": "/tmp/x.tfstate"}}},
+        "driver": {"name": "local-k8s"}})
+    ex = TerraformExecutor(stream_output=False)
+    with ex._workdir(doc) as cwd:
+        with open(os.path.join(cwd, "main.tf.json")) as f:
+            emitted = json.load(f)
+    mod = emitted["module"]["cluster-manager"]
+    assert mod["source"] == os.path.join(ROOT, "gcp-manager")
+    assert mod["gcp_project_id"] == "p1"
+    # Output re-exports for every declared manager output.
+    for out in get_module("modules/gcp-manager").OUTPUTS:
+        assert emitted["output"][f"cluster-manager__{out}"]["value"] == \
+            f"${{module.cluster-manager.{out}}}"
+    assert emitted["terraform"]["backend"]["local"]["path"] == "/tmp/x.tfstate"
+    # Framework-only keys never reach terraform (unknown root block types
+    # are a hard init error).
+    assert "driver" not in emitted
+
+
+needs_terraform = pytest.mark.skipif(
+    shutil.which("terraform") is None, reason="terraform not installed")
+
+
+@needs_terraform
+@pytest.mark.parametrize("name", HCL_MODULES)
+def test_terraform_validate(name, tmp_path):
+    """Live check when the binary exists: `terraform init -backend=false &&
+    terraform validate` on each module (no cloud credentials needed)."""
+    src = os.path.join(ROOT, name)
+    dst = tmp_path / name
+    shutil.copytree(src, dst)
+    subprocess.run(["terraform", "init", "-backend=false", "-input=false"],
+                   cwd=dst, check=True, capture_output=True)
+    res = subprocess.run(["terraform", "validate", "-no-color"],
+                         cwd=dst, check=False, capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr

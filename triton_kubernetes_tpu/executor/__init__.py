@@ -18,6 +18,7 @@ from .interpolate import (
     resolve,
 )
 from .plan import Plan, PlanAction, diff_states
+from .drivers import driver_names, make_driver, register_driver
 from .engine import (
     ApplyError,
     ExecutorState,
@@ -36,6 +37,9 @@ __all__ = [
     "PlanAction",
     "TerraformExecutor",
     "diff_states",
+    "driver_names",
+    "make_driver",
+    "register_driver",
     "extract_dependencies",
     "module_dependencies",
     "resolve",

@@ -1,0 +1,77 @@
+"""Cloud-driver registry: the document names its driver, the engine builds it.
+
+A state document may carry a top-level ``driver`` block::
+
+    {"driver": {"name": "local-k8s", "provisioner": "kind"}}
+
+Absent block (or ``name: sim``) keeps the in-process
+:class:`~..executor.cloudsim.CloudSimulator` — the default everywhere, and
+the only driver used by workflow unit tests. ``local-k8s`` swaps in the real
+kind/k3d-backed :class:`~.k8s_local.LocalK8sDriver`; every module runs
+unmodified because the driver API is a strict superset of the simulator's.
+
+The driver choice is also persisted inside the executor state's cloud dict
+(``to_dict()["driver"]``), so a destroy driven from a reloaded document
+reconstructs the same driver even if the doc's block was hand-edited away —
+destroying real clusters with the simulator would orphan them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .cloudsim import CloudSimulator
+
+DriverFactory = Callable[[Dict[str, Any], Dict[str, Any]], Any]
+
+_DRIVERS: Dict[str, DriverFactory] = {}
+
+
+def register_driver(name: str, factory: DriverFactory) -> None:
+    _DRIVERS[name] = factory
+
+
+def _make_sim(cfg: Dict[str, Any], state: Dict[str, Any]) -> CloudSimulator:
+    return CloudSimulator(state)
+
+
+def _make_local_k8s(cfg: Dict[str, Any], state: Dict[str, Any]):
+    from .k8s_local import LocalK8sDriver
+
+    return LocalK8sDriver(state, provisioner=cfg.get("provisioner", ""))
+
+
+register_driver("sim", _make_sim)
+register_driver("local-k8s", _make_local_k8s)
+
+
+def driver_names() -> list:
+    return sorted(_DRIVERS)
+
+
+def normalize_driver_config(raw: Any) -> Dict[str, Any]:
+    """Accept the string shorthand (``driver: local-k8s``) or a mapping;
+    reject anything else. Shared by the config layer and the document."""
+    if raw is None:
+        return {}
+    if isinstance(raw, str):
+        return {"name": raw}
+    if isinstance(raw, dict):
+        return dict(raw)
+    raise ValueError(f"driver must be a name or a mapping, got {raw!r}")
+
+
+def driver_config(doc) -> Dict[str, Any]:
+    return normalize_driver_config(doc.get("driver"))
+
+
+def make_driver(doc, cloud_state: Optional[Dict[str, Any]] = None):
+    """Build the driver for a document + its persisted cloud state."""
+    state = cloud_state or {}
+    cfg = driver_config(doc)
+    # Applied state wins: existing real resources must keep their driver.
+    name = state.get("driver") or cfg.get("name") or "sim"
+    if name not in _DRIVERS:
+        raise ValueError(
+            f"unknown driver {name!r} (choices: {sorted(_DRIVERS)})")
+    return _DRIVERS[name](cfg, state)

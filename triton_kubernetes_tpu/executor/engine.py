@@ -28,6 +28,7 @@ from ..state import StateDocument
 from ..modules import get_module
 from ..modules.base import DriverContext
 from .cloudsim import CloudSimulator
+from .drivers import make_driver
 from .interpolate import module_dependencies, resolve, topo_order
 from .plan import Plan, PlanAction, diff_states
 
@@ -184,7 +185,7 @@ class LocalExecutor:
         self._taint_dependents(plan, desired, targets)
         self.log(plan.summary())
 
-        cloud = CloudSimulator(est.cloud)
+        cloud = make_driver(doc, est.cloud)
         order = topo_order(desired)
         outputs: Dict[str, Dict[str, Any]] = {
             name: rec.get("outputs", {}) for name, rec in est.modules.items()
@@ -241,7 +242,7 @@ class LocalExecutor:
         """Destroy targeted modules (or everything when targets is None) —
         RunTerraformDestroyWithState analog (shell/run_terraform.go:104)."""
         est = load_executor_state(doc)
-        cloud = CloudSimulator(est.cloud)
+        cloud = make_driver(doc, est.cloud)
         names = list(est.modules) if targets is None else [
             t for t in targets if t in est.modules
         ]
@@ -296,7 +297,7 @@ class LocalExecutor:
             resolved_rec["config"] = resolve(rec.get("config", {}), outputs)
         except KeyError as e:
             raise ApplyError(f"module {backup_key!r}: {e}") from e
-        cloud = CloudSimulator(est.cloud)
+        cloud = make_driver(doc, est.cloud)
         with self.logger.span("restore", doc=doc.name, backup=backup_key), \
                 tempfile.TemporaryDirectory(prefix="tk-tpu-restore-") as workdir:
             ctx = DriverContext(cloud=cloud, workdir=workdir,
@@ -323,5 +324,7 @@ class LocalExecutor:
         return dict(est.modules[module_key].get("outputs", {}))
 
     def cloud_view(self, doc: StateDocument) -> CloudSimulator:
-        """Read-only view of the simulated cloud (tests, `get` inspection)."""
+        """Read-only view of the driver's cloud state (tests, `get`
+        inspection). Always a plain simulator over the persisted dict — a
+        read must never require (or touch) the real provisioner."""
         return CloudSimulator(load_executor_state(doc).cloud)

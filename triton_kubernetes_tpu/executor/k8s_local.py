@@ -1,0 +1,253 @@
+"""Real local Kubernetes driver: kind/k3d-backed implementation of the
+cloud-driver API.
+
+This is the first driver where ``create cluster`` provisions something real:
+the bare-metal provider pointed at this driver stands up an actual local
+Kubernetes cluster (kind preferred, k3d fallback) and ``apply_manifest``
+really ``kubectl apply``s into it, so BASELINE config 1 ("hello-world
+Deployment runs") is a genuine pod, not a simulator record.
+
+Reference analog: modules/bare-metal-rancher/main.tf:1-121 — the reference's
+cheapest real path is an existing host over SSH on which Rancher+RKE stand up
+Kubernetes. SURVEY.md §7 phase 3 prescribes kind/k3s as the local stand-in
+for that Rancher+RKE pair; this driver is that stand-in.
+
+Design: ``LocalK8sDriver`` subclasses :class:`CloudSimulator` so every module
+runs unmodified — the simulator's control-plane bookkeeping (manager creds,
+registration tokens, CA checksums — the rancher_cluster.sh contract) stays
+the source of truth for the workflow layer, while cluster creation, manifest
+application, node labels, and teardown additionally hit the real local
+cluster. All subprocess access goes through one injectable runner so unit
+tests can pin the exact command sequences without the binaries installed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from typing import Any, Callable, Dict, List, Optional
+
+from .cloudsim import CloudSimError, CloudSimulator
+
+# Runner signature: (argv, input_text|None, capture: bool) -> stdout text.
+Runner = Callable[[List[str], Optional[str], bool], str]
+
+
+class LocalK8sError(CloudSimError):
+    pass
+
+
+def _run_subprocess(argv: List[str], input_text: Optional[str] = None,
+                    capture: bool = True) -> str:
+    try:
+        proc = subprocess.run(
+            argv, input=input_text, text=True, check=True,
+            capture_output=capture)
+    except FileNotFoundError as e:
+        raise LocalK8sError(f"binary not found: {argv[0]!r}") from e
+    except subprocess.CalledProcessError as e:
+        detail = (e.stderr or "").strip()[-2000:]
+        raise LocalK8sError(
+            f"{' '.join(argv[:3])} failed (rc={e.returncode}): {detail}") from e
+    return proc.stdout or ""
+
+
+def default_kubeconfig_dir() -> str:
+    return os.path.expanduser("~/.triton-kubernetes-tpu/kubeconfigs")
+
+
+class Provisioner:
+    """One local-cluster tool. Cluster names are prefixed ``tk8s-`` so
+    ``delete`` can never touch a user's unrelated local clusters."""
+
+    BINARY = ""
+
+    def __init__(self, runner: Runner):
+        self._run = runner
+
+    def real_name(self, cluster_name: str) -> str:
+        return f"tk8s-{cluster_name}"
+
+    def available(self) -> bool:
+        return shutil.which(self.BINARY) is not None
+
+    def exists(self, cluster_name: str) -> bool:
+        raise NotImplementedError
+
+    def create(self, cluster_name: str, kubeconfig: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, cluster_name: str) -> None:
+        raise NotImplementedError
+
+
+class KindProvisioner(Provisioner):
+    BINARY = "kind"
+
+    def exists(self, cluster_name: str) -> bool:
+        out = self._run([self.BINARY, "get", "clusters"], None, True)
+        return self.real_name(cluster_name) in out.split()
+
+    def create(self, cluster_name: str, kubeconfig: str) -> None:
+        self._run([self.BINARY, "create", "cluster",
+                   "--name", self.real_name(cluster_name),
+                   "--kubeconfig", kubeconfig,
+                   "--wait", "180s"], None, False)
+
+    def delete(self, cluster_name: str) -> None:
+        self._run([self.BINARY, "delete", "cluster",
+                   "--name", self.real_name(cluster_name)], None, False)
+
+
+class K3dProvisioner(Provisioner):
+    BINARY = "k3d"
+
+    def exists(self, cluster_name: str) -> bool:
+        out = self._run([self.BINARY, "cluster", "list", "-o", "json"],
+                        None, True)
+        try:
+            clusters = json.loads(out or "[]")
+        except json.JSONDecodeError:
+            return False
+        return any(c.get("name") == self.real_name(cluster_name)
+                   for c in clusters)
+
+    def create(self, cluster_name: str, kubeconfig: str) -> None:
+        name = self.real_name(cluster_name)
+        self._run([self.BINARY, "cluster", "create", name,
+                   "--kubeconfig-update-default=false",
+                   "--wait", "--timeout", "180s"], None, False)
+        kc = self._run([self.BINARY, "kubeconfig", "get", name], None, True)
+        os.makedirs(os.path.dirname(kubeconfig), exist_ok=True)
+        with open(kubeconfig, "w") as f:
+            f.write(kc)
+
+    def delete(self, cluster_name: str) -> None:
+        self._run([self.BINARY, "cluster", "delete",
+                   self.real_name(cluster_name)], None, False)
+
+
+PROVISIONERS = {"kind": KindProvisioner, "k3d": K3dProvisioner}
+
+
+def detect_provisioner(runner: Runner = _run_subprocess,
+                       preferred: str = "") -> Provisioner:
+    if preferred:
+        if preferred not in PROVISIONERS:
+            raise LocalK8sError(
+                f"unknown provisioner {preferred!r} "
+                f"(choices: {sorted(PROVISIONERS)})")
+        return PROVISIONERS[preferred](runner)
+    for name in ("kind", "k3d"):
+        p = PROVISIONERS[name](runner)
+        if p.available():
+            return p
+    raise LocalK8sError(
+        "no local Kubernetes provisioner found (need `kind` or `k3d` on "
+        "PATH) — install one, or use the default simulator driver")
+
+
+class LocalK8sDriver(CloudSimulator):
+    """CloudSimulator subclass whose Kubernetes-facing surface is real."""
+
+    DRIVER_NAME = "local-k8s"
+
+    def __init__(self, state: Optional[Dict[str, Any]] = None,
+                 provisioner: str = "", runner: Runner = _run_subprocess,
+                 kubeconfig_dir: Optional[str] = None):
+        super().__init__(state)
+        s = state or {}
+        self._runner = runner
+        self.kubeconfig_dir = (kubeconfig_dir or s.get("kubeconfig_dir")
+                               or default_kubeconfig_dir())
+        # Persisted state wins over config: resources provisioned by one
+        # tool must be destroyed by the same tool, or they orphan.
+        self.provisioner = detect_provisioner(
+            runner, preferred=s.get("provisioner") or provisioner)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = super().to_dict()
+        d["driver"] = self.DRIVER_NAME
+        d["provisioner"] = self.provisioner.BINARY
+        d["kubeconfig_dir"] = self.kubeconfig_dir
+        return d
+
+    # ----------------------------------------------------------- kubectl
+    def kubeconfig_path(self, cluster_id: str) -> str:
+        return os.path.join(self.kubeconfig_dir, f"{cluster_id}.yaml")
+
+    def kubectl(self, cluster_id: str, args: List[str],
+                input_text: Optional[str] = None, capture: bool = True) -> str:
+        self.cluster_by_id(cluster_id)  # raises on unknown id
+        kc = self.kubeconfig_path(cluster_id)
+        if not os.path.isfile(kc):
+            raise LocalK8sError(
+                f"no kubeconfig for cluster {cluster_id!r} at {kc} "
+                "(was the cluster provisioned by this driver?)")
+        return self._runner(["kubectl", "--kubeconfig", kc, *args],
+                            input_text, capture)
+
+    # ------------------------------------------------------ control plane
+    def create_or_get_cluster(self, manager_url: str, cluster_name: str,
+                              **attrs: Any) -> Dict[str, Any]:
+        cluster = super().create_or_get_cluster(
+            manager_url, cluster_name, **attrs)
+        if not self.provisioner.exists(cluster_name):
+            kc = self.kubeconfig_path(cluster["id"])
+            os.makedirs(self.kubeconfig_dir, exist_ok=True)
+            self.provisioner.create(cluster_name, kc)
+        cluster["kubeconfig"] = self.kubeconfig_path(cluster["id"])
+        cluster["provisioner"] = self.provisioner.BINARY
+        return cluster
+
+    def register_node(self, registration_token: str, hostname: str,
+                      roles: List[str], labels: Optional[Dict[str, str]] = None,
+                      ca_checksum: str = "") -> Dict[str, Any]:
+        node = super().register_node(
+            registration_token, hostname, roles, labels, ca_checksum)
+        # The local cluster's nodes were created by the provisioner, not by
+        # the host module; registration projects the host labels onto the
+        # real node(s). On the 1-node BASELINE config this is exact.
+        cluster_id = next(
+            c["id"] for c in self.clusters.values()
+            if c["registration_token"] == registration_token)
+        if labels:
+            label_args = [f"{k}={v}" for k, v in sorted(labels.items())]
+            self.kubectl(cluster_id,
+                         ["label", "nodes", "--all", "--overwrite",
+                          *label_args], capture=False)
+        return node
+
+    # -------------------------------------------------------- manifests
+    def apply_manifest(self, cluster_id: str, manifest: Dict[str, Any]) -> None:
+        super().apply_manifest(cluster_id, manifest)
+        self.kubectl(cluster_id, ["apply", "-f", "-"],
+                     input_text=json.dumps(manifest), capture=False)
+
+    def delete_manifest(self, cluster_id: str, kind: str, name: str) -> bool:
+        existed = super().delete_manifest(cluster_id, kind, name)
+        if existed:
+            self.kubectl(cluster_id,
+                         ["delete", kind.lower(), name, "--ignore-not-found"],
+                         capture=False)
+        return existed
+
+    def wait_rollout(self, cluster_id: str, name: str,
+                     kind: str = "deployment", timeout: str = "120s") -> str:
+        """Block until the workload is actually running real pods."""
+        return self.kubectl(cluster_id,
+                            ["rollout", "status", f"{kind}/{name}",
+                             f"--timeout={timeout}"])
+
+    # --------------------------------------------------------- teardown
+    def delete_resource(self, rtype: str, name: str) -> None:
+        if rtype == "cluster" and name in self.clusters:
+            cluster = self.clusters[name]
+            if self.provisioner.exists(cluster["name"]):
+                self.provisioner.delete(cluster["name"])
+            kc = self.kubeconfig_path(name)
+            if os.path.isfile(kc):
+                os.unlink(kc)
+        super().delete_resource(rtype, name)

@@ -27,13 +27,25 @@ class TerraformNotFoundError(RuntimeError):
     pass
 
 
+def default_modules_root() -> str:
+    """The in-repo HCL module tree (terraform/modules/**) shipped alongside
+    the package — the real-provisioning counterpart of the in-process module
+    registry."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "terraform", "modules")
+
+
 class TerraformExecutor:
     def __init__(self, binary: str = "terraform",
                  plugin_dir: Optional[str] = None,
-                 stream_output: bool = True):
+                 stream_output: bool = True,
+                 modules_root: Optional[str] = None):
         self.binary = binary
         self.plugin_dir = plugin_dir
         self.stream_output = stream_output
+        self.modules_root = (default_modules_root() if modules_root is None
+                             else modules_root)
 
     def _require_binary(self) -> str:
         path = shutil.which(self.binary)
@@ -49,9 +61,39 @@ class TerraformExecutor:
             kwargs.update(capture_output=True)
         subprocess.run([self._require_binary(), *args], **kwargs)
 
+    def _rewrite_sources(self, doc: StateDocument) -> StateDocument:
+        """Point registry-style sources (``modules/<name>`` or the
+        reference's ``github.com/...//terraform/modules/<name>?ref=...``
+        form) at the in-repo HCL tree when the module exists there — the
+        source_url/source_ref local-dev redirect (docs/guide/README.md:
+        104-118 in the reference), applied automatically."""
+        from ..modules.registry import module_name_from_source
+
+        prepared = doc.copy()
+        if not self.modules_root or not os.path.isdir(self.modules_root):
+            return prepared
+        for key in list(prepared.module_keys()):
+            source = (prepared.get(f"module.{key}") or {}).get("source", "")
+            try:
+                name = module_name_from_source(source)
+            except Exception:
+                continue
+            local = os.path.join(self.modules_root, name)
+            if os.path.isdir(local):
+                prepared.set(f"module.{key}.source", local)
+        return prepared
+
+    # Framework-only document keys that must not reach terraform (it rejects
+    # unknown root block types in main.tf.json).
+    NON_TERRAFORM_KEYS = ("driver",)
+
     def _workdir(self, doc: StateDocument) -> tempfile.TemporaryDirectory:
         td = tempfile.TemporaryDirectory(prefix="tk-tpu-tf-")
-        prepared = self._with_output_exports(doc)
+        # Exports first: rewriting turns sources into absolute paths the
+        # registry can no longer resolve to module classes.
+        prepared = self._rewrite_sources(self._with_output_exports(doc))
+        for key in self.NON_TERRAFORM_KEYS:
+            prepared.delete(key)
         with open(os.path.join(td.name, "main.tf.json"), "wb") as f:
             f.write(prepared.to_bytes())
         if self.plugin_dir and os.path.isdir(self.plugin_dir):

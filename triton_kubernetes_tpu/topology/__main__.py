@@ -1,0 +1,90 @@
+"""Manifest render CLI: the seam between the Terraform HCL modules and the
+in-process render code.
+
+The HCL modules under ``terraform/modules/`` provision cloud resources with
+real providers, but their Kubernetes payloads (TPU runtime DaemonSets,
+device plugin, slice-health probe, JobSet + headless service) are rendered
+by THIS command and piped to ``kubectl apply -f -`` — one render
+implementation for both execution paths, so the in-process simulator tests
+pin exactly what the real path applies.
+
+Usage:
+    python -m triton_kubernetes_tpu.topology daemonsets \
+        --accelerator v5p-64 [--topology 4x4x4] [--image IMG]
+    python -m triton_kubernetes_tpu.topology jobset \
+        --name train --accelerator v5p-64 --slice-id cluster-pool \
+        [--topology TxTxT] [--image IMG] [--namespace NS] \
+        [--env K=V ...] [--command CMD ARGS...]
+
+Output: a Kubernetes List object (JSON) on stdout — kubectl-applyable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+from .daemonsets import (
+    render_slice_health_daemonset,
+    render_tpu_device_plugin,
+    render_tpu_runtime_daemonset,
+)
+from .jobset import render_headless_service, render_jobset
+from .slices import SliceSpec
+
+
+def _as_list(items: List[Dict[str, Any]]) -> Dict[str, Any]:
+    return {"apiVersion": "v1", "kind": "List", "items": items}
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="triton_kubernetes_tpu.topology")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    ds = sub.add_parser("daemonsets", help="TPU host-software DaemonSets")
+    ds.add_argument("--accelerator", required=True)
+    ds.add_argument("--topology", default="")
+    ds.add_argument("--image", default="")
+
+    js = sub.add_parser("jobset", help="multi-host JAX workload")
+    js.add_argument("--name", required=True)
+    js.add_argument("--accelerator", required=True)
+    js.add_argument("--slice-id", required=True)
+    js.add_argument("--topology", default="")
+    js.add_argument("--image", default="tk8s/jax-tpu-runtime:0.1.0")
+    js.add_argument("--namespace", default="default")
+    js.add_argument("--env", action="append", default=[],
+                    metavar="K=V")
+    js.add_argument("--command", nargs=argparse.REMAINDER,
+                    default=["python", "-m", "triton_kubernetes_tpu.train"])
+
+    args = parser.parse_args(argv)
+    spec = SliceSpec.from_accelerator(args.accelerator, args.topology or None)
+
+    if args.cmd == "daemonsets":
+        kwargs = {"image": args.image} if args.image else {}
+        items = [render_tpu_runtime_daemonset(spec, **kwargs),
+                 render_tpu_device_plugin(spec),
+                 render_slice_health_daemonset(spec, **kwargs)]
+    else:
+        env = {}
+        for kv in args.env:
+            if "=" not in kv:
+                parser.error(f"--env expects K=V, got {kv!r}")
+            k, v = kv.split("=", 1)
+            env[k] = v
+        command = args.command or ["python", "-m", "triton_kubernetes_tpu.train"]
+        items = [render_headless_service(args.name, args.namespace),
+                 render_jobset(args.name, spec, args.slice_id,
+                               image=args.image, command=command,
+                               namespace=args.namespace, env=env)]
+
+    json.dump(_as_list(items), sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,23 @@ def new_manager(ctx: WorkflowContext) -> str:
     state = ctx.backend.state(name)
     MANAGER_PROVIDERS[provider](ctx, state, name)
 
+    # Optional silent-config key: pick a real cloud driver instead of the
+    # in-process simulator (e.g. `driver: local-k8s` stands up actual kind/
+    # k3d clusters for the bare-metal provider — BASELINE config 1). Never
+    # prompted: the default driver is always valid.
+    if ctx.config.is_set("driver"):
+        from ..executor.drivers import driver_names, normalize_driver_config
+
+        try:
+            cfg = normalize_driver_config(ctx.config.get("driver"))
+        except ValueError as e:
+            raise WorkflowError(str(e)) from e
+        if cfg.get("name") not in driver_names():
+            raise WorkflowError(
+                f"unknown driver {cfg.get('name')!r} "
+                f"(choices: {driver_names()})")
+        state.set("driver", cfg)
+
     if not r.confirm("confirm", f"Proceed? This will create cluster manager '{name}'"):
         return ""
 
