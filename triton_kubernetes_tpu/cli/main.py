@@ -75,6 +75,37 @@ def choose_backend(resolver: InputResolver) -> Backend:
     return ObjectStoreBackend(DirObjectStore(str(bucket)), bucket_hint=str(bucket))
 
 
+def choose_executor(resolver: InputResolver, logger):
+    """Executor selection via the ``executor:`` config key.
+
+    Like the ``driver:`` key this is never prompted — the default
+    (in-process :class:`LocalExecutor`) is always valid. ``executor:
+    terraform`` swaps in :class:`TerraformExecutor`, which writes the doc as
+    ``main.tf.json`` and shells out to a real ``terraform`` binary — the
+    reference's only execution path (shell/run_terraform.go:63-104, called
+    from create/manager.go:146). Tuning keys: ``terraform_binary``,
+    ``terraform_plugin_dir``, ``terraform_modules_root``.
+    """
+    cfg = resolver.config
+    kind = cfg.get("executor") if cfg.is_set("executor") else "local"
+    if kind == "local":
+        return LocalExecutor(log=logger.info, logger=logger)
+    if kind == "terraform":
+        from ..executor.terraform import TerraformExecutor
+
+        kwargs = {}
+        if cfg.is_set("terraform_binary"):
+            kwargs["binary"] = str(cfg.get("terraform_binary"))
+        if cfg.is_set("terraform_plugin_dir"):
+            kwargs["plugin_dir"] = str(cfg.get("terraform_plugin_dir"))
+        if cfg.is_set("terraform_modules_root"):
+            kwargs["modules_root"] = str(cfg.get("terraform_modules_root"))
+        return TerraformExecutor(**kwargs)
+    raise ValidationError(
+        f"executor: {kind!r} is not a valid choice "
+        f"(valid: ['local', 'terraform'])")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="triton-kubernetes-tpu",
@@ -141,8 +172,8 @@ def main(argv: Optional[List[str]] = None,
 
     try:
         be = backend if backend is not None else choose_backend(resolver)
-        ex = executor if executor is not None else LocalExecutor(
-            log=logger.info, logger=logger)
+        ex = executor if executor is not None else choose_executor(
+            resolver, logger)
         ctx = WorkflowContext(backend=be, executor=ex, resolver=resolver)
 
         if args.command == "create":

@@ -56,10 +56,21 @@ class TerraformExecutor:
 
     def _run(self, args: List[str], cwd: str) -> None:
         """Stdio passthrough like the reference (shell/run_shell_cmd.go:10-12)."""
+        from .engine import ApplyError
+
         kwargs: Dict[str, Any] = {"cwd": cwd, "check": True}
         if not self.stream_output:
             kwargs.update(capture_output=True)
-        subprocess.run([self._require_binary(), *args], **kwargs)
+        try:
+            subprocess.run([self._require_binary(), *args], **kwargs)
+        except subprocess.CalledProcessError as e:
+            # A failing terraform run is an ordinary provisioning failure
+            # (bad credentials, quota, plan error) — surface it on the same
+            # logged-error/exit-1 path as in-process apply failures.
+            raise ApplyError(
+                f"terraform {args[0]} failed with exit code {e.returncode}"
+                + (f": {e.stderr.decode(errors='replace').strip()}"
+                   if e.stderr else "")) from e
 
     def _rewrite_sources(self, doc: StateDocument) -> StateDocument:
         """Point registry-style sources (``modules/<name>`` or the
@@ -118,6 +129,18 @@ class TerraformExecutor:
             for t in targets or []:
                 args.append(f"-target=module.{t}")
             self._run(args, cwd)
+
+    def restore(self, doc: StateDocument, backup_key: str) -> str:
+        """The terraform path has no restore verb — the reference CLI never
+        restores either (backup create only, SURVEY.md §5); restoring an
+        Ark/Velero backup is done with the workload's own tooling against the
+        cluster, not by re-running terraform."""
+        from .engine import ApplyError
+
+        raise ApplyError(
+            "restore is not supported by the terraform executor; "
+            "use the workload's backup tooling against the cluster "
+            f"(requested backup: {backup_key!r})")
 
     def output(self, doc: StateDocument, module_key: str) -> Dict[str, Any]:
         """Module outputs via root-level re-exports.
