@@ -1,0 +1,52 @@
+#!/bin/bash
+# First-boot / remote-exec script for a cluster host: container runtime,
+# hostname, optional registry login, optional data-disk mkfs+mount, then the
+# self-registering agent container. Reference analog:
+# files/install_rancher_agent.sh.tpl:1-44 (docker install, hostname set,
+# disk mount, docker run rancher-agent --server --token --ca-checksum
+# --<role>) — rewritten for the tk8s manager contract.
+set -euo pipefail
+
+if ! command -v docker >/dev/null 2>&1; then
+  curl -fsSL '${docker_engine_install_url}' | sh
+fi
+systemctl enable --now docker
+
+hostnamectl set-hostname '${hostname}' || hostname '${hostname}'
+
+%{ if private_registry != "" ~}
+docker login '${private_registry}' \
+  -u '${private_registry_username}' -p '${private_registry_password}'
+%{ endif ~}
+
+%{ if disk_device != "" ~}
+# Optional block storage: the volume attachment lands after first boot
+# (aws_volume_attachment depends on the running instance), so wait for the
+# device before formatting; give up after ~5 min and continue without it —
+# a missing data disk must not keep the node out of the cluster.
+for i in $(seq 1 60); do
+  [ -b '${disk_device}' ] && break
+  sleep 5
+done
+if [ -b '${disk_device}' ]; then
+# Format on first boot only, then mount.
+if ! blkid '${disk_device}' >/dev/null 2>&1; then
+  mkfs.ext4 '${disk_device}'
+fi
+mkdir -p '${disk_mount_path}'
+grep -q '${disk_device}' /etc/fstab || \
+  echo '${disk_device} ${disk_mount_path} ext4 defaults 0 2' >> /etc/fstab
+mountpoint -q '${disk_mount_path}' || mount '${disk_mount_path}'
+fi
+%{ endif ~}
+
+if ! docker ps --format '{{.Names}}' | grep -q '^tk8s-agent$'; then
+  docker run -d --restart=unless-stopped --name tk8s-agent \
+    --net host \
+    -v /var/run/docker.sock:/var/run/docker.sock \
+    '${agent_image}' \
+    --server '${manager_url}' \
+    --token '${registration_token}' \
+    --ca-checksum '${ca_checksum}' \
+    ${roles}
+fi

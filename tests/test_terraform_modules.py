@@ -19,7 +19,12 @@ from triton_kubernetes_tpu.state import StateDocument
 from triton_kubernetes_tpu.topology.slices import TPU_GENERATIONS
 
 ROOT = default_modules_root()
-HCL_MODULES = ["gcp-manager", "gcp-tpu-k8s", "gcp-tpu-nodepool", "tpu-jobset"]
+HCL_MODULES = [
+    "gcp-manager", "gcp-tpu-k8s", "gcp-tpu-nodepool", "tpu-jobset",
+    "aws-manager", "aws-k8s", "aws-k8s-host",
+    "bare-metal-manager", "bare-metal-k8s", "bare-metal-k8s-host",
+    "k8s-backup-gcs", "k8s-backup-s3",
+]
 
 
 def _load(module, fname):
@@ -55,17 +60,17 @@ def test_variable_and_output_parity_with_python_modules(name):
 
 
 def test_scripts_exist_and_are_valid_bash():
-    """Every files/ script referenced from a main.tf.json exists and passes
-    `bash -n` (the templated .tpl files are checked for existence only)."""
-    ref_re = re.compile(r"\$\{path\.module\}/(files/[A-Za-z0-9._/-]+)")
+    """Every files/ script referenced from a main.tf.json — module-local
+    (``files/``) or shared (``../files/``, the reference's modules/files
+    pattern) — exists and passes `bash -n` (the templated .tpl files are
+    checked for existence only)."""
+    ref_re = re.compile(r"\$\{path\.module\}/((?:\.\./)?files/[A-Za-z0-9._/-]+)")
     for m in HCL_MODULES:
         text = json.dumps(_load(m, "main.tf.json"))
-        refs = set(ref_re.findall(text)) | {
-            f"files/{f}" for f in re.findall(
-                r'path\.module\}/files/([A-Za-z0-9._-]+)', text)}
+        refs = set(ref_re.findall(text))
         assert refs, f"{m}: no files/ scripts referenced"
         for rel in refs:
-            path = os.path.join(ROOT, m, rel)
+            path = os.path.normpath(os.path.join(ROOT, m, rel))
             assert os.path.isfile(path), f"{m}: missing {rel}"
             if path.endswith(".sh"):
                 subprocess.run(["bash", "-n", path], check=True)

@@ -152,12 +152,20 @@ class TerraformExecutor:
         root as ``<module_key>__<output>`` (see ``add_output_exports``); this
         reads all root outputs and strips that prefix.
         """
+        from .engine import ApplyError
+
         with self._workdir(doc) as cwd:
             self._run(["init", "-force-copy"], cwd)
-            res = subprocess.run(
-                [self._require_binary(), "output", "-json"],
-                cwd=cwd, check=True, capture_output=True,
-            )
+            try:
+                res = subprocess.run(
+                    [self._require_binary(), "output", "-json"],
+                    cwd=cwd, check=True, capture_output=True,
+                )
+            except subprocess.CalledProcessError as e:
+                raise ApplyError(
+                    f"terraform output failed with exit code {e.returncode}"
+                    + (f": {e.stderr.decode(errors='replace').strip()}"
+                       if e.stderr else "")) from e
             all_outputs = json.loads(res.stdout or b"{}")
             prefix = f"{module_key}__"
             return {

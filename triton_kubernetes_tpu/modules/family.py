@@ -120,6 +120,9 @@ class HostModule(Module):
     OUTPUTS: List[str] = []
     VARIABLES = [
         Variable("hostname", required=True),
+        # Endpoint the agent registers against (reference wires
+        # rancher_api_url into every host module the same way).
+        Variable("manager_url", default=""),
         Variable("rancher_agent_image", default="tk8s/agent:2.0"),
         Variable("rancher_cluster_registration_token", required=True),
         Variable("rancher_cluster_ca_checksum", required=True),

@@ -31,6 +31,12 @@ def new_backup(ctx: WorkflowContext) -> str:
         "source": module_source(ctx, f"k8s-backup-{kind}"),
         "cluster_name": cluster_name,
         "cluster_id": f"${{module.{cluster_key}.cluster_id}}",
+        # Manager credentials for the kubeconfig mint on the real path
+        # (files/setup_backup.sh); reference wires the same via
+        # rancher_api_url/access/secret (create/backup.go base config).
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
     }
     if kind == "gcs":
         cfg["gcp_path_to_credentials"] = r.value(

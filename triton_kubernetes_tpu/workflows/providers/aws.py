@@ -62,9 +62,10 @@ def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
     cfg["aws_instance_type"] = r.choose(
         "aws_instance_type", "AWS Instance Type",
         [(t, t) for t in INSTANCE_TYPES], default=INSTANCE_TYPES[0])
-    # Wire the cluster's network envelope via interpolation.
+    # Wire the cluster's network envelope + keypair via interpolation.
     cfg["aws_subnet_id"] = f"${{module.{cluster_key}.aws_subnet_id}}"
     cfg["aws_security_group_id"] = f"${{module.{cluster_key}.aws_security_group_id}}"
+    cfg["aws_key_name"] = f"${{module.{cluster_key}.aws_key_name}}"
     # Optional EBS volume (aws-rancher-k8s-host/main.tf:47-62 analog).
     device = r.value("ebs_volume_device_name", "EBS Volume Device Name", default="")
     if device:

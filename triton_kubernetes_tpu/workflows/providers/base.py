@@ -92,6 +92,7 @@ def base_node_config(ctx: WorkflowContext, module_name: str,
     return {
         "source": module_source(ctx, module_name),
         "hostname": hostname,
+        "manager_url": "${module.cluster-manager.manager_url}",
         "rancher_cluster_registration_token":
             f"${{module.{cluster_key}.registration_token}}",
         "rancher_cluster_ca_checksum":
