@@ -1,15 +1,26 @@
 #!/bin/bash
 # data.external program: SSH the manager VM and emit its minted credentials
 # as the {url, access_key, secret_key} JSON terraform expects.
-# Reference analog: files/rancher_server.sh (jq-driven data.external that
-# SSH-cats ~/rancher_api_key).
+# Reference analog: files/rancher_server.sh (the data.external that SSH-cats
+# ~/rancher_api_key) — with python3 for JSON handling instead of jq (the
+# operator machine runs a Python CLI, so python3 is always present).
 set -euo pipefail
 
-eval "$(jq -r '@sh "SSH_USER=\(.ssh_user) KEY_PATH=\(.key_path) HOST=\(.host)"')"
+eval "$(python3 -c '
+import json, shlex, sys
+q = json.load(sys.stdin)
+for var, key in (("SSH_USER", "ssh_user"), ("KEY_PATH", "key_path"),
+                 ("HOST", "host")):
+    print(f"{var}={shlex.quote(str(q[key]))}")
+')"
 
 KEY_PATH="${KEY_PATH/#\~/$HOME}"
 CREDS=$(ssh -i "$KEY_PATH" -o StrictHostKeyChecking=no \
   -o UserKnownHostsFile=/dev/null "$SSH_USER@$HOST" \
   'sudo cat /root/tk8s_api_key.json')
 
-echo "$CREDS" | jq '{url: .url, access_key: .access_key, secret_key: .secret_key}'
+echo "$CREDS" | python3 -c '
+import json, sys
+d = json.load(sys.stdin)
+json.dump({k: d[k] for k in ("url", "access_key", "secret_key")}, sys.stdout)
+'

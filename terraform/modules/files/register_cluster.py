@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""terraform data.external program: create-or-get a cluster registration.
+
+Reference analog: files/rancher_cluster.sh:17-100 (idempotent POST
+/v3/cluster + clusterregistrationtoken mint + cacerts sha256) — rewritten as
+stdlib-only Python: the operator machine already runs a Python CLI, so the
+reference's jq/curl prerequisites drop away, and the exact same file is
+exercised against a live manager in tests/test_manager.py. Reads the query
+JSON on stdin ({manager_url, access_key, secret_key, cluster_name, kind}),
+emits {cluster_id, registration_token, ca_checksum} on stdout.
+
+This file intentionally has no triton_kubernetes_tpu imports — terraform
+runs it wherever the operator stands; the in-process twin of these calls is
+triton_kubernetes_tpu/manager/client.py.
+"""
+
+import base64
+import hashlib
+import json
+import ssl
+import sys
+import urllib.parse
+import urllib.request
+
+
+def request(method, url, auth, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method, headers={
+        "Content-Type": "application/json",
+        "Authorization": "Basic "
+        + base64.b64encode(auth.encode()).decode(),
+    })
+    # Self-signed manager certs are the norm (reference curls with -k).
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    with urllib.request.urlopen(req, timeout=60, context=ctx) as resp:
+        return json.load(resp)
+
+
+def main():
+    q = json.load(sys.stdin)
+    base = q["manager_url"].rstrip("/")
+    auth = f"{q['access_key']}:{q['secret_key']}"
+
+    # Create-or-get: look the cluster up by name first
+    # (rancher_cluster.sh:17-28 contract).
+    name_q = urllib.parse.quote(q["cluster_name"], safe="")
+    found = request("GET", f"{base}/v3/cluster?name={name_q}",
+                    auth)["data"]
+    if found:
+        cluster_id = found[0]["id"]
+    else:
+        cluster_id = request("POST", f"{base}/v3/cluster", auth, {
+            "name": q["cluster_name"], "kind": q.get("kind", ""),
+        })["id"]
+
+    token = request("POST", f"{base}/v3/clusterregistrationtoken", auth,
+                    {"clusterId": cluster_id})["token"]
+
+    cacerts = request("GET", f"{base}/v3/settings/cacerts", auth)["value"]
+    checksum = hashlib.sha256(cacerts.encode()).hexdigest()
+
+    json.dump({"cluster_id": cluster_id, "registration_token": token,
+               "ca_checksum": checksum}, sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,11 @@ def test_scripts_exist_and_are_valid_bash():
             assert os.path.isfile(path), f"{m}: missing {rel}"
             if path.endswith(".sh"):
                 subprocess.run(["bash", "-n", path], check=True)
+            elif path.endswith(".py"):
+                # Syntax check without dropping __pycache__ into the
+                # deployable module tree.
+                with open(path) as f:
+                    compile(f.read(), path, "exec")
 
 
 def test_nodepool_locals_mirror_generation_table():

@@ -26,6 +26,8 @@ from __future__ import annotations
 import hashlib
 from typing import Any, Dict, List, Optional
 
+from ..manager import protocol
+
 
 def _token(*parts: str) -> str:
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:40]
@@ -99,8 +101,9 @@ class CloudSimulator:
             self.managers[name] = {
                 "name": name,
                 "url": url,
-                "access_key": f"token-{_token(name, 'access')[:8]}",
-                "secret_key": _token(name, "secret"),
+                # Shared credential derivation with the real control plane
+                # (manager/protocol.py); empty salt keeps tests deterministic.
+                **protocol.mint_credentials(name),
                 "clusters": [],
             }
         self.managers[name]["url"] = url
@@ -121,22 +124,12 @@ class CloudSimulator:
         checksum from /v3/settings/cacerts.
         """
         mgr = self._find_manager(manager_url)
-        for c in self.clusters.values():
-            if c["manager"] == mgr["name"] and c["name"] == cluster_name:
-                c.update(attrs)
-                return c
-        cid = f"c-{_token(mgr['name'], cluster_name)[:8]}"
-        cluster = {
-            "id": cid,
-            "name": cluster_name,
-            "manager": mgr["name"],
-            "registration_token": _token(cid, "reg"),
-            "ca_checksum": _token(cid, "ca"),
-            "nodes": {},
-            **attrs,
-        }
-        self.clusters[cid] = cluster
-        mgr["clusters"].append(cid)
+        # Shared semantic core with the real control plane: same idempotency,
+        # same id/token/CA-checksum derivation (manager/protocol.py).
+        cluster = protocol.create_or_get_cluster(
+            self.clusters, mgr["name"], cluster_name, **attrs)
+        if cluster["id"] not in mgr["clusters"]:
+            mgr["clusters"].append(cluster["id"])
         return cluster
 
     def register_node(self, registration_token: str, hostname: str,
@@ -148,17 +141,12 @@ class CloudSimulator:
         rancher/rancher-agent --server ... --token ... --ca-checksum ...
         --worker|--etcd|--controlplane``). Token+checksum pinning enforced.
         """
-        for c in self.clusters.values():
-            if c["registration_token"] == registration_token:
-                if ca_checksum and ca_checksum != c["ca_checksum"]:
-                    raise CloudSimError(f"CA checksum mismatch for {hostname}")
-                c["nodes"][hostname] = {
-                    "hostname": hostname,
-                    "roles": sorted(roles),
-                    "labels": dict(labels or {}),
-                }
-                return c["nodes"][hostname]
-        raise CloudSimError(f"invalid registration token for {hostname}")
+        try:
+            return protocol.register_node(
+                self.clusters, registration_token, hostname, roles,
+                labels, ca_checksum)
+        except protocol.ProtocolError as e:
+            raise CloudSimError(str(e)) from e
 
     def cluster_by_id(self, cluster_id: str) -> Dict[str, Any]:
         if cluster_id not in self.clusters:

@@ -1,0 +1,24 @@
+"""The tk8s manager control plane.
+
+The reference delegates its control plane to Rancher 2.x and drives it over
+a v3 REST API from bash (SURVEY.md §2.4: setup_rancher.sh.tpl:22-63,
+rancher_cluster.sh:17-100). This package IS that control plane, rebuilt:
+
+* :mod:`.protocol` — the semantic core (credential mint, cluster
+  create-or-get, registration tokens, node join, kubeconfig), shared by the
+  HTTP server, the in-process :class:`~..executor.cloudsim.CloudSimulator`,
+  and the typed client, so every implementation agrees by construction;
+* :mod:`.server` — the HTTP control plane the provisioning scripts talk to
+  (what runs inside the ``tk8s/manager`` image);
+* :mod:`.client` — the in-process typed client with retries, used by
+  workflows/tests instead of shelling out to curl;
+* ``python -m triton_kubernetes_tpu.manager`` — the ``tk8s-admin`` CLI
+  (``serve``, ``init-token``) invoked by files/install_manager.sh.tpl.
+"""
+
+from .client import ManagerClient, ManagerClientError
+from .protocol import ProtocolError
+from .server import ManagerServer
+
+__all__ = ["ManagerClient", "ManagerClientError", "ManagerServer",
+           "ProtocolError"]

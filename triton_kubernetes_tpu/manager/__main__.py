@@ -1,0 +1,73 @@
+"""``tk8s-admin`` — the manager image's CLI.
+
+Invoked by files/install_manager.sh.tpl (``docker exec tk8s-manager
+tk8s-admin init-token ... --json``) and as the image entrypoint
+(``tk8s-admin serve``). Reference analog: the bash that drives a fresh
+Rancher into a usable state (files/setup_rancher.sh.tpl:22-63) — here the
+control plane ships its own admin tool instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .client import ManagerClient, ManagerClientError
+from .server import ManagerServer
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="tk8s-admin",
+                                description="tk8s manager control plane")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="run the control-plane server")
+    serve.add_argument("--name", default="tk8s-manager")
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--port", type=int, default=80)
+    serve.add_argument("--state", default="/var/lib/tk8s/state.json",
+                       help="JSON state file (persists credentials/clusters)")
+
+    tok = sub.add_parser("init-token",
+                         help="create-or-get the admin API credentials")
+    tok.add_argument("--url", default="",
+                     help="public manager URL embedded in the output")
+    tok.add_argument("--admin-password", default="")
+    tok.add_argument("--server", default="http://127.0.0.1:80",
+                     help="loopback address of the running server")
+    tok.add_argument("--json", action="store_true", dest="as_json")
+
+    args = p.parse_args(argv)
+
+    if args.command == "serve":
+        server = ManagerServer(args.name, host=args.host, port=args.port,
+                               state_path=args.state)
+        print(f"tk8s-manager {args.name!r} serving on "
+              f"{args.host}:{server.address[1]}", file=sys.stderr)
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    if args.command == "init-token":
+        client = ManagerClient(args.server)
+        try:
+            creds = client.init_token(args.url, args.admin_password)
+        except ManagerClientError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(creds))
+        else:
+            for k, v in creds.items():
+                print(f"{k}: {v}")
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

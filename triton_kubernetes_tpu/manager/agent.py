@@ -1,0 +1,89 @@
+"""``tk8s-agent`` — the node-side registration agent.
+
+What runs in the container started by files/install_agent.sh.tpl
+(``docker run ... tk8s/agent --server ... --token ... --ca-checksum ...
+--worker``), replacing the reference's rancher/rancher-agent
+(install_rancher_agent.sh.tpl:44). It verifies the manager's CA pin,
+registers the host with its roles/labels via the shared protocol, then
+heartbeats so the restart policy keeps membership alive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import time
+from typing import List, Optional
+
+from .client import ManagerClient, ManagerClientError
+
+ROLE_FLAGS = ("worker", "etcd", "controlplane")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tk8s-agent",
+                                description="tk8s node registration agent")
+    p.add_argument("--server", required=True, help="manager URL")
+    p.add_argument("--token", required=True, help="cluster registration token")
+    p.add_argument("--ca-checksum", default="",
+                   help="pin: sha256 of the manager's cacerts")
+    p.add_argument("--hostname", default="",
+                   help="override (default: the machine's hostname)")
+    p.add_argument("--label", action="append", default=[], metavar="K=V")
+    p.add_argument("--heartbeat-interval", type=float, default=60.0)
+    p.add_argument("--once", action="store_true",
+                   help="register once and exit (tests / cron mode)")
+    for role in ROLE_FLAGS:
+        p.add_argument(f"--{role}", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    roles = [r for r in ROLE_FLAGS if getattr(args, r)] or ["worker"]
+    labels = {}
+    for item in args.label:
+        k, _, v = item.partition("=")
+        labels[k] = v
+    hostname = args.hostname or socket.gethostname()
+
+    client = ManagerClient(args.server)
+    # CA pinning before anything else (install_rancher_agent contract): the
+    # server re-verifies on registration, but a clear client-side error
+    # beats a 403 when the operator pinned the wrong manager.
+    if args.ca_checksum:
+        try:
+            served = client.ca_checksum()
+        except ManagerClientError as e:
+            print(f"tk8s-agent: cannot fetch cacerts: {e}", file=sys.stderr)
+            return 1
+        if served != args.ca_checksum:
+            print("tk8s-agent: CA checksum mismatch — refusing to register "
+                  f"(pinned {args.ca_checksum[:12]}..., "
+                  f"server {served[:12]}...)", file=sys.stderr)
+            return 1
+
+    try:
+        node = client.register_node(args.token, hostname, roles,
+                                    labels=labels,
+                                    ca_checksum=args.ca_checksum)
+    except ManagerClientError as e:
+        print(f"tk8s-agent: registration failed: {e}", file=sys.stderr)
+        return 1
+    print(f"tk8s-agent: registered {node['hostname']} roles={node['roles']}",
+          file=sys.stderr)
+    if args.once:
+        return 0
+
+    while True:  # pragma: no cover - infinite heartbeat loop
+        time.sleep(args.heartbeat_interval)
+        try:
+            client.register_node(args.token, hostname, roles, labels=labels,
+                                 ca_checksum=args.ca_checksum)
+        except ManagerClientError as e:
+            print(f"tk8s-agent: heartbeat failed: {e}", file=sys.stderr)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""The manager control-plane semantic core.
+
+Pure functions over plain state dicts — no I/O, no clocks, no randomness
+(callers supply a ``salt``; the HTTP server uses a random persisted one so
+tokens are unpredictable, the simulator uses the empty salt so tests are
+deterministic). Implemented once and shared by :mod:`.server` and
+:class:`~..executor.cloudsim.CloudSimulator`, so the wire protocol the bash
+provisioning scripts speak and the in-process simulation can never drift.
+
+Reference analog: the Rancher v3 REST surface the reference drives by bash —
+``/v3/cluster`` create-or-get + ``/v3/clusterregistrationtoken`` +
+``/v3/settings/cacerts`` (files/rancher_cluster.sh:17-100), admin
+token mint (files/setup_rancher.sh.tpl:22-63), and
+``/v3/clusters/<id>?action=generateKubeconfig``
+(modules/k8s-backup-manta/main.tf:28-39).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+
+class ProtocolError(RuntimeError):
+    """A control-plane contract violation (bad token, unknown cluster...)."""
+
+
+def _h(*parts: str) -> str:
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def mint_credentials(name: str, salt: str = "") -> Dict[str, str]:
+    """Admin API credentials for a manager — create-or-get semantics are the
+    caller's job (rerunning the provisioner must not rotate credentials,
+    install_manager.sh.tpl contract)."""
+    return {
+        "access_key": f"token-{_h(name, salt, 'access')[:8]}",
+        "secret_key": _h(name, salt, "secret")[:40],
+    }
+
+
+def cacerts_pem(name: str, salt: str = "") -> str:
+    """The manager's CA material as served at /v3/settings/cacerts. A
+    deterministic stand-in body (the fingerprint contract is what matters:
+    agents pin sha256(cacerts), register_cluster script computes the same)."""
+    return (
+        "-----BEGIN CERTIFICATE-----\n"
+        f"tk8s-manager:{name}:{_h(name, salt, 'ca')}\n"
+        "-----END CERTIFICATE-----\n"
+    )
+
+
+def ca_checksum(name: str, salt: str = "") -> str:
+    """sha256 over the exact cacerts body — what agents pass as
+    ``--ca-checksum`` and register_cluster emits (rancher_cluster.sh:94-97
+    analog)."""
+    return hashlib.sha256(cacerts_pem(name, salt).encode()).hexdigest()
+
+
+def cluster_id(manager_name: str, cluster_name: str) -> str:
+    return f"c-{_h(manager_name, cluster_name)[:8]}"
+
+
+def create_or_get_cluster(clusters: Dict[str, Dict[str, Any]],
+                          manager_name: str, cluster_name: str,
+                          salt: str = "", **attrs: Any) -> Dict[str, Any]:
+    """Idempotent create-or-get by (manager, name) — rancher_cluster.sh:17-28
+    contract. Existing records absorb attr updates (k8s_version bumps) but
+    keep identity, token, and nodes."""
+    for c in clusters.values():
+        if c["manager"] == manager_name and c["name"] == cluster_name:
+            c.update(attrs)
+            return c
+    cid = cluster_id(manager_name, cluster_name)
+    cluster = {
+        "id": cid,
+        "name": cluster_name,
+        "manager": manager_name,
+        "registration_token": _h(cid, salt, "reg")[:40],
+        "ca_checksum": ca_checksum(manager_name, salt),
+        "nodes": {},
+        **attrs,
+    }
+    clusters[cid] = cluster
+    return cluster
+
+
+def registration_token(clusters: Dict[str, Dict[str, Any]],
+                       cid: str) -> str:
+    """Token mint for one cluster (POST /v3/clusterregistrationtoken analog).
+    Stable per cluster: re-minting must hand back the same token so
+    terraform re-applies converge."""
+    if cid not in clusters:
+        raise ProtocolError(f"no such cluster {cid!r}")
+    return clusters[cid]["registration_token"]
+
+
+def register_node(clusters: Dict[str, Dict[str, Any]], token: str,
+                  hostname: str, roles: List[str],
+                  labels: Optional[Dict[str, str]] = None,
+                  ca_checksum_pin: str = "") -> Dict[str, Any]:
+    """Agent self-registration: resolve the cluster by token, verify the CA
+    pin, upsert the node (install_rancher_agent.sh.tpl:44 analog)."""
+    for c in clusters.values():
+        if c["registration_token"] == token:
+            if ca_checksum_pin and ca_checksum_pin != c["ca_checksum"]:
+                raise ProtocolError(f"CA checksum mismatch for {hostname}")
+            c["nodes"][hostname] = {
+                "hostname": hostname,
+                "roles": sorted(roles),
+                "labels": dict(labels or {}),
+            }
+            return c["nodes"][hostname]
+    raise ProtocolError(f"invalid registration token for {hostname}")
+
+
+def generate_kubeconfig(cluster: Dict[str, Any], manager_url: str,
+                        salt: str = "") -> str:
+    """Kubeconfig for one cluster, API traffic proxied via the manager
+    (/v3/clusters/<id>?action=generateKubeconfig analog; the reference's
+    backup path consumes exactly this, k8s-backup-manta/main.tf:28-39)."""
+    cid = cluster["id"]
+    token = _h(cid, salt, "kubeconfig")[:40]
+    doc = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "clusters": [{
+            "name": cluster["name"],
+            "cluster": {"server": f"{manager_url}/k8s/clusters/{cid}"},
+        }],
+        "users": [{
+            "name": f"{cluster['name']}-admin",
+            "user": {"token": f"kubeconfig-{token}"},
+        }],
+        "contexts": [{
+            "name": cluster["name"],
+            "context": {"cluster": cluster["name"],
+                        "user": f"{cluster['name']}-admin"},
+        }],
+        "current-context": cluster["name"],
+    }
+    # Emitted as JSON — valid YAML 1.2, parseable by kubectl, and needs no
+    # yaml dependency at the data.external boundary.
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,322 @@
+"""The tk8s-manager HTTP control plane.
+
+What runs inside the ``tk8s/manager`` image (started by
+files/install_manager.sh.tpl) and what every provisioning script talks to:
+``register_cluster.py`` (terraform data.external), the agent containers'
+join call, and ``setup_backup.sh``'s kubeconfig mint. Stdlib-only
+(ThreadingHTTPServer) so the image needs nothing beyond this package.
+
+Wire surface (Rancher-v3-flavored, the contract of the scripts):
+
+========  =====================================  ====================
+method    path                                   auth
+========  =====================================  ====================
+GET       /v3                                    none (health)
+POST      /v3-admin/init-token                   loopback only
+GET       /v3/cluster?name=N                     basic
+POST      /v3/cluster                            basic
+POST      /v3/clusterregistrationtoken           basic
+GET       /v3/settings/cacerts                   basic
+POST      /v3/clusters/<id>?action=generateKubeconfig  basic
+GET       /v3/clusters/<id>/nodes                basic
+POST      /v3/agent/register                     registration token
+==========================================================================
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import os
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from . import protocol
+
+
+class ManagerState:
+    """The server's persistent state: identity, credentials, clusters.
+
+    JSON-file backed (``--state``); a restarted manager container keeps its
+    credentials and registrations, matching install_manager.sh.tpl's
+    create-or-get expectation. All mutation happens under one lock — the
+    reference's unlocked-state hazard (backend/manta/backend.go:33 TODO)
+    doesn't get rebuilt.
+    """
+
+    def __init__(self, name: str, path: Optional[str] = None):
+        self.lock = threading.Lock()
+        self.path = path
+        self.name = name
+        self.url = ""
+        self.salt = ""
+        self.credentials: Dict[str, str] = {}
+        self.clusters: Dict[str, Dict[str, Any]] = {}
+        if path and os.path.isfile(path):
+            with open(path) as f:
+                d = json.load(f)
+            self.name = d.get("name", name)
+            self.url = d.get("url", "")
+            self.salt = d.get("salt", "")
+            self.credentials = d.get("credentials", {})
+            self.clusters = d.get("clusters", {})
+        if not self.salt:
+            # Random, persisted: every derived token/credential becomes
+            # unpredictable while protocol.py itself stays deterministic.
+            self.salt = secrets.token_hex(16)
+            self._save_locked()
+
+    def _save_locked(self) -> None:
+        if not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"name": self.name, "url": self.url, "salt": self.salt,
+                       "credentials": self.credentials,
+                       "clusters": self.clusters}, f, indent=2)
+        os.replace(tmp, self.path)
+
+    def init_token(self, url: str, admin_password: str = "") -> Dict[str, str]:
+        """Create-or-get the admin API credentials (setup_rancher.sh.tpl
+        analog: login, mint token, set server-url). When the first mint set
+        an admin password, later mints must present it — otherwise any
+        loopback process could read the credentials back."""
+        with self.lock:
+            stored = self.credentials.get("admin_password", "")
+            if self.credentials and stored and not hmac.compare_digest(
+                    stored, admin_password):
+                raise protocol.ProtocolError("admin password mismatch")
+            if not self.credentials:
+                self.credentials = protocol.mint_credentials(
+                    self.name, self.salt)
+                if admin_password:
+                    self.credentials["admin_password"] = admin_password
+            if url:
+                self.url = url
+            self._save_locked()
+            return {"url": self.url,
+                    "access_key": self.credentials["access_key"],
+                    "secret_key": self.credentials["secret_key"]}
+
+    def check_auth(self, access_key: str, secret_key: str) -> bool:
+        creds = self.credentials
+        return bool(creds) and hmac.compare_digest(
+            creds.get("access_key", ""), access_key) and hmac.compare_digest(
+            creds.get("secret_key", ""), secret_key)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tk8s-manager"
+    state: ManagerState  # set by ManagerServer
+
+    # ------------------------------------------------------------ plumbing
+    def log_message(self, fmt: str, *args: Any) -> None:  # quiet by default
+        if os.environ.get("TK8S_MANAGER_DEBUG"):
+            super().log_message(fmt, *args)
+
+    def _json(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b"{}"
+        try:
+            d = json.loads(raw or b"{}")
+        except ValueError:
+            raise _BadRequest("invalid JSON body")
+        if not isinstance(d, dict):
+            raise _BadRequest("body must be a JSON object")
+        return d
+
+    def _authed(self) -> bool:
+        hdr = self.headers.get("Authorization") or ""
+        if not hdr.startswith("Basic "):
+            return False
+        try:
+            user, _, pw = base64.b64decode(hdr[6:]).decode().partition(":")
+        except Exception:
+            return False
+        return self.state.check_auth(user, pw)
+
+    def _require_auth(self) -> bool:
+        if self._authed():
+            return True
+        self._json(401, {"type": "error", "message": "must authenticate"})
+        return False
+
+    # ------------------------------------------------------------- routes
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        try:
+            url = urlparse(self.path)
+            if url.path == "/v3":
+                self._json(200, {"type": "apiRoot", "name": self.state.name})
+                return
+            if url.path == "/v3/settings/cacerts":
+                # Public like Rancher's: agents verify their --ca-checksum
+                # pin against this before holding any credentials.
+                self._json(200, {
+                    "name": "cacerts",
+                    "value": protocol.cacerts_pem(self.state.name,
+                                                  self.state.salt)})
+                return
+            if not self._require_auth():
+                return
+            if url.path == "/v3/cluster":
+                name = (parse_qs(url.query).get("name") or [""])[0]
+                with self.state.lock:
+                    data = [c for c in self.state.clusters.values()
+                            if not name or c["name"] == name]
+                self._json(200, {"type": "collection", "data": data})
+            elif url.path.startswith("/v3/clusters/") and \
+                    url.path.endswith("/nodes"):
+                cid = url.path[len("/v3/clusters/"):-len("/nodes")]
+                with self.state.lock:
+                    if cid not in self.state.clusters:
+                        self._json(404, {"type": "error",
+                                         "message": f"no cluster {cid}"})
+                        return
+                    nodes = list(self.state.clusters[cid]["nodes"].values())
+                self._json(200, {"type": "collection", "data": nodes})
+            else:
+                self._json(404, {"type": "error", "message": "not found"})
+        except _BadRequest as e:
+            self._json(400, {"type": "error", "message": str(e)})
+
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            url = urlparse(self.path)
+            if url.path == "/v3-admin/init-token":
+                # docker-exec'd tk8s-admin reaches this over loopback only.
+                if self.client_address[0] not in ("127.0.0.1", "::1"):
+                    self._json(403, {"type": "error",
+                                     "message": "loopback only"})
+                    return
+                d = self._body()
+                try:
+                    creds = self.state.init_token(
+                        d.get("url", ""), d.get("admin_password", ""))
+                except protocol.ProtocolError as e:
+                    self._json(403, {"type": "error", "message": str(e)})
+                    return
+                self._json(200, creds)
+                return
+            if url.path == "/v3/agent/register":
+                d = self._body()
+                with self.state.lock:
+                    try:
+                        node = protocol.register_node(
+                            self.state.clusters, d.get("token", ""),
+                            d.get("hostname", ""), d.get("roles", []),
+                            d.get("labels"), d.get("ca_checksum", ""))
+                    except protocol.ProtocolError as e:
+                        self._json(403, {"type": "error", "message": str(e)})
+                        return
+                    self.state._save_locked()
+                self._json(200, node)
+                return
+            if not self._require_auth():
+                return
+            if url.path == "/v3/cluster":
+                d = self._body()
+                if not d.get("name"):
+                    raise _BadRequest("cluster name required")
+                # Protocol-managed fields can never be set by a request —
+                # they are derived, and letting a body override them would
+                # persist corrupted state.
+                reserved = {"name", "id", "manager", "registration_token",
+                            "ca_checksum", "nodes", "salt"}
+                attrs = {k: v for k, v in d.items() if k not in reserved}
+                with self.state.lock:
+                    c = protocol.create_or_get_cluster(
+                        self.state.clusters, self.state.name, d["name"],
+                        self.state.salt, **attrs)
+                    self.state._save_locked()
+                self._json(201, c)
+            elif url.path == "/v3/clusterregistrationtoken":
+                d = self._body()
+                with self.state.lock:
+                    try:
+                        token = protocol.registration_token(
+                            self.state.clusters, d.get("clusterId", ""))
+                    except protocol.ProtocolError as e:
+                        self._json(404, {"type": "error", "message": str(e)})
+                        return
+                self._json(201, {"type": "clusterRegistrationToken",
+                                 "token": token})
+            elif url.path.startswith("/v3/clusters/") and \
+                    parse_qs(url.query).get("action") == ["generateKubeconfig"]:
+                cid = url.path[len("/v3/clusters/"):]
+                with self.state.lock:
+                    if cid not in self.state.clusters:
+                        self._json(404, {"type": "error",
+                                         "message": f"no cluster {cid}"})
+                        return
+                    cfg = protocol.generate_kubeconfig(
+                        self.state.clusters[cid],
+                        self.state.url or f"https://{self.state.name}",
+                        self.state.salt)
+                self._json(200, {"type": "generateKubeconfigOutput",
+                                 "config": cfg})
+            else:
+                self._json(404, {"type": "error", "message": "not found"})
+        except _BadRequest as e:
+            self._json(400, {"type": "error", "message": str(e)})
+
+
+class _BadRequest(ValueError):
+    pass
+
+
+class ManagerServer:
+    """Embeddable server: ``with ManagerServer(name="m1") as url: ...`` in
+    tests; ``serve_forever`` under ``tk8s-admin serve`` in the image."""
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
+                 state_path: Optional[str] = None):
+        self.state = ManagerState(name, state_path)
+        handler = type("Handler", (_Handler,), {"state": self.state})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ManagerServer":
+        # Tight poll so embedded servers stop quickly (tests start dozens).
+        self._thread = threading.Thread(
+            target=lambda: self.httpd.serve_forever(poll_interval=0.05),
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def __enter__(self) -> "ManagerServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
