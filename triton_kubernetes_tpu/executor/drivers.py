@@ -38,7 +38,8 @@ def _make_sim(cfg: Dict[str, Any], state: Dict[str, Any]) -> CloudSimulator:
 def _make_local_k8s(cfg: Dict[str, Any], state: Dict[str, Any]):
     from .k8s_local import LocalK8sDriver
 
-    return LocalK8sDriver(state, provisioner=cfg.get("provisioner", ""))
+    return LocalK8sDriver(state, provisioner=cfg.get("provisioner", ""),
+                          node_count=int(cfg.get("nodes") or 0))
 
 
 register_driver("sim", _make_sim)

@@ -76,7 +76,8 @@ class Provisioner:
     def exists(self, cluster_name: str) -> bool:
         raise NotImplementedError
 
-    def create(self, cluster_name: str, kubeconfig: str) -> None:
+    def create(self, cluster_name: str, kubeconfig: str,
+               node_count: int = 1) -> None:
         raise NotImplementedError
 
     def delete(self, cluster_name: str) -> None:
@@ -90,11 +91,26 @@ class KindProvisioner(Provisioner):
         out = self._run([self.BINARY, "get", "clusters"], None, True)
         return self.real_name(cluster_name) in out.split()
 
-    def create(self, cluster_name: str, kubeconfig: str) -> None:
-        self._run([self.BINARY, "create", "cluster",
-                   "--name", self.real_name(cluster_name),
-                   "--kubeconfig", kubeconfig,
-                   "--wait", "180s"], None, False)
+    def create(self, cluster_name: str, kubeconfig: str,
+               node_count: int = 1) -> None:
+        argv = [self.BINARY, "create", "cluster",
+                "--name", self.real_name(cluster_name),
+                "--kubeconfig", kubeconfig,
+                "--wait", "180s"]
+        if node_count > 1:
+            # Multi-node local cluster: 1 control-plane + N-1 workers.
+            cfg = os.path.join(os.path.dirname(kubeconfig),
+                               f"{self.real_name(cluster_name)}-kind.yaml")
+            os.makedirs(os.path.dirname(cfg), exist_ok=True)
+            roles = ["control-plane"] + ["worker"] * (node_count - 1)
+            with open(cfg, "w") as f:
+                f.write("kind: Cluster\n"
+                        "apiVersion: kind.x-k8s.io/v1alpha4\n"
+                        "nodes:\n")
+                for r in roles:
+                    f.write(f"  - role: {r}\n")
+            argv += ["--config", cfg]
+        self._run(argv, None, False)
 
     def delete(self, cluster_name: str) -> None:
         self._run([self.BINARY, "delete", "cluster",
@@ -114,11 +130,15 @@ class K3dProvisioner(Provisioner):
         return any(c.get("name") == self.real_name(cluster_name)
                    for c in clusters)
 
-    def create(self, cluster_name: str, kubeconfig: str) -> None:
+    def create(self, cluster_name: str, kubeconfig: str,
+               node_count: int = 1) -> None:
         name = self.real_name(cluster_name)
-        self._run([self.BINARY, "cluster", "create", name,
-                   "--kubeconfig-update-default=false",
-                   "--wait", "--timeout", "180s"], None, False)
+        argv = [self.BINARY, "cluster", "create", name,
+                "--kubeconfig-update-default=false",
+                "--wait", "--timeout", "180s"]
+        if node_count > 1:
+            argv += ["--agents", str(node_count - 1)]
+        self._run(argv, None, False)
         kc = self._run([self.BINARY, "kubeconfig", "get", name], None, True)
         os.makedirs(os.path.dirname(kubeconfig), exist_ok=True)
         with open(kubeconfig, "w") as f:
@@ -156,12 +176,13 @@ class LocalK8sDriver(CloudSimulator):
 
     def __init__(self, state: Optional[Dict[str, Any]] = None,
                  provisioner: str = "", runner: Runner = _run_subprocess,
-                 kubeconfig_dir: Optional[str] = None):
+                 kubeconfig_dir: Optional[str] = None, node_count: int = 0):
         super().__init__(state)
         s = state or {}
         self._runner = runner
         self.kubeconfig_dir = (kubeconfig_dir or s.get("kubeconfig_dir")
                                or default_kubeconfig_dir())
+        self.node_count = int(node_count or s.get("node_count") or 1)
         # Persisted state wins over config: resources provisioned by one
         # tool must be destroyed by the same tool, or they orphan.
         self.provisioner = detect_provisioner(
@@ -172,6 +193,7 @@ class LocalK8sDriver(CloudSimulator):
         d["driver"] = self.DRIVER_NAME
         d["provisioner"] = self.provisioner.BINARY
         d["kubeconfig_dir"] = self.kubeconfig_dir
+        d["node_count"] = self.node_count
         return d
 
     # ----------------------------------------------------------- kubectl
@@ -197,10 +219,28 @@ class LocalK8sDriver(CloudSimulator):
         if not self.provisioner.exists(cluster_name):
             kc = self.kubeconfig_path(cluster["id"])
             os.makedirs(self.kubeconfig_dir, exist_ok=True)
-            self.provisioner.create(cluster_name, kc)
+            self.provisioner.create(cluster_name, kc,
+                                    node_count=self.node_count)
         cluster["kubeconfig"] = self.kubeconfig_path(cluster["id"])
         cluster["provisioner"] = self.provisioner.BINARY
         return cluster
+
+    CONTROL_PLANE_LABEL = "node-role.kubernetes.io/control-plane"
+
+    def _real_nodes(self, cluster_id: str) -> List[Dict[str, Any]]:
+        out = self.kubectl(cluster_id, ["get", "nodes", "-o", "json"])
+        try:
+            items = json.loads(out or "{}").get("items", [])
+        except json.JSONDecodeError as e:
+            # Fail loudly like every other kubectl path — silently skipping
+            # assignment would strand role labels off the real cluster.
+            raise LocalK8sError(
+                f"unparseable `kubectl get nodes` output for cluster "
+                f"{cluster_id!r}: {out[:200]!r}") from e
+        nodes = [{"name": i["metadata"]["name"],
+                  "labels": i["metadata"].get("labels") or {}}
+                 for i in items]
+        return sorted(nodes, key=lambda n: n["name"])
 
     def register_node(self, registration_token: str, hostname: str,
                       roles: List[str], labels: Optional[Dict[str, str]] = None,
@@ -208,16 +248,37 @@ class LocalK8sDriver(CloudSimulator):
         node = super().register_node(
             registration_token, hostname, roles, labels, ca_checksum)
         # The local cluster's nodes were created by the provisioner, not by
-        # the host module; registration projects the host labels onto the
-        # real node(s). On the 1-node BASELINE config this is exact.
-        cluster_id = next(
-            c["id"] for c in self.clusters.values()
+        # the host module; registration projects each registered hostname
+        # onto ONE real node (sticky via cluster["node_assignments"], so
+        # re-applies keep the mapping). Control/etcd hosts prefer the
+        # control-plane node, workers prefer workers. More hosts than real
+        # nodes is a hard config mismatch — silently sharing a node would
+        # clobber the previous host's identity label (the round-2 `--all`
+        # bug in a new costume).
+        cluster = next(
+            c for c in self.clusters.values()
             if c["registration_token"] == registration_token)
-        if labels:
-            label_args = [f"{k}={v}" for k, v in sorted(labels.items())]
-            self.kubectl(cluster_id,
-                         ["label", "nodes", "--all", "--overwrite",
-                          *label_args], capture=False)
+        assignments = cluster.setdefault("node_assignments", {})
+        if hostname not in assignments:
+            real = self._real_nodes(cluster["id"])
+            taken = set(assignments.values())
+            free = [n for n in real if n["name"] not in taken]
+            if not free:
+                raise LocalK8sError(
+                    f"no unassigned real node left for host {hostname!r} "
+                    f"({len(real)} nodes, {len(taken)} assigned) — size the "
+                    "local cluster with driver {name: local-k8s, nodes: N}")
+            want_cp = any(r in ("controlplane", "etcd") for r in roles)
+            cp = [n for n in free if self.CONTROL_PLANE_LABEL in n["labels"]]
+            workers = [n for n in free
+                       if self.CONTROL_PLANE_LABEL not in n["labels"]]
+            pick = (cp or workers) if want_cp else (workers or cp)
+            assignments[hostname] = pick[0]["name"]
+        label_args = [f"tk8s.io/hostname={hostname}"] + [
+            f"{k}={v}" for k, v in sorted((labels or {}).items())]
+        self.kubectl(cluster["id"],
+                     ["label", "node", assignments[hostname],
+                      "--overwrite", *label_args], capture=False)
         return node
 
     # -------------------------------------------------------- manifests
@@ -247,7 +308,10 @@ class LocalK8sDriver(CloudSimulator):
             cluster = self.clusters[name]
             if self.provisioner.exists(cluster["name"]):
                 self.provisioner.delete(cluster["name"])
-            kc = self.kubeconfig_path(name)
-            if os.path.isfile(kc):
-                os.unlink(kc)
+            kind_cfg = os.path.join(
+                self.kubeconfig_dir,
+                f"{self.provisioner.real_name(cluster['name'])}-kind.yaml")
+            for path in (self.kubeconfig_path(name), kind_cfg):
+                if os.path.isfile(path):
+                    os.unlink(path)
         super().delete_resource(rtype, name)
