@@ -1,0 +1,255 @@
+"""GcsObjectStore against an in-process fake GCS server.
+
+Every store method executes over real HTTP (upload, media download with
+x-goog-generation, ifGenerationMatch preconditions returning 412, paginated
+list, delete), so the backend's real-path code runs here — not a stub of
+it. The fake implements the same JSON-API subset fake-gcs-server does and
+the store reaches it via the standard STORAGE_EMULATOR_HOST convention.
+"""
+
+import base64
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from triton_kubernetes_tpu.backends import ObjectStoreBackend
+from triton_kubernetes_tpu.backends.base import StateLockedError
+from triton_kubernetes_tpu.backends.gcs import (
+    GcsObjectStore, service_account_jwt)
+from triton_kubernetes_tpu.backends.objectstore import store_from_location
+from triton_kubernetes_tpu.cli.main import main
+from triton_kubernetes_tpu.executor import LocalExecutor
+
+
+class FakeGcs(BaseHTTPRequestHandler):
+    """Minimal GCS JSON-API: objects with integer generations per bucket."""
+
+    buckets = {}  # {bucket: {name: (data, generation)}}
+    page_size = 2  # tiny, so pagination is actually exercised
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, code, payload, extra_headers=None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(url.query))
+        parts = url.path.split("/")
+        # /storage/v1/b/<bucket>/o[/<object>]
+        bucket = self.buckets.setdefault(parts[4], {})
+        if len(parts) == 6 and parts[5] == "o":  # list
+            names = sorted(n for n in bucket if
+                           n.startswith(q.get("prefix", "")))
+            start = int(q.get("pageToken") or 0)
+            page = names[start:start + self.page_size]
+            out = {"items": [{"name": n} for n in page]}
+            if start + self.page_size < len(names):
+                out["nextPageToken"] = str(start + self.page_size)
+            self._json(200, out)
+            return
+        name = urllib.parse.unquote(parts[6])
+        if name not in bucket:
+            self._json(404, {"error": "not found"})
+            return
+        data, gen = bucket[name]
+        if q.get("alt") == "media":
+            self.send_response(200)
+            self.send_header("x-goog-generation", str(gen))
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        else:
+            self._json(200, {"name": name, "generation": str(gen)})
+
+    def do_POST(self):
+        url = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(url.query))
+        bucket = self.buckets.setdefault(url.path.split("/")[5], {})
+        name = q["name"]
+        data = self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        current = bucket.get(name, (b"", 0))[1]
+        want = q.get("ifGenerationMatch")
+        if want is not None and int(want) != current:
+            self._json(412, {"error": "conditionNotMet"})
+            return
+        bucket[name] = (data, current + 1)
+        self._json(200, {"name": name, "generation": str(current + 1)})
+
+    def do_DELETE(self):
+        url = urllib.parse.urlparse(self.path)
+        parts = url.path.split("/")
+        bucket = self.buckets.setdefault(parts[4], {})
+        name = urllib.parse.unquote(parts[6])
+        if bucket.pop(name, None) is None:
+            self._json(404, {"error": "not found"})
+        else:
+            self._json(204, {})
+
+
+@pytest.fixture()
+def gcs(monkeypatch):
+    FakeGcs.buckets = {}
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeGcs)
+    t = threading.Thread(
+        target=lambda: httpd.serve_forever(poll_interval=0.05), daemon=True)
+    t.start()
+    endpoint = f"http://127.0.0.1:{httpd.server_address[1]}"
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", endpoint)
+    yield endpoint
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_crud_and_generations(gcs):
+    store = GcsObjectStore("bkt")
+    g1 = store.put("a/doc.json", b"v1")
+    assert g1 == 1
+    data, gen = store.get("a/doc.json")
+    assert (data, gen) == (b"v1", 1)
+    # Precondition honored server-side: stale generation -> locked error.
+    with pytest.raises(StateLockedError, match="generation mismatch"):
+        store.put("a/doc.json", b"v2", if_generation_match=0)
+    g2 = store.put("a/doc.json", b"v2", if_generation_match=1)
+    assert g2 == 2 and store.get("a/doc.json")[0] == b"v2"
+    store.delete("a/doc.json")
+    with pytest.raises(KeyError):
+        store.get("a/doc.json")
+    store.delete("a/doc.json")  # idempotent
+
+
+def test_list_paginates(gcs):
+    store = GcsObjectStore("bkt")
+    for i in range(5):
+        store.put(f"p/{i}", b"x")
+    store.put("other/0", b"x")
+    assert store.list("p/") == [f"p/{i}" for i in range(5)]  # 3 pages
+
+
+def test_backend_over_gcs_detects_concurrent_writer(gcs):
+    """Two CLI instances racing on one document: the loser gets
+    StateLockedError, never a silent clobber (the reference's Manta TODO,
+    closed)."""
+    be1 = ObjectStoreBackend(GcsObjectStore("bkt"), bucket_hint="bkt")
+    be2 = ObjectStoreBackend(GcsObjectStore("bkt"), bucket_hint="bkt")
+    doc1 = be1.state("m1")
+    doc2 = be2.state("m1")
+    doc1.set("a", 1)
+    be1.persist(doc1)
+    doc2.set("a", 2)
+    with pytest.raises(StateLockedError):
+        be2.persist(doc2)
+    # Reload -> retry succeeds and sees the winner's write.
+    doc2 = be2.state("m1")
+    assert doc2.get("a") == 1
+    doc2.set("b", 3)
+    be2.persist(doc2)
+
+
+def test_executor_state_lives_in_bucket(gcs):
+    """The executor's own state (terraform.tfstate analog) round-trips
+    through the same bucket via store_from_location — a second machine
+    pointed at the bucket reconstructs the same store."""
+    be = ObjectStoreBackend(GcsObjectStore("bkt"), bucket_hint="bkt")
+    loc = be.executor_backend_config("m1")["objectstore"]
+    assert loc["kind"] == "gcs" and loc["bucket"] == "bkt"
+    store2 = store_from_location(loc)
+    assert isinstance(store2, GcsObjectStore)
+    store2.put(loc["path"], b'{"serial": 7}')
+    assert json.loads(store_from_location(loc).get(loc["path"])[0]) == \
+        {"serial": 7}
+
+
+def test_cli_drives_gcs_backend_end_to_end(gcs, capsys):
+    """backend_provider=gcs through the real CLI: create manager, list it
+    from a second backend instance, destroy."""
+    ex = LocalExecutor(log=lambda m: None)
+    rc = main(["--non-interactive",
+               "--set", "backend_provider=gcs",
+               "--set", "backend_bucket=bkt",
+               "--set", "manager_cloud_provider=bare-metal",
+               "--set", "name=gm1", "--set", "host=10.0.0.5",
+               "create", "manager"], executor=ex)
+    assert rc == 0
+    assert "created: gm1" in capsys.readouterr().out
+    # The document is really in the (fake) bucket.
+    names = [n for n in FakeGcs.buckets["bkt"]]
+    assert any(n.endswith("gm1/main.tf.json") for n in names)
+    assert any(n.endswith("gm1/terraform.tfstate") for n in names)
+
+    rc = main(["--non-interactive",
+               "--set", "backend_provider=gcs",
+               "--set", "backend_bucket=bkt",
+               "--set", "cluster_manager=gm1",
+               "destroy", "manager"], executor=ex)
+    assert rc == 0
+    assert not any(n.startswith("triton-kubernetes-tpu/gm1/")
+                   for n in FakeGcs.buckets["bkt"])
+
+
+def test_config_errors_are_not_lock_errors(gcs, monkeypatch):
+    from triton_kubernetes_tpu.backends.gcs import GcsConfigError
+
+    with pytest.raises(GcsConfigError, match="cannot contain"):
+        GcsObjectStore("bad/bucket")
+    # No emulator, no credentials -> clear config error on first use.
+    monkeypatch.delenv("STORAGE_EMULATOR_HOST")
+    monkeypatch.delenv("GOOGLE_APPLICATION_CREDENTIALS", raising=False)
+    store = GcsObjectStore("bkt")
+    with pytest.raises(GcsConfigError, match="service-account key"):
+        store.get("x")
+
+
+def test_schemeless_emulator_host(monkeypatch):
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", "localhost:4443")
+    store = GcsObjectStore("bkt")
+    assert store.endpoint == "http://localhost:4443"
+    assert store.emulator
+
+
+def test_explicit_endpoint_stays_authenticated(monkeypatch):
+    monkeypatch.delenv("STORAGE_EMULATOR_HOST", raising=False)
+    store = GcsObjectStore(
+        "bkt", endpoint="https://storage.mtls.googleapis.com")
+    assert not store.emulator  # alternate endpoint still wants Bearer auth
+
+
+def test_service_account_jwt_shape():
+    """The OAuth2 assertion is a well-formed RS256 JWT over the right
+    claims (no network: verified with the generated public key)."""
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()).decode()
+    creds = {"client_email": "sa@proj.iam.gserviceaccount.com",
+             "private_key": pem, "private_key_id": "kid-1"}
+    jwt = service_account_jwt(creds, now=1_700_000_000)
+    h, c, sig = jwt.split(".")
+
+    def unb64(s):
+        return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+    header = json.loads(unb64(h))
+    claims = json.loads(unb64(c))
+    assert header == {"alg": "RS256", "typ": "JWT", "kid": "kid-1"}
+    assert claims["iss"] == "sa@proj.iam.gserviceaccount.com"
+    assert claims["aud"] == "https://oauth2.googleapis.com/token"
+    assert claims["exp"] == claims["iat"] + 3600
+    assert "devstorage.read_write" in claims["scope"]
+    key.public_key().verify(unb64(sig), f"{h}.{c}".encode(),
+                            padding.PKCS1v15(), hashes.SHA256())

@@ -123,6 +123,8 @@ STORE_KINDS: Dict[str, Any] = {
 
 def store_from_location(loc: Dict[str, Any]) -> ObjectStore:
     kind = loc.get("kind", "dir")
+    if kind == "gcs" and kind not in STORE_KINDS:
+        from . import gcs  # noqa: F401 — import registers the kind
     if kind not in STORE_KINDS:
         raise KeyError(
             f"unknown object-store kind {kind!r}; know {sorted(STORE_KINDS)}")

@@ -23,6 +23,7 @@ from .. import __version__
 from ..backends import Backend, LocalBackend, ObjectStoreBackend
 from ..backends.objectstore import DirObjectStore
 from ..backends.base import StateLockedError, StateNotFoundError
+from ..backends.gcs import GcsConfigError
 from ..config import (
     Config,
     InputResolver,
@@ -58,18 +59,31 @@ GIT_SHA = "dev"  # stamped by packaging (Makefile -ldflags analog, Makefile:2)
 def choose_backend(resolver: InputResolver) -> Backend:
     """Backend selection (util/backend_prompt.go:18-168 analog).
 
-    ``local`` keeps everything under ~/.triton-kubernetes-tpu; ``objectstore``
-    is the Manta/GCS-style remote (a directory emulation unless a real bucket
-    client is wired in), with ``manta``/``gcs`` accepted as aliases.
+    ``local`` keeps everything under ~/.triton-kubernetes-tpu; ``gcs`` is a
+    real GCS bucket (generation-locked, the Manta-backend analog);
+    ``objectstore`` (alias ``manta``) is the directory-backed bucket
+    emulation for air-gapped use.
     """
     kind = resolver.choose(
         "backend_provider", "Backend Provider",
-        [("local", "local"), ("objectstore", "objectstore"),
-         ("manta", "objectstore"), ("gcs", "objectstore")],
+        [("local", "local"), ("gcs", "gcs"),
+         ("objectstore", "objectstore"), ("manta", "objectstore")],
         default="local")
     if kind == "local":
         root = resolver.config.get("backend_root", "~/.triton-kubernetes-tpu")
         return LocalBackend(root)
+    if kind == "gcs":
+        from ..backends.gcs import GcsObjectStore
+
+        bucket = str(resolver.value(
+            "backend_bucket", "GCS bucket",
+            validate=lambda v: "bucket names cannot contain '/'"
+            if "/" in str(v) else None))
+        creds = str(resolver.value(
+            "gcp_path_to_credentials", "Path to GCP credentials file",
+            default=""))
+        store = GcsObjectStore(bucket, credentials_path=creds)
+        return ObjectStoreBackend(store, bucket_hint=bucket)
     bucket = resolver.value("backend_bucket", "Object-store bucket/path",
                             default="~/.triton-kubernetes-tpu-bucket")
     return ObjectStoreBackend(DirObjectStore(str(bucket)), bucket_hint=str(bucket))
@@ -196,7 +210,7 @@ def main(argv: Optional[List[str]] = None,
     except (WorkflowError, MissingInputError, ValidationError,
             ClusterKeyError, ApplyError, OutputError, ModuleError,
             StateLockedError, StateNotFoundError, TerraformNotFoundError,
-            EOFError) as e:
+            GcsConfigError, EOFError) as e:
         logger.error(str(e), kind=type(e).__name__)
         return 1
     except KeyboardInterrupt:
