@@ -64,3 +64,25 @@ def test_parallelism_plan_guards():
     sizes = ParallelismPlan(
         MeshConfig(seq=2, fsdp=-1), ring_attention=True).validate(8)
     assert sizes["seq"] == 2 and sizes["fsdp"] == 4
+
+
+def test_seq_ring_handles_indivisible_heads(cpu_mesh_devices):
+    """seq>1 with a tensor axis that doesn't divide the KV heads: the auto
+    ring keeps heads unsharded instead of crashing the shard_map (the dense
+    path handled this before ring became the seq default)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_kubernetes_tpu.models import get_config
+    from triton_kubernetes_tpu.train import (
+        init_state, make_optimizer, make_train_step)
+    from triton_kubernetes_tpu.train.data import synthetic_batches
+
+    cfg = get_config("llama-test")  # hkv=2, not divisible by tensor=4
+    mesh = create_mesh(MeshConfig(seq=2, tensor=4))
+    opt = make_optimizer(warmup_steps=1, decay_steps=10)
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    batch = next(synthetic_batches(cfg.vocab_size, 2, 32))
+    _, metrics = step(state, {"tokens": jnp.asarray(batch["tokens"])})
+    assert np.isfinite(float(metrics["loss"]))

@@ -113,3 +113,88 @@ def test_pipelined_matches_unpipelined_loss(cpu_mesh_devices):
         state2, {"tokens": tokens})
     np.testing.assert_allclose(
         float(m_pp["loss"]), float(m_flat["loss"]), rtol=1e-4)
+
+
+def test_flash_kernel_nests_inside_stage_map(cpu_mesh_devices):
+    """pp x tp keeps the Pallas kernel: the flash shard_map (data/fsdp/
+    tensor manual) nests inside the stage-manual stage map, matches the
+    sequential forward exactly, and trains (fwd+bwd through the custom-vjp
+    kernels). Structural proof: the jaxpr shows pallas_call under two
+    shard_maps with disjoint manual axes."""
+    from jax.sharding import PartitionSpec as P
+
+    from triton_kubernetes_tpu.ops.flash_attention import flash_attention
+
+    cfg = get_config("llama-test", num_layers=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = _tokens(cfg, 8, 64)
+    mesh = create_mesh(MeshConfig(data=2, stage=2, tensor=2))
+    spec = P(("data", "fsdp"), None, "tensor", None)
+    kern = jax.shard_map(
+        lambda q, k, v: flash_attention(q, k, v, interpret=True),
+        in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={"data", "fsdp", "tensor"}, check_vma=False)
+    attn = lambda q, k, v, positions: kern(q, k, v)
+
+    ref, _ = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    out, _ = jax.jit(lambda p, t: pipeline_forward(
+        p, t, cfg, 2, 2, attention_fn=attn, mesh=mesh))(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+    # Structural proof that the kernel survives into the lowered program.
+    jaxpr = str(jax.make_jaxpr(lambda p, t: pipeline_forward(
+        p, t, cfg, 2, 2, attention_fn=attn, mesh=mesh))(params, tokens))
+    assert "pallas_call" in jaxpr
+    assert "manual_axes=frozenset({'stage'})" in jaxpr.replace('"', "'")
+
+    # And the full train step (backward through the pallas vjp) runs.
+    opt = make_optimizer(warmup_steps=1, decay_steps=10)
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt, attention_fn=attn, microbatches=2)
+    batch = next(synthetic_batches(cfg.vocab_size, 8, 32))
+    _, metrics = step(state, {"tokens": jnp.asarray(batch["tokens"])})
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_ring_attention_nests_inside_stage_map(cpu_mesh_devices):
+    """pp x sp: ring attention (positions-operand form, axis-index-free)
+    nests inside the stage map, matches sequential, and trains."""
+    from triton_kubernetes_tpu.ops.ring_attention import make_ring_attention
+
+    cfg = get_config("llama-test", num_layers=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = _tokens(cfg, 8, 64)
+    mesh = create_mesh(MeshConfig(stage=2, seq=2, data=2))
+    ring = make_ring_attention(mesh, nested=True)
+    attn = lambda q, k, v, positions: ring(q, k, v, positions)
+
+    ref, _ = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    out, _ = jax.jit(lambda p, t: pipeline_forward(
+        p, t, cfg, 2, 2, attention_fn=attn, mesh=mesh))(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+    opt = make_optimizer(warmup_steps=1, decay_steps=10)
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt, attention_fn=attn, microbatches=2)
+    batch = next(synthetic_batches(cfg.vocab_size, 8, 32))
+    _, metrics = step(state, {"tokens": jnp.asarray(batch["tokens"])})
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_seq_mesh_auto_resolves_to_ring(cpu_mesh_devices):
+    """A seq>1 mesh without an explicit attention fn gets ring attention
+    automatically (the round-2 dense-einsum forfeit, fixed)."""
+    from triton_kubernetes_tpu.train.trainer import _resolve_attention
+
+    mesh = create_mesh(MeshConfig(seq=2, data=2, tensor=2))
+    attn = _resolve_attention(None, mesh)
+    assert attn is not None
+    cfg = get_config("llama-test")
+    opt = make_optimizer(warmup_steps=1, decay_steps=10)
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    batch = next(synthetic_batches(cfg.vocab_size, 4, 32))
+    _, metrics = step(state, {"tokens": jnp.asarray(batch["tokens"])})
+    assert np.isfinite(float(metrics["loss"]))

@@ -68,12 +68,14 @@ def test_bad_batch_divisibility(cpu_mesh_devices, capsys):
     assert rc == 2
 
 
-def test_ring_plus_stage_rejected(cpu_mesh_devices, capsys):
+def test_ring_plus_stage_trains(cpu_mesh_devices, capsys):
+    """ring attention + pipeline stages now combine: the ring shard_map
+    (positions-operand form) nests inside the stage-manual stage map."""
     rc, _ = _run(capsys, [
-        "--model", "llama-test", "--steps", "1", "--batch-size", "4",
-        "--seq-len", "16", "--stage", "2", "--fsdp", "4",
+        "--model", "llama-test", "--steps", "1", "--batch-size", "8",
+        "--seq-len", "16", "--stage", "2", "--fsdp", "2", "--seq", "2",
         "--ring-attention", "--json-logs"])
-    assert rc == 2
+    assert rc == 0
 
 
 def test_auto_batch_scales_with_mesh(cpu_mesh_devices, capsys):
@@ -86,3 +88,13 @@ def test_auto_batch_scales_with_mesh(cpu_mesh_devices, capsys):
     lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
     start = [l for l in lines if l["msg"] == "trainer starting"][0]
     assert start["batch"] == 16  # 4 shards x 4
+
+
+def test_pipeline_microbatch_divisibility_rejected(cpu_mesh_devices, capsys):
+    """Configs whose per-microbatch size can't split over data*fsdp are a
+    friendly rc=2 error, not a shard_map traceback."""
+    rc, _ = _run(capsys, [
+        "--model", "llama-test", "--steps", "1", "--batch-size", "8",
+        "--seq-len", "16", "--stage", "2", "--fsdp", "2", "--seq", "2",
+        "--microbatches", "8", "--json-logs"])
+    assert rc == 2

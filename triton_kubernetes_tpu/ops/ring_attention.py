@@ -61,25 +61,34 @@ def ring_attention_inner(
     k: jnp.ndarray,  # [B, S_loc, Hkv, D]
     v: jnp.ndarray,
     axis_name: str,
+    positions: Optional[jnp.ndarray] = None,  # [S_loc] local token positions
 ) -> jnp.ndarray:
-    """Body to run inside shard_map; ``axis_name`` is the sequence axis."""
+    """Body to run inside shard_map; ``axis_name`` is the sequence axis.
+
+    When ``positions`` is given, each shard's q/k positions come from it and
+    the k positions *rotate with the KV blocks* — no ``lax.axis_index``
+    anywhere, which is what lets this nest inside the pipeline's
+    partial-manual stage map (axis-index lowering inside a nested manual
+    computation trips the sdy verifier under grad). Without ``positions``
+    the classic derivation from the axis index is used (top-level callers).
+    """
     n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
     b, s_loc, hq, d = q.shape
     hkv = k.shape[2]
     g = hq // hkv
-    q_pos = idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+    if positions is None:
+        idx = lax.axis_index(axis_name)
+        q_pos = idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+    else:
+        q_pos = positions.astype(jnp.int32)
 
     m0 = jnp.full((b, hkv, g, s_loc), NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((b, hkv, g, s_loc), dtype=jnp.float32)
     o0 = jnp.zeros((b, s_loc, hkv, g, d), dtype=jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def step(carry, t):
-        k_blk, v_blk, m, l, o = carry
-        # After t rotations, device idx holds the block born on idx - t.
-        src = (idx - t) % n
-        k_pos = src * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+    def step(carry, _):
+        k_blk, v_blk, k_pos, m, l, o = carry
         bm, bs, bo = _block_flash(q, k_blk, v_blk, q_pos, k_pos)
         new_m = jnp.maximum(m, bm)
         alpha = jnp.exp(m - new_m)
@@ -88,39 +97,62 @@ def ring_attention_inner(
         # [B, Sq, Hkv, G, 1] scaling of the f32 accumulator
         o = o * jnp.moveaxis(alpha, 3, 1)[..., None] \
             + bo * jnp.moveaxis(beta, 3, 1)[..., None]
+        # Positions ride the ring with their blocks, so no device ever
+        # needs to know which block it holds.
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, new_m, l, o), None
+        k_pos = lax.ppermute(k_pos, axis_name, perm)
+        return (k_blk, v_blk, k_pos, new_m, l, o), None
 
-    (k_f, v_f, m, l, o), _ = lax.scan(
-        step, (k, v, m0, l0, o0), jnp.arange(n, dtype=jnp.int32))
-    del k_f, v_f
+    (k_f, v_f, p_f, m, l, o), _ = lax.scan(
+        step, (k, v, q_pos, m0, l0, o0), None, length=n)
+    del k_f, v_f, p_f
     out = o / jnp.moveaxis(l, 3, 1)[..., None]
     return out.reshape(b, s_loc, hq, d).astype(q.dtype)
 
 
 def make_ring_attention(
-    mesh: Mesh,
+    mesh: Optional[Mesh],
     seq_axis: str = "seq",
     batch_axes: Tuple[str, ...] = ("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
+    nested: bool = False,
 ):
-    """Returns attention(q, k, v) -> out, shard_mapped over the full mesh.
+    """Returns attention(q, k, v) -> out, shard_mapped over the mesh.
 
     q/k/v layout: [batch over ``batch_axes``, seq over ``seq_axis``, heads
     over ``head_axis``, head_dim replicated]. Everything except the ring
     exchange is embarrassingly parallel across the other axes.
-    """
-    spec = P(batch_axes, seq_axis, head_axis, None)
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
-    def attn(q, k, v):
-        return ring_attention_inner(q, k, v, seq_axis)
+    ``nested=True`` builds the shard_map against the ambient mesh with only
+    these axes manual, so it can nest inside an outer partial-manual
+    shard_map (the pipeline's stage map) — an explicit mesh would conflict
+    with the outer context's Manual stage axis.
+    """
+    batch_part = tuple(batch_axes) or None  # () -> replicated batch
+    spec = P(batch_part, seq_axis, head_axis, None)
+    pos_spec = P(batch_part, seq_axis)
+    kwargs = dict(check_vma=False)
+    if nested:
+        kwargs["axis_names"] = set(batch_axes) | {seq_axis} | (
+            {head_axis} if head_axis else set())
+    else:
+        kwargs["mesh"] = mesh
+
+    sm_nopos = jax.shard_map(
+        lambda q, k, v: ring_attention_inner(q, k, v, seq_axis),
+        in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
+    # Positions-operand variant: positions are [B, S] standard ranges; the
+    # local [B_loc, S_loc] shard's first row is every row's positions. Used
+    # under the pipeline, where axis-index-free bodies are required.
+    sm_pos = jax.shard_map(
+        lambda q, k, v, p: ring_attention_inner(
+            q, k, v, seq_axis, positions=p[0]),
+        in_specs=(spec, spec, spec, pos_spec), out_specs=spec, **kwargs)
+
+    def attn(q, k, v, positions=None):
+        if positions is None:
+            return sm_nopos(q, k, v)
+        return sm_pos(q, k, v, positions)
 
     return attn

@@ -131,13 +131,24 @@ def main(argv=None) -> int:
         log.log("error", "global batch must divide the data*fsdp axes",
                 batch=batch_size, shards=batch_shards)
         return 2
-    if args.ring_attention and mesh.shape["stage"] > 1:
-        log.log("error", "ring attention cannot combine with pipeline "
-                "stages (shard_map cannot nest inside the stage vmap)")
-        return 2
+    stages = mesh.shape["stage"]
+    if stages > 1:
+        # The per-stage kernel shard_maps split each microbatch over
+        # (data, fsdp): validate here so misconfigurations are a friendly
+        # error, not a shard_map traceback from deep inside tracing.
+        m = args.microbatches or stages
+        if batch_size % m or (batch_size // m) % batch_shards:
+            log.log("error",
+                    "batch/microbatches must divide the data*fsdp axes "
+                    "under pipeline stages",
+                    batch=batch_size, microbatches=m, shards=batch_shards)
+            return 2
 
     attention_fn = None
-    if args.ring_attention or mesh.shape["seq"] > 1:
+    if args.ring_attention and mesh.shape["seq"] == 1:
+        # seq > 1 meshes get ring automatically (trainer._resolve_attention,
+        # incl. the nested-under-pipeline form); this flag covers the
+        # unusual request for ring on an unsharded sequence.
         ring = make_ring_attention(mesh)
         attention_fn = lambda q, k, v, positions: ring(q, k, v)
 

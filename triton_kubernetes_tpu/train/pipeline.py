@@ -18,9 +18,13 @@ No reference analog: the reference provisions clusters and has no ML
 runtime (SURVEY.md §2.5); this implements the pipeline-parallel axis the
 TPU build adds on top (BASELINE.json north star).
 
-Constraints (this round): sequence parallelism (ring attention) cannot be
-combined with the pipeline — ``shard_map`` inside the stage ``vmap`` is
-not supported. ``seq`` must be 1 when ``stage`` > 1.
+Kernels inside the pipeline: on a mesh the per-tick stage computation runs
+under a *partial-manual* ``shard_map`` over the ``stage`` axis (every other
+axis stays under GSPMD). Because manual axes are disjoint, the flash
+attention kernel's own shard_map (over data/fsdp/tensor) and ring
+attention's (over data/fsdp/seq/tensor) nest inside it — pp×tp keeps the
+Pallas kernel and pp×sp keeps the ring exchange, instead of falling back
+to the dense einsum.
 """
 
 from __future__ import annotations
@@ -35,7 +39,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import llama
 from ..models.config import ModelConfig
 from ..ops.rotary import rotary_tables
-from ..parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_STAGE, mesh_axis_size
+from ..parallel.mesh import (
+    AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_STAGE, mesh_axis_size)
 
 
 def _stage_params(layers, num_stages: int):
@@ -115,11 +120,37 @@ def pipeline_forward(
         [pos_mb, jnp.zeros((num_stages - 1, mb, s), pos_mb.dtype)], axis=0)
 
     if mesh is not None:
-        buf_sharding = NamedSharding(mesh, P(AXIS_STAGE, (AXIS_DATA, AXIS_FSDP)))
+        # The activation's sequence dim rides the seq axis too, so ring
+        # attention under the pipeline starts from seq-sharded operands.
+        buf_sharding = NamedSharding(
+            mesh, P(AXIS_STAGE, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ))
         constrain = lambda a: lax.with_sharding_constraint(a, buf_sharding)
     else:
         constrain = lambda a: a  # shape-only run (tests, no mesh in scope)
     stage_idx = jnp.arange(num_stages)
+
+    if mesh is not None and mesh_axis_size(mesh, AXIS_STAGE) > 1:
+        if mesh_axis_size(mesh, AXIS_STAGE) != num_stages:
+            raise ValueError(
+                f"num_stages ({num_stages}) must equal the mesh stage axis "
+                f"({mesh_axis_size(mesh, AXIS_STAGE)})")
+
+        # Partial-manual over the stage axis only: each device group applies
+        # its single local stage; data/fsdp/seq/tensor stay under GSPMD, so
+        # kernel shard_maps (flash, ring) nest inside the body.
+        def _one_stage(layers_s, x, pos):
+            out, aux = stage_apply(
+                jax.tree.map(lambda l: l[0], layers_s), x[0], pos[0])
+            return out[None], aux[None]
+
+        stage_specs = jax.tree.map(lambda _: P(AXIS_STAGE), stage_layers)
+        stage_map = jax.shard_map(
+            _one_stage, mesh=mesh,
+            in_specs=(stage_specs, P(AXIS_STAGE), P(AXIS_STAGE)),
+            out_specs=(P(AXIS_STAGE), P(AXIS_STAGE)),
+            axis_names={AXIS_STAGE}, check_vma=False)
+    else:
+        stage_map = jax.vmap(stage_apply)
 
     def tick(carry, xs):
         buf, pos_buf, outputs, aux_total = carry
@@ -129,7 +160,7 @@ def pipeline_forward(
         # Positions ride along so each stage sees its own microbatch's.
         buf = constrain(jnp.concatenate([inject[None], buf[:-1]], axis=0))
         pos_buf = jnp.concatenate([pos_t[None], pos_buf[:-1]], axis=0)
-        out, aux = jax.vmap(stage_apply)(stage_layers, buf, pos_buf)
+        out, aux = stage_map(stage_layers, buf, pos_buf)
         out = constrain(out)
         # Only stages holding a real microbatch (0 <= t - s < M) count.
         valid = ((t - stage_idx >= 0)

@@ -34,30 +34,66 @@ from .pipeline import pipeline_degree, pipeline_forward
 
 
 def _resolve_attention(attention_fn, mesh: Mesh):
-    """None -> the best backend kernel (flash on TPU, dense einsum else).
-    Sequence-parallel callers pass ring attention explicitly.
+    """None -> the best kernel for the mesh: ring attention when the seq
+    axis is sharded, the Pallas flash kernel on multi-device TPU meshes,
+    dense einsum otherwise.
 
     On a multi-device mesh the pallas call must be wrapped in shard_map —
     GSPMD cannot partition a Mosaic custom-call, so an unwrapped kernel
     would silently all-gather q/k/v and run replicated per chip. Attention
     is independent across batch and heads, so the per-shard view over
-    (data+fsdp batch, tensor heads) is exact; a seq>1 mesh without an
-    explicit ring attention fn keeps the partitionable einsum path.
+    (data+fsdp batch, tensor heads) is exact. Under the pipeline, the stage
+    map is a *partial-manual* shard_map over ``stage`` only, so the kernel
+    shard_map is built against the ambient mesh with disjoint manual axes
+    and nests inside it (train/pipeline.py) — pp no longer forfeits the
+    kernel.
     """
     if attention_fn is not None:
         return attention_fn
+    pp = pipeline_degree(mesh) > 1
+    if mesh.shape[AXIS_SEQ] > 1:
+        # Sequence-sharded: ring attention IS the flash path (blockwise
+        # online-softmax over rotating KV blocks) and is exact. Head/batch
+        # dims that the tensor/data axes don't divide stay unsharded in the
+        # ring spec (replicated there, still seq-sharded) instead of
+        # crashing the shard_map. NOTE: the auto ring assumes standard
+        # broadcast positions (every batch row identical) — callers with
+        # per-row positions (packed sequences) must pass their own fn.
+        from ..ops.ring_attention import make_ring_attention
+
+        dp = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+        tensor = mesh.shape[AXIS_TENSOR]
+        cache: Dict[Tuple[bool, bool], Any] = {}
+
+        def ring_attn(q, k, v, positions):
+            use_batch = dp > 1 and q.shape[0] % dp == 0
+            use_head = (tensor > 1 and q.shape[2] % tensor == 0
+                        and k.shape[2] % tensor == 0)
+            ring = cache.get((use_batch, use_head))
+            if ring is None:
+                ring = make_ring_attention(
+                    mesh,
+                    batch_axes=(AXIS_DATA, AXIS_FSDP) if use_batch else (),
+                    head_axis=AXIS_TENSOR if use_head else None,
+                    nested=pp)
+                cache[(use_batch, use_head)] = ring
+            # Inside the stage map the body must be axis-index-free; the
+            # positions operand carries what the axis index would compute.
+            return ring(q, k, v, positions if pp else None)
+
+        return ring_attn
     flash = auto_attention(mesh.devices.flat[0].platform)
     if flash is None or mesh.size == 1:
         return flash
-    if mesh.shape[AXIS_SEQ] > 1 or pipeline_degree(mesh) > 1:
-        # seq>1 without an explicit ring fn, and the GPipe path (attention
-        # runs inside the stage vmap, where shard_map can't nest), both
-        # keep the partitionable einsum attention.
-        return None
     spec = P((AXIS_DATA, AXIS_FSDP), None, AXIS_TENSOR, None)
-    kernel = jax.shard_map(
-        lambda q, k, v: flash(q, k, v, None), mesh=mesh,
+    sm_kwargs: Dict[str, Any] = dict(
         in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    if pp:
+        sm_kwargs["axis_names"] = {AXIS_DATA, AXIS_FSDP, AXIS_TENSOR}
+    else:
+        sm_kwargs["mesh"] = mesh
+    kernel = jax.shard_map(
+        lambda q, k, v: flash(q, k, v, None), **sm_kwargs)
     tensor = mesh.shape[AXIS_TENSOR]
 
     def attn(q, k, v, positions):
@@ -185,11 +221,6 @@ def make_train_step(
     """
     b_sharding = NamedSharding(mesh, batch_spec())
     num_stages = pipeline_degree(mesh)
-    if num_stages > 1 and mesh.shape[AXIS_SEQ] > 1:
-        raise ValueError(
-            "pipeline (stage > 1) cannot combine with sequence parallelism "
-            "(seq > 1): ring attention's shard_map cannot nest inside the "
-            "stage vmap")
     attention_fn = _resolve_attention(attention_fn, mesh)
     microbatches = microbatches or num_stages
 
