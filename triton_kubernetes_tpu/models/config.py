@@ -33,6 +33,9 @@ class ModelConfig:
     num_selected: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    # "auto": sort-based dispatch once the dense [T,E,C] one-hots get big;
+    # "dense" / "sort" force a path (ops/moe.py).
+    moe_dispatch: str = "auto"
     # Numerics / compile shape
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"  # master weights
@@ -47,6 +50,10 @@ class ModelConfig:
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got "
                 f"{self.remat_policy!r}")
+        if self.moe_dispatch not in ("auto", "dense", "sort"):
+            raise ValueError(
+                f"moe_dispatch must be 'auto', 'dense', or 'sort', got "
+                f"{self.moe_dispatch!r}")
     scan_layers: bool = True  # lax.scan over the layer stack
 
     @property

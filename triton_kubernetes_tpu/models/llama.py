@@ -145,7 +145,8 @@ def _mlp(x: jnp.ndarray, layer: Params, config: ModelConfig,
             "w1": w("moe_w1"), "w3": w("moe_w3"), "w2": w("moe_w2"),
         }
         return moe_layer(
-            h, moe_params, config.num_selected, config.capacity_factor)
+            h, moe_params, config.num_selected, config.capacity_factor,
+            dispatch_mode=config.moe_dispatch)
     gate = jax.nn.silu(
         jnp.einsum("bsd,df->bsf", h, w("w3")).astype(jnp.float32)
     ).astype(ad)

@@ -11,7 +11,7 @@ from .rotary import apply_rotary, rotary_tables
 from .attention import auto_attention, causal_attention
 from .flash_attention import flash_attention
 from .ring_attention import make_ring_attention, ring_attention_inner
-from .moe import moe_layer, top_k_router
+from .moe import moe_layer, sort_router, top_k_router
 
 __all__ = [
     "rms_norm",
@@ -23,5 +23,6 @@ __all__ = [
     "make_ring_attention",
     "ring_attention_inner",
     "moe_layer",
+    "sort_router",
     "top_k_router",
 ]

@@ -21,6 +21,29 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _route(
+    x: jnp.ndarray,  # [T, D]
+    router_w: jnp.ndarray,  # [D, E]
+    num_selected: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared routing head for both dispatch paths: returns (top_p [T,K]
+    renormalized gates, top_i [T,K] expert ids, aux_loss). One
+    implementation so dense and sort dispatch can never diverge in routing
+    decisions or the load-balancing loss."""
+    e = router_w.shape[1]
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = lax.top_k(probs, num_selected)  # [T, K]
+    top_p = top_p / top_p.sum(axis=-1, keepdims=True)
+
+    # Load-balancing aux loss (Switch/Mixtral): E * <frac routed> . <mean prob>
+    first_choice = jax.nn.one_hot(top_i[:, 0], e, dtype=jnp.float32)
+    frac_routed = first_choice.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux_loss = e * jnp.sum(frac_routed * mean_prob)
+    return top_p, top_i, aux_loss
+
+
 def top_k_router(
     x: jnp.ndarray,  # [T, D]
     router_w: jnp.ndarray,  # [D, E]
@@ -34,16 +57,7 @@ def top_k_router(
     """
     t, _ = x.shape
     e = router_w.shape[1]
-    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    top_p, top_i = lax.top_k(probs, num_selected)  # [T, K]
-    top_p = top_p / top_p.sum(axis=-1, keepdims=True)
-
-    # Load-balancing aux loss (Switch/Mixtral): E * <frac routed> . <mean prob>
-    first_choice = jax.nn.one_hot(top_i[:, 0], e, dtype=jnp.float32)
-    frac_routed = first_choice.mean(axis=0)
-    mean_prob = probs.mean(axis=0)
-    aux_loss = e * jnp.sum(frac_routed * mean_prob)
+    top_p, top_i, aux_loss = _route(x, router_w, num_selected)
 
     dispatch = jnp.zeros((t, e, capacity), dtype=jnp.float32)
     combine = jnp.zeros((t, e, capacity), dtype=jnp.float32)
@@ -63,26 +77,104 @@ def top_k_router(
     return dispatch, combine, aux_loss
 
 
+def sort_router(
+    x: jnp.ndarray,  # [T, D]
+    router_w: jnp.ndarray,  # [D, E]
+    num_selected: int,
+    capacity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort-based slot assignment: identical semantics to ``top_k_router``
+    (priority-ordered GShard seating, same drops) without ever building the
+    [T, E, C] one-hot tensors — those are O(T²) at fixed capacity factor
+    and dominate HBM at Mixtral scale.
+
+    Returns (token_idx [T*K], slot [T*K], gate [T*K], keep [T*K], aux):
+    assignment i sends token ``token_idx[i]`` to flat expert-slot
+    ``slot[i]`` (expert*C + position) with combine weight ``gate[i]``;
+    ``keep`` masks assignments beyond capacity (dropped tokens).
+    """
+    t, _ = x.shape
+    top_p, top_i, aux_loss = _route(x, router_w, num_selected)
+
+    # Choice-major flattening (index j*T + t): a stable sort by expert then
+    # seats every token's first choice before any token's second choice,
+    # and ties within a choice by token id — exactly top_k_router's
+    # priority order.
+    flat_e = top_i.T.reshape(-1)  # [K*T]
+    flat_p = top_p.T.reshape(-1)
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    # Position within each expert's group: index minus the group's start
+    # (searchsorted on the already-sorted keys).
+    idx = jnp.arange(t * num_selected, dtype=jnp.int32)
+    group_start = jnp.searchsorted(sorted_e, sorted_e, side="left")
+    pos = idx - group_start.astype(jnp.int32)
+    keep = pos < capacity
+    slot = sorted_e * capacity + jnp.minimum(pos, capacity - 1)
+    token_idx = (order % t).astype(jnp.int32)
+    return token_idx, slot.astype(jnp.int32), flat_p[order], keep, aux_loss
+
+
+def _auto_dispatch_mode(t: int, e: int, capacity: int) -> str:
+    """Two f32 [T, E, C] tensors; beyond ~64 MB the quadratic term is the
+    layer's HBM high-water mark and sort dispatch wins (measured on v5e,
+    scripts/tpu/bench_moe.py)."""
+    return "sort" if 2 * 4 * t * e * capacity > 64 * 2**20 else "dense"
+
+
+def _expert_mlp(expert_in, params, out_dtype):
+    """[E, C, D] -> [E, C, D] SwiGLU per expert."""
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w1"])
+    g = jnp.einsum("ecd,edf->ecf", expert_in, params["w3"])
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(out_dtype) * h
+    return jnp.einsum("ecf,efd->ecd", h, params["w2"])
+
+
 def moe_layer(
     x: jnp.ndarray,  # [B, S, D]
     params: Dict[str, jnp.ndarray],  # router [D,E], w1/w3 [E,D,F], w2 [E,F,D]
     num_selected: int = 2,
     capacity_factor: float = 1.25,
+    dispatch_mode: str = "auto",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """SwiGLU experts; returns (y [B,S,D], aux_loss scalar)."""
+    """SwiGLU experts; returns (y [B,S,D], aux_loss scalar).
+
+    ``dispatch_mode``: ``"dense"`` = one-hot [T,E,C] einsum dispatch (lowers
+    to clean all-to-alls under expert sharding; fine at small T·E·C),
+    ``"sort"`` = argsort-over-expert-ids with scatter/gather (avoids the
+    O(T²)-at-fixed-capacity-factor one-hots; wins at scale — see
+    tests/test_ops.py equivalence and bench_moe.py), ``"auto"`` picks sort
+    once the dense dispatch tensors would exceed ~64 MB.
+    """
     b, s, d = x.shape
     e = params["router"].shape[1]
     t = b * s
     capacity = max(1, int(capacity_factor * num_selected * t / e))
     x2 = x.reshape(t, d)
+
+    if dispatch_mode == "auto":
+        dispatch_mode = _auto_dispatch_mode(t, e, capacity)
+
+    if dispatch_mode == "sort":
+        token_idx, slot, gate, keep, aux = sort_router(
+            x2, params["router"], num_selected, capacity)
+        safe_slot = jnp.where(keep, slot, e * capacity)  # OOB -> dropped
+        buf = jnp.zeros((e * capacity, d), dtype=x.dtype)
+        expert_in = buf.at[safe_slot].set(
+            x2[token_idx], mode="drop").reshape(e, capacity, d)
+        expert_out = _expert_mlp(expert_in, params, x.dtype)
+        contrib = expert_out.reshape(e * capacity, d)[slot].astype(
+            jnp.float32)
+        contrib = contrib * (gate * keep)[:, None]
+        y2 = jnp.zeros((t, d), jnp.float32).at[token_idx].add(contrib)
+        return y2.reshape(b, s, d).astype(x.dtype), aux
+
+    if dispatch_mode != "dense":
+        raise ValueError(
+            f"dispatch_mode must be auto|dense|sort, got {dispatch_mode!r}")
     dispatch, combine, aux = top_k_router(
         x2, params["router"], num_selected, capacity)
-
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x2.astype(jnp.float32))
-    expert_in = expert_in.astype(x.dtype)
-    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w1"])
-    g = jnp.einsum("ecd,edf->ecf", expert_in, params["w3"])
-    h = jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * h
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    expert_out = _expert_mlp(expert_in.astype(x.dtype), params, x.dtype)
     y2 = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
     return y2.reshape(b, s, d).astype(x.dtype), aux
