@@ -96,7 +96,13 @@ def test_apply_manifest_hits_kubectl(driver):
     d.bootstrap_manager("m1", "https://10.0.0.1")
     c = d.create_or_get_cluster("https://10.0.0.1", "dev")
     manifest = {"apiVersion": "apps/v1", "kind": "Deployment",
-                "metadata": {"name": "hello"}}
+                "metadata": {"name": "hello"},
+                "spec": {
+                    "selector": {"matchLabels": {"app": "hello"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "hello"}},
+                        "spec": {"containers": [
+                            {"name": "hello", "image": "pause:3.9"}]}}}}
     d.apply_manifest(c["id"], manifest)
     applies = [(a, i) for a, i in runner.calls if "apply" in a]
     assert len(applies) == 1

@@ -167,3 +167,42 @@ def test_azure_rke_ha_manager(tmp_path):
             assert vm["roles"] == ["controlplane", "etcd", "worker"]
     finally:
         delete_executor_state(d)
+
+
+@pytest.mark.parametrize("provider,module", [("gke", "gke-k8s"),
+                                             ("aks", "aks-k8s")])
+def test_hosted_cluster_import_agent_is_schema_valid(tmp_path, provider,
+                                                     module):
+    """The hosted-cluster import path applies a real agent Deployment (the
+    cattle-cluster-agent analog) that passes the simulator's mandatory
+    schema validation."""
+    d = StateDocument("mgr")
+    d.set_backend_config({"local": {"path": str(tmp_path / "tf.tfstate")}})
+    d.set_manager({"source": "modules/bare-metal-manager", "name": "mgr",
+                   "host": "10.0.0.1"})
+    cfg = {
+        "source": f"modules/{module}", "name": "hosted1",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        "node_count": 1,
+    }
+    if provider == "gke":
+        cfg.update(gcp_path_to_credentials="/tmp/c.json",
+                   gcp_project_id="p", gcp_zone="us-central1-a",
+                   master_password="0123456789abcdef")
+    else:
+        cfg.update(azure_subscription_id="s", azure_client_id="c",
+                   azure_client_secret="x", azure_tenant_id="t",
+                   azure_location="eastus", azure_ssh_public_key="ssh-rsa k")
+    ckey = d.add_cluster(provider, "hosted1", cfg)
+    ex = LocalExecutor(log=lambda m: None)
+    ex.apply(d)
+    cid = ex.output(d, ckey)["cluster_id"]
+    deps = ex.cloud_view(d).get_manifests(cid, "Deployment")
+    agent = [m for m in deps
+             if m["metadata"]["name"] == "cattle-cluster-agent"][0]
+    assert agent["spec"]["selector"]["matchLabels"] == \
+        agent["spec"]["template"]["metadata"]["labels"]
+    assert agent["spec"]["template"]["spec"]["containers"][0]["image"]
+    delete_executor_state(d)

@@ -96,3 +96,63 @@ def test_peak_flops_table_sane():
     for gen in TPU_GENERATIONS.values():
         assert gen.peak_bf16_tflops > 100
         assert gen.chips_per_host in (4, 8)
+
+
+# ---------------------------------------------------------- schema validation
+def test_all_renders_pass_schema_validation():
+    """Every manifest the framework renders validates against the K8s
+    schemas — JobSet, Service, and the three DaemonSets."""
+    from triton_kubernetes_tpu.topology.daemonsets import (
+        render_slice_health_daemonset, render_tpu_device_plugin,
+        render_tpu_runtime_daemonset)
+    from triton_kubernetes_tpu.topology.validate import validate_manifest
+
+    spec = SliceSpec.from_accelerator("v5p-64")
+    for m in (render_jobset("train", spec, "s0", "tk8s/jax-tpu-runtime:0.1.0",
+                            ["python", "-m", "triton_kubernetes_tpu.train"]),
+              render_headless_service("train"),
+              render_tpu_runtime_daemonset(spec),
+              render_tpu_device_plugin(spec),
+              render_slice_health_daemonset(spec)):
+        validate_manifest(m)
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda m: m["metadata"].update(name="Bad_Name"), "name"),
+    (lambda m: m["spec"]["selector"]["matchLabels"].update(app="other"),
+     "selector"),
+    (lambda m: m["spec"]["template"]["spec"]["containers"][0].pop("image"),
+     "image"),
+    (lambda m: m["spec"]["template"]["spec"]["containers"][0].update(
+        ports=[{"containerPort": 99999}]), "99999"),
+    (lambda m: m["metadata"].update(labels={"app": "bad value!"}),
+     "bad value"),
+])
+def test_schema_rejects_broken_manifests(mutate, match):
+    from triton_kubernetes_tpu.topology.daemonsets import (
+        render_tpu_runtime_daemonset)
+    from triton_kubernetes_tpu.topology.validate import (
+        ManifestError, validate_manifest)
+
+    m = render_tpu_runtime_daemonset(SliceSpec.from_accelerator("v5e-8"))
+    mutate(m)
+    with pytest.raises(ManifestError, match=match):
+        validate_manifest(m)
+
+
+def test_simulator_rejects_invalid_manifest():
+    """The in-process cloud behaves like a real API server on apply."""
+    from triton_kubernetes_tpu.executor.cloudsim import CloudSimulator
+    from triton_kubernetes_tpu.topology.validate import ManifestError
+
+    sim = CloudSimulator()
+    sim.bootstrap_manager("m", "https://10.0.0.1")
+    c = sim.create_or_get_cluster("https://10.0.0.1", "dev")
+    with pytest.raises(ManifestError, match="required"):
+        sim.apply_manifest(c["id"], {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "no-spec"}})
+    # Unknown CRD kinds validate the generic envelope only.
+    sim.apply_manifest(c["id"], {
+        "apiVersion": "velero.io/v1", "kind": "Restore",
+        "metadata": {"name": "r1"}, "spec": {"backupName": "b"}})

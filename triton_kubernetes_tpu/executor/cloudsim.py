@@ -185,7 +185,14 @@ class CloudSimulator:
 
     # ---------------------------------------------------------------- manifests
     def apply_manifest(self, cluster_id: str, manifest: Dict[str, Any]) -> None:
-        """kubectl-apply analog, idempotent on (kind, metadata.name)."""
+        """kubectl-apply analog, idempotent on (kind, metadata.name).
+
+        Schema-validates first (topology/validate.py) so the simulator
+        rejects what a real API server would — renders are exercised like
+        ``kubectl apply --dry-run=server``, in every workflow test."""
+        from ..topology.validate import validate_manifest
+
+        validate_manifest(manifest)
         objs = self.manifests.setdefault(cluster_id, [])
         ident = (manifest.get("kind"), manifest.get("metadata", {}).get("name"))
         for i, existing in enumerate(objs):

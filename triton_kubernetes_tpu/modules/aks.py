@@ -9,7 +9,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Tuple
 
-from .base import DriverContext, Module, Resource, Variable
+from .base import (
+    DriverContext, Module, Resource, Variable, agent_import_manifest)
 from .registry import register
 
 
@@ -50,11 +51,10 @@ class AksCluster(Module):
         )
         imported = ctx.cloud.create_or_get_cluster(
             config["manager_url"], name, imported=True, kind="aks")
-        ctx.cloud.apply_manifest(imported["id"], {
-            "apiVersion": "apps/v1", "kind": "Deployment",
-            "metadata": {"name": "cattle-cluster-agent", "namespace": "cattle-system"},
-            "spec": {"replicas": 1},
-        })
+        ctx.cloud.apply_manifest(
+            imported["id"],
+            agent_import_manifest(str(config.get("rancher_agent_image",
+                                                 "tk8s/agent:2.0"))))
         ctx.cloud.create_resource("cluster", imported["id"], cluster_name=name)
         resources = [Resource("aks_cluster", name), Resource("cluster", imported["id"])]
         return ({"cluster_id": imported["id"],

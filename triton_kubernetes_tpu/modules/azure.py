@@ -102,8 +102,23 @@ class AzureRkeManager(ManagerModule):
                 ca_checksum=mgr_cluster["ca_checksum"])
         ctx.cloud.apply_manifest(mgr_cluster["id"], {
             "apiVersion": "apps/v1", "kind": "Deployment",
-            "metadata": {"name": "cluster-manager", "namespace": "cattle-system"},
-            "spec": {"replicas": int(config.get("node_count", 3))},
+            "metadata": {"name": "cluster-manager",
+                         "namespace": "cattle-system",
+                         "labels": {"app": "cluster-manager"}},
+            "spec": {
+                "replicas": int(config.get("node_count", 3)),
+                "selector": {"matchLabels": {"app": "cluster-manager"}},
+                "template": {
+                    "metadata": {"labels": {"app": "cluster-manager"}},
+                    "spec": {"containers": [{
+                        "name": "manager",
+                        "image": str(config.get("manager_image",
+                                                "tk8s/manager:2.0")),
+                        "ports": [{"containerPort": 80},
+                                  {"containerPort": 443}],
+                    }]},
+                },
+            },
         })
         ctx.cloud.apply_manifest(mgr_cluster["id"], {
             "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",

@@ -33,12 +33,30 @@ class _BackupBase(Module):
               ) -> Tuple[Dict[str, Any], List[Resource]]:
         cluster_id = config["cluster_id"]
         loc = self.location(config)
+        # A real Deployment (selector/template/container) — the same shape
+        # files/setup_backup.sh kubectl-applies on the terraform path; the
+        # simulator schema-validates every apply, so a fake shape would be
+        # rejected exactly like a real API server would.
         manifests = [{
             "apiVersion": "apps/v1", "kind": "Deployment",
-            "metadata": {"name": "velero", "namespace": "velero"},
-            "spec": {"replicas": 1,
-                     "backupStorageLocation": {"provider": self.KIND,
-                                               "bucket": loc}},
+            "metadata": {"name": "velero", "namespace": "velero",
+                         "labels": {"app": "velero"}},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "velero"}},
+                "template": {
+                    "metadata": {"labels": {"app": "velero"}},
+                    "spec": {"containers": [{
+                        "name": "velero",
+                        "image": "velero/velero:v1.13.2",
+                        "args": ["server"],
+                        "env": [
+                            {"name": "BACKUP_PROVIDER", "value": self.KIND},
+                            {"name": "BACKUP_LOCATION", "value": loc},
+                        ],
+                    }]},
+                },
+            },
         }] + self.extra_manifests(config)
         for m in manifests:
             ctx.cloud.apply_manifest(cluster_id, m)
@@ -113,6 +131,23 @@ class MantaBackup(_BackupBase):
         # files/minio-manta-deployment.yaml:30-55).
         return [{
             "apiVersion": "apps/v1", "kind": "Deployment",
-            "metadata": {"name": "minio-manta-gateway", "namespace": "velero"},
-            "spec": {"replicas": 1},
+            "metadata": {"name": "minio-manta-gateway",
+                         "namespace": "velero",
+                         "labels": {"app": "minio-manta-gateway"}},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "minio-manta-gateway"}},
+                "template": {
+                    "metadata": {"labels": {"app": "minio-manta-gateway"}},
+                    "spec": {"containers": [{
+                        "name": "minio",
+                        "image": "minio/minio:RELEASE.2019-08-07T01-59-21Z",
+                        "args": ["gateway", "manta"],
+                        "env": [{"name": "MANTA_SUBUSER",
+                                 "value": str(config.get("manta_subuser",
+                                                         ""))}],
+                        "ports": [{"containerPort": 9000}],
+                    }]},
+                },
+            },
         }]

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Tuple
 
-from .base import DriverContext, Module, Resource, Variable
+from .base import (
+    DriverContext, Module, Resource, Variable, agent_import_manifest)
 from .registry import register
 
 
@@ -55,11 +56,10 @@ class GkeCluster(Module):
         # create-or-get registration with imported=True, no RKE config.
         imported = ctx.cloud.create_or_get_cluster(
             config["manager_url"], name, imported=True, kind="gke")
-        ctx.cloud.apply_manifest(imported["id"], {
-            "apiVersion": "apps/v1", "kind": "Deployment",
-            "metadata": {"name": "cattle-cluster-agent", "namespace": "cattle-system"},
-            "spec": {"replicas": 1},
-        })
+        ctx.cloud.apply_manifest(
+            imported["id"],
+            agent_import_manifest(str(config.get("rancher_agent_image",
+                                                 "tk8s/agent:2.0"))))
         resources = [Resource("gke_cluster", name),
                      Resource("cluster", imported["id"])]
         ctx.cloud.create_resource("cluster", imported["id"], cluster_name=name)
