@@ -1,0 +1,149 @@
+"""Provider catalogs: the live-API prompt seam, against a fake GCP server.
+
+The reference validates every provider prompt against live cloud APIs
+(create/manager_gcp.go:22-422, create/cluster_gke.go GetServerconfig). The
+LiveGcpCatalog speaks the same compute/container REST surface; here a fake
+in-process server serves it so the request/parse/pagination paths execute
+for real, and workflows are driven end-to-end with live choices replacing
+the static lists.
+"""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from triton_kubernetes_tpu.backends import MemoryBackend
+from triton_kubernetes_tpu.catalogs import Catalog, StaticCatalog, make_catalog
+from triton_kubernetes_tpu.catalogs.gcp import LiveGcpCatalog
+from triton_kubernetes_tpu.config import (
+    Config, InputResolver, ValidationError)
+from triton_kubernetes_tpu.executor import LocalExecutor
+from triton_kubernetes_tpu.workflows import WorkflowContext, new_manager
+
+
+class FakeGcpApi(BaseHTTPRequestHandler):
+    regions = ["us-central1", "us-east5", "made-up-region1"]
+    zones = ["us-central1-a", "us-central1-b", "us-east5-a", "us-east5-b"]
+    machine_types = ["n2-standard-4", "n2-standard-8", "c3-standard-4"]
+    master_versions = ["1.33.2-gke.100", "1.32.6-gke.200"]
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(url.query))
+        path = url.path
+
+        def paged(names):
+            # One-item pages so pagination is really exercised.
+            start = int(q.get("pageToken") or 0)
+            out = {"items": [{"name": n} for n in names[start:start + 1]]}
+            if start + 1 < len(names):
+                out["nextPageToken"] = str(start + 1)
+            return out
+
+        if path.endswith("/regions"):
+            self._json(paged(self.regions))
+        elif path.endswith("/zones"):
+            self._json(paged(self.zones))
+        elif path.endswith("/machineTypes"):
+            self._json(paged(self.machine_types))
+        elif "ubuntu-os-cloud/global/images" in path:
+            self._json({"items": [{"family": "ubuntu-2404-lts"},
+                                  {"family": "ubuntu-2204-lts"},
+                                  {"family": "ubuntu-2404-lts"}]})
+        elif path.endswith("/serverconfig"):
+            self._json({"validMasterVersions": self.master_versions})
+        else:
+            self._json({"items": []})
+
+
+@pytest.fixture()
+def gcp_api():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeGcpApi)
+    t = threading.Thread(
+        target=lambda: httpd.serve_forever(poll_interval=0.05), daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _live(gcp_api):
+    return LiveGcpCatalog(project="proj-1", compute_endpoint=gcp_api,
+                          container_endpoint=gcp_api)
+
+
+def test_live_lookups_and_pagination(gcp_api):
+    cat = _live(gcp_api)
+    assert cat.regions() == FakeGcpApi.regions  # 3 one-item pages
+    assert cat.zones("us-east5") == ["us-east5-a", "us-east5-b"]
+    assert cat.machine_types("us-east5-a") == FakeGcpApi.machine_types
+    assert cat.images() == ["ubuntu-os-cloud/ubuntu-2204-lts",
+                            "ubuntu-os-cloud/ubuntu-2404-lts"]
+    assert cat.k8s_versions("us-east5-a") == FakeGcpApi.master_versions
+
+
+def test_choices_seam_and_graceful_degradation(gcp_api):
+    cat = _live(gcp_api)
+    assert cat.choices("gcp", "regions") == FakeGcpApi.regions
+    assert cat.choices("aws", "regions") is None  # not this catalog's cloud
+    # Dead endpoint: degrade to None so static lists take over.
+    dead = LiveGcpCatalog(project="p", compute_endpoint="http://127.0.0.1:9",
+                          container_endpoint="http://127.0.0.1:9")
+    assert dead.choices("gcp", "regions") is None
+
+
+def test_workflow_validates_against_live_catalog(gcp_api):
+    """create manager (gcp) accepts a region only the live API knows and
+    rejects one neither the API nor the static list has — the reference's
+    validated-prompt contract through the seam."""
+    def run(region):
+        cfg = Config()
+        for k, v in {"manager_cloud_provider": "gcp", "name": "m1",
+                     "gcp_path_to_credentials": "/s.json",
+                     "gcp_project_id": "p",
+                     "gcp_compute_region": region}.items():
+            cfg.set(k, v)
+        ctx = WorkflowContext(
+            backend=MemoryBackend(), executor=LocalExecutor(log=lambda m: None),
+            resolver=InputResolver(cfg, None, True),
+            catalog=_live(gcp_api))
+        return new_manager(ctx)
+
+    assert run("made-up-region1") == "m1"  # only the live API offers this
+    with pytest.raises(ValidationError, match="not a valid choice"):
+        run("nowhere-east1")
+
+
+def test_static_catalog_and_make_catalog():
+    static = StaticCatalog({"gcp:regions": ["r1"]})
+    assert static.choices("gcp", "regions") == ["r1"]
+    assert static.choices("gcp", "images") is None
+
+    cfg = Config()
+    assert isinstance(make_catalog(cfg), Catalog)
+    cfg.set("catalog", "live")
+    assert isinstance(make_catalog(cfg), LiveGcpCatalog)
+    cfg.set("catalog", "nope")
+    with pytest.raises(ValidationError):
+        make_catalog(cfg)
+
+
+def test_tpu_regions_not_answered_by_generic_lookup(gcp_api):
+    """TPU capability isn't derivable from the compute regions list: the
+    live catalog must decline 'gcp-tpu'/'regions' so the static
+    TPU-capable list keeps enforcing the constraint."""
+    assert _live(gcp_api).choices("gcp-tpu", "regions") is None

@@ -1,0 +1,65 @@
+"""Provider choice catalogs: where interactive prompt options come from.
+
+The reference drives every provider prompt from live cloud APIs (regions/
+zones/machine types via the compute API, create/manager_gcp.go:22-422; GKE
+master versions via GetServerconfig, create/cluster_gke.go:26-519). This
+package is that seam rebuilt: workflows ask the context's catalog for
+choices and fall back to their static lists when the catalog has none —
+so silent installs and tests never need a network, while ``catalog: live``
+swaps real SDK-backed lookups in.
+
+``Catalog.choices`` returning ``None`` means "no opinion, use the static
+fallback"; returning a list replaces the options AND the validation set
+(a configured value must be one of them — the reference's validated-prompt
+contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class Catalog:
+    """Base: no opinions; workflows keep their static lists."""
+
+    def choices(self, provider: str, kind: str,
+                context: Optional[Dict[str, Any]] = None
+                ) -> Optional[List[str]]:
+        return None
+
+
+class StaticCatalog(Catalog):
+    """The default. Explicit data beats ``None`` so tests can pin exactly
+    which options a given (provider, kind) shows."""
+
+    def __init__(self, data: Optional[Dict[str, List[str]]] = None):
+        self.data = data or {}
+
+    def choices(self, provider, kind, context=None):
+        return self.data.get(f"{provider}:{kind}")
+
+
+def make_catalog(config) -> Catalog:
+    """Build the catalog the ``catalog:`` config key names.
+
+    ``static`` (default) keeps the workflows' built-in lists; ``live``
+    returns SDK-backed catalogs where implemented (GCP today; other
+    providers fall back to static per-call).
+    """
+    from ..config import ValidationError
+
+    kind = config.get("catalog") if config.is_set("catalog") else "static"
+    if kind == "static":
+        return Catalog()
+    if kind == "live":
+        from .gcp import LiveGcpCatalog
+
+        return LiveGcpCatalog(
+            credentials_path=str(config.get("gcp_path_to_credentials") or ""),
+            project=str(config.get("gcp_project_id") or ""),
+        )
+    raise ValidationError(
+        f"catalog: {kind!r} is not a valid choice (valid: ['static', 'live'])")
+
+
+__all__ = ["Catalog", "StaticCatalog", "make_catalog"]

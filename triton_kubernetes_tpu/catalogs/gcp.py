@@ -1,0 +1,162 @@
+"""Live GCP catalog: compute + container APIs behind the Catalog seam.
+
+Reference analog: create/manager_gcp.go:22-422 (regions/zones/machine
+types/images from compute/v1) and create/cluster_gke.go:26-519 (valid
+master versions from the container API's serverConfig). Stdlib HTTP with
+the same service-account JWT grant the GCS backend uses
+(backends/gcs.py) — no cloud SDK import. ``endpoint`` overrides route to a
+fake server in tests, so every request/parse path executes for real.
+
+Lookups degrade gracefully: any HTTP/auth failure returns ``None`` (the
+workflow's static list takes over) rather than blocking an interactive
+session on a flaky API — silent installs validated against live data can
+instead pin ``catalog: live`` and let the error surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from . import Catalog
+from ..backends.gcs import service_account_jwt, TOKEN_URL
+
+COMPUTE = "https://compute.googleapis.com/compute/v1"
+CONTAINER = "https://container.googleapis.com/v1"
+SCOPE = "https://www.googleapis.com/auth/cloud-platform"
+
+
+class LiveGcpCatalog(Catalog):
+    def __init__(self, credentials_path: str = "", project: str = "",
+                 compute_endpoint: str = "", container_endpoint: str = "",
+                 authenticated: Optional[bool] = None):
+        self.credentials_path = credentials_path
+        self.project = project
+        self.compute = (compute_endpoint or COMPUTE).rstrip("/")
+        self.container = (container_endpoint or CONTAINER).rstrip("/")
+        # Fake servers in tests take no auth.
+        self.authenticated = (not (compute_endpoint or container_endpoint)
+                              if authenticated is None else authenticated)
+        self._token: Optional[str] = None
+        self._token_expiry = 0.0
+
+    # ------------------------------------------------------------- plumbing
+    def _access_token(self) -> Optional[str]:
+        if not self.authenticated:
+            return None
+        if self._token and time.time() < self._token_expiry - 60:
+            return self._token
+        path = os.path.expanduser(self.credentials_path or os.environ.get(
+            "GOOGLE_APPLICATION_CREDENTIALS", ""))
+        with open(path) as f:
+            creds = json.load(f)
+        if not self.project:
+            # The reference's re-unmarshal trick (create/manager_gcp.go).
+            self.project = creds.get("project_id", "")
+        body = urllib.parse.urlencode({
+            "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
+            "assertion": service_account_jwt(creds),
+        }).encode()
+        req = urllib.request.Request(TOKEN_URL, data=body, headers={
+            "Content-Type": "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            tok = json.load(resp)
+        self._token = tok["access_token"]
+        self._token_expiry = time.time() + int(tok.get("expires_in", 3600))
+        return self._token
+
+    def _get(self, url: str) -> Dict[str, Any]:
+        headers = {}
+        token = self._access_token()
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.load(resp)
+
+    def _list_names(self, url: str) -> List[str]:
+        """Paginated compute list -> item names."""
+        names: List[str] = []
+        page = None
+        while True:
+            u = url + (f"&pageToken={page}" if page else "")
+            body = self._get(u)
+            names += [i["name"] for i in body.get("items", [])]
+            page = body.get("nextPageToken")
+            if not page:
+                return names
+
+    # -------------------------------------------------------------- lookups
+    def regions(self) -> List[str]:
+        return self._list_names(
+            f"{self.compute}/projects/{self.project}/regions?fields="
+            "items/name,nextPageToken")
+
+    def zones(self, region: str = "") -> List[str]:
+        names = self._list_names(
+            f"{self.compute}/projects/{self.project}/zones?fields="
+            "items/name,nextPageToken")
+        if region:
+            names = [n for n in names if n.startswith(region + "-")]
+        return names
+
+    def machine_types(self, zone: str) -> List[str]:
+        return self._list_names(
+            f"{self.compute}/projects/{self.project}/zones/{zone}/"
+            "machineTypes?fields=items/name,nextPageToken")
+
+    def images(self) -> List[str]:
+        # The reference lists ubuntu-os-cloud family images
+        # (create/manager_gcp.go image prompt). Paginated like every other
+        # lookup — the image list easily exceeds one page.
+        families: set = set()
+        page = None
+        base = (f"{self.compute}/projects/ubuntu-os-cloud/global/images"
+                "?fields=items/family,nextPageToken")
+        while True:
+            body = self._get(base + (f"&pageToken={page}" if page else ""))
+            families |= {i["family"] for i in body.get("items", [])
+                         if i.get("family")}
+            page = body.get("nextPageToken")
+            if not page:
+                break
+        return [f"ubuntu-os-cloud/{f}" for f in sorted(families)]
+
+    def k8s_versions(self, zone: str) -> List[str]:
+        """GKE valid master versions (GetServerconfig analog)."""
+        cfg = self._get(
+            f"{self.container}/projects/{self.project}/zones/{zone}/"
+            "serverconfig")
+        return list(cfg.get("validMasterVersions", []))
+
+    # ---------------------------------------------------------- Catalog API
+    def choices(self, provider, kind, context=None):
+        context = context or {}
+        if provider not in ("gcp", "gcp-tpu", "gke"):
+            return None
+        if provider == "gcp-tpu" and kind == "regions":
+            # TPU capacity is NOT derivable from the compute regions list;
+            # answering with all project regions would silently drop the
+            # TPU-capable constraint the static list enforces.
+            return None
+        try:
+            if kind == "regions":
+                return self.regions() or None
+            if kind == "zones":
+                return self.zones(context.get("region", "")) or None
+            if kind == "machine_types":
+                return self.machine_types(
+                    context.get("zone", "us-central1-a")) or None
+            if kind == "images":
+                return self.images() or None
+            if kind == "k8s_versions":
+                return self.k8s_versions(
+                    context.get("zone", "us-central1-a")) or None
+        except (urllib.error.URLError, OSError, ValueError, KeyError):
+            return None  # degrade to the static list
+        return None

@@ -185,10 +185,13 @@ def main(argv: Optional[List[str]] = None,
     resolver = InputResolver(config, prompter, args.non_interactive)
 
     try:
+        from ..catalogs import make_catalog
+
         be = backend if backend is not None else choose_backend(resolver)
         ex = executor if executor is not None else choose_executor(
             resolver, logger)
-        ctx = WorkflowContext(backend=be, executor=ex, resolver=resolver)
+        ctx = WorkflowContext(backend=be, executor=ex, resolver=resolver,
+                              catalog=make_catalog(config))
 
         if args.command == "create":
             result = {"manager": new_manager, "cluster": new_cluster,

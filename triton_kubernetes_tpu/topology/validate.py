@@ -265,15 +265,22 @@ def _check_unique_container_names(manifest: Dict[str, Any]) -> None:
                 f"duplicate container names {names}")
 
 
+# Precompiled once: the simulator validates on every apply, and
+# jsonschema.validate would re-check and re-build the validator per call.
+_VALIDATORS = {kind: jsonschema.Draft202012Validator(schema)
+               for kind, schema in SCHEMAS.items()}
+_GENERIC_VALIDATOR = jsonschema.Draft202012Validator(_GENERIC)
+
+
 def validate_manifest(manifest: Dict[str, Any]) -> None:
     """Raise :class:`ManifestError` when a rendered object would be
     rejected by a Kubernetes API server (structural subset)."""
     if not isinstance(manifest, dict):
         raise ManifestError(f"manifest must be a mapping, got {manifest!r}")
     kind = manifest.get("kind")
-    schema = SCHEMAS.get(kind, _GENERIC)
+    validator = _VALIDATORS.get(kind, _GENERIC_VALIDATOR)
     try:
-        jsonschema.validate(manifest, schema)
+        validator.validate(manifest)
     except jsonschema.ValidationError as e:
         path = ".".join(str(p) for p in e.absolute_path) or "<root>"
         raise ManifestError(

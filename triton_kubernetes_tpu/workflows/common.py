@@ -7,10 +7,11 @@ so silent-mode behavior is pin-compatible.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..backends import Backend
+from ..catalogs import Catalog
 from ..config import Config, InputResolver, MissingInputError
 from ..state import StateDocument
 
@@ -24,6 +25,9 @@ class WorkflowContext:
     backend: Backend
     executor: object  # LocalExecutor or TerraformExecutor
     resolver: InputResolver
+    # Provider choice catalog (live cloud APIs when `catalog: live`);
+    # the default has no opinions, so static lists rule.
+    catalog: Catalog = field(default_factory=Catalog)
 
     @property
     def config(self) -> Config:
@@ -32,6 +36,14 @@ class WorkflowContext:
     @property
     def non_interactive(self) -> bool:
         return self.resolver.non_interactive
+
+    def choices(self, provider: str, kind: str, fallback: List[str],
+                context: Optional[Dict[str, Any]] = None) -> List[str]:
+        """Catalog-backed prompt options with a static fallback — the
+        reference's live-API validated prompts (create/manager_gcp.go
+        :22-422), behind one seam."""
+        live = self.catalog.choices(provider, kind, context)
+        return list(live) if live else fallback
 
 
 def module_source(ctx: WorkflowContext, name: str) -> str:

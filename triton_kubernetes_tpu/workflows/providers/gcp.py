@@ -46,16 +46,21 @@ def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> Non
     r = ctx.resolver
     cfg = base_manager_config(ctx, "gcp-manager", name)
     cfg.update(_creds(ctx))
+    regions = ctx.choices("gcp", "regions", REGIONS)
     cfg["gcp_compute_region"] = r.choose(
-        "gcp_compute_region", "GCP Region", [(x, x) for x in REGIONS],
-        default=REGIONS[0])
+        "gcp_compute_region", "GCP Region", [(x, x) for x in regions],
+        default=regions[0])
     cfg["gcp_zone"] = r.value("gcp_zone", "GCP Zone",
                               default=f"{cfg['gcp_compute_region']}-a")
+    machine_types = ctx.choices("gcp", "machine_types", MACHINE_TYPES,
+                                {"zone": cfg["gcp_zone"]})
     cfg["gcp_machine_type"] = r.choose(
         "gcp_machine_type", "GCP Machine Type",
-        [(t, t) for t in MACHINE_TYPES], default=MACHINE_TYPES[1])
+        [(t, t) for t in machine_types],
+        default=machine_types[min(1, len(machine_types) - 1)])
+    images = ctx.choices("gcp", "images", IMAGES)
     cfg["gcp_image"] = r.choose("gcp_image", "GCP Image",
-                                [(i, i) for i in IMAGES], default=IMAGES[0])
+                                [(i, i) for i in images], default=images[0])
     state.set_manager(cfg)
 
 
@@ -63,9 +68,10 @@ def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str
     r = ctx.resolver
     cfg = base_cluster_config(ctx, "gcp-k8s", name)
     cfg.update(_creds(ctx))
+    regions = ctx.choices("gcp", "regions", REGIONS)
     cfg["gcp_compute_region"] = r.choose(
-        "gcp_compute_region", "GCP Region", [(x, x) for x in REGIONS],
-        default=REGIONS[0])
+        "gcp_compute_region", "GCP Region", [(x, x) for x in regions],
+        default=regions[0])
     return state.add_cluster("gcp", name, cfg)
 
 
@@ -113,13 +119,27 @@ def gke_cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) ->
         "gcp_zone": r.value("gcp_zone", "GCP Zone", default="us-central1-a"),
         "gcp_additional_zones": r.value("gcp_additional_zones",
                                         "GCP Additional Zones", default=[]),
+    }
+    machine_types = ctx.choices("gke", "machine_types", MACHINE_TYPES,
+                                {"zone": cfg["gcp_zone"]})
+    # Valid master versions from the live serverConfig when the catalog has
+    # them (create/cluster_gke.go's GetServerconfig prompt); free-form with
+    # a default otherwise.
+    versions = ctx.choices("gke", "k8s_versions", [],
+                           {"zone": cfg["gcp_zone"]})
+    cfg.update({
         "gcp_machine_type": r.choose(
             "gcp_machine_type", "GCP Machine Type",
-            [(t, t) for t in MACHINE_TYPES], default=MACHINE_TYPES[1]),
-        "k8s_version": r.value("k8s_version", "Kubernetes Master Version",
-                               default="1.31"),
+            [(t, t) for t in machine_types],
+            default=machine_types[min(1, len(machine_types) - 1)]),
+        "k8s_version": (
+            r.choose("k8s_version", "Kubernetes Master Version",
+                     [(v, v) for v in versions], default=versions[0])
+            if versions else
+            r.value("k8s_version", "Kubernetes Master Version",
+                    default="1.31")),
         "node_count": int(r.value("node_count", "Node Count", default=3)),
         "master_password": r.value("master_password", "GKE Master Password",
                                    default="change-me-please-16", validate=_pw),
-    }
+    })
     return state.add_cluster("gke", name, cfg)
