@@ -1,0 +1,34 @@
+#!/usr/bin/env python3
+"""terraform data.external program: mint a cluster kubeconfig from the
+manager (POST /v3/clusters/<id>?action=generateKubeconfig — the call the
+reference's backup path makes, k8s-backup-manta/main.tf:28-39). Reads
+{manager_url, access_key, secret_key, cluster_id} on stdin, emits
+{config: <kubeconfig>} on stdout. Stdlib-only, like register_cluster.py."""
+
+import base64
+import json
+import ssl
+import sys
+import urllib.request
+
+
+def main():
+    q = json.load(sys.stdin)
+    url = (f"{q['manager_url'].rstrip('/')}/v3/clusters/"
+           f"{q['cluster_id']}?action=generateKubeconfig")
+    auth = base64.b64encode(
+        f"{q['access_key']}:{q['secret_key']}".encode()).decode()
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    req = urllib.request.Request(url, data=b"{}", method="POST", headers={
+        "Content-Type": "application/json",
+        "Authorization": f"Basic {auth}",
+    })
+    with urllib.request.urlopen(req, timeout=60, context=ctx) as resp:
+        config = json.load(resp)["config"]
+    json.dump({"config": config}, sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ HCL_MODULES = [
     "gcp-manager", "gcp-tpu-k8s", "gcp-tpu-nodepool", "tpu-jobset",
     "aws-manager", "aws-k8s", "aws-k8s-host",
     "bare-metal-manager", "bare-metal-k8s", "bare-metal-k8s-host",
+    "azure-manager", "azure-rke-manager", "azure-k8s", "azure-k8s-host",
     "k8s-backup-gcs", "k8s-backup-s3",
 ]
 

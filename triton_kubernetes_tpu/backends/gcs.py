@@ -67,6 +67,22 @@ def service_account_jwt(creds: Dict[str, Any], now: Optional[int] = None,
     return (signing_input + b"." + _b64url(signature)).decode()
 
 
+def exchange_service_account_token(creds: Dict[str, Any],
+                                   token_url: str = TOKEN_URL
+                                   ) -> Dict[str, Any]:
+    """One OAuth2 JWT-grant exchange: service-account dict -> token
+    response ({access_token, expires_in, ...}). Shared by the GCS store and
+    the live GCP catalog so the auth plumbing exists exactly once."""
+    body = urllib.parse.urlencode({
+        "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
+        "assertion": service_account_jwt(creds),
+    }).encode()
+    req = urllib.request.Request(token_url, data=body, headers={
+        "Content-Type": "application/x-www-form-urlencoded"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.load(resp)
+
+
 class GcsObjectStore(ObjectStore):
     """GCS JSON-API implementation. Generations are GCS's own object
     generations — preconditions are enforced server-side, so two machines
@@ -109,15 +125,7 @@ class GcsObjectStore(ObjectStore):
                 "gcp_path_to_credentials / GOOGLE_APPLICATION_CREDENTIALS")
         with open(path) as f:
             creds = json.load(f)
-        assertion = service_account_jwt(creds)
-        body = urllib.parse.urlencode({
-            "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
-            "assertion": assertion,
-        }).encode()
-        req = urllib.request.Request(TOKEN_URL, data=body, headers={
-            "Content-Type": "application/x-www-form-urlencoded"})
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            tok = json.load(resp)
+        tok = exchange_service_account_token(creds)
         self._token = tok["access_token"]
         self._token_expiry = time.time() + int(tok.get("expires_in", 3600))
         return self._token

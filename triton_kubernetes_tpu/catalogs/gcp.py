@@ -24,7 +24,7 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 from . import Catalog
-from ..backends.gcs import service_account_jwt, TOKEN_URL
+from ..backends.gcs import exchange_service_account_token
 
 COMPUTE = "https://compute.googleapis.com/compute/v1"
 CONTAINER = "https://container.googleapis.com/v1"
@@ -46,26 +46,30 @@ class LiveGcpCatalog(Catalog):
         self._token_expiry = 0.0
 
     # ------------------------------------------------------------- plumbing
+    def _creds_path(self) -> str:
+        return os.path.expanduser(self.credentials_path or os.environ.get(
+            "GOOGLE_APPLICATION_CREDENTIALS", ""))
+
+    def _ensure_project(self) -> None:
+        """Derive project_id from the credentials file BEFORE any lookup
+        URL is formatted (the reference's re-unmarshal trick,
+        create/manager_gcp.go) — deriving it only during auth would 404 the
+        first request."""
+        if self.project:
+            return
+        with open(self._creds_path()) as f:
+            self.project = json.load(f).get("project_id", "")
+        if not self.project:
+            raise ValueError("no project_id in credentials and none given")
+
     def _access_token(self) -> Optional[str]:
         if not self.authenticated:
             return None
         if self._token and time.time() < self._token_expiry - 60:
             return self._token
-        path = os.path.expanduser(self.credentials_path or os.environ.get(
-            "GOOGLE_APPLICATION_CREDENTIALS", ""))
-        with open(path) as f:
+        with open(self._creds_path()) as f:
             creds = json.load(f)
-        if not self.project:
-            # The reference's re-unmarshal trick (create/manager_gcp.go).
-            self.project = creds.get("project_id", "")
-        body = urllib.parse.urlencode({
-            "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
-            "assertion": service_account_jwt(creds),
-        }).encode()
-        req = urllib.request.Request(TOKEN_URL, data=body, headers={
-            "Content-Type": "application/x-www-form-urlencoded"})
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            tok = json.load(resp)
+        tok = exchange_service_account_token(creds)
         self._token = tok["access_token"]
         self._token_expiry = time.time() + int(tok.get("expires_in", 3600))
         return self._token
@@ -144,7 +148,17 @@ class LiveGcpCatalog(Catalog):
             # answering with all project regions would silently drop the
             # TPU-capable constraint the static list enforces.
             return None
+        # Workflow-supplied credentials/project (from the prompt flow) win
+        # over whatever the catalog was constructed with — interactive
+        # sessions provide them only at prompt time.
+        if context.get("credentials_path"):
+            if self.credentials_path != context["credentials_path"]:
+                self.credentials_path = context["credentials_path"]
+                self._token = None
+        if context.get("project"):
+            self.project = context["project"]
         try:
+            self._ensure_project()
             if kind == "regions":
                 return self.regions() or None
             if kind == "zones":

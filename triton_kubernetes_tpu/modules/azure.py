@@ -145,7 +145,12 @@ class AzureCluster(ClusterModule):
                           ) -> Tuple[List[Resource], Dict[str, Any]]:
         res = _azure_envelope(config["name"], ctx,
                               [22, 80, 443, 2379, 2380, 6443, 10250])
-        return res, {"azure_subnet_id": f"{config['name']}-subnet"}
+        return res, {
+            "azure_subnet_id": f"{config['name']}-subnet",
+            # Host placement contract shared with the HCL twin's outputs.
+            "azure_resource_group": f"{config['name']}-rg",
+            "azure_location": str(config.get("azure_location", "")),
+        }
 
 
 @register

@@ -16,18 +16,20 @@ LOCATIONS = ["West US 2", "East US", "West Europe", "Southeast Asia"]
 VM_SIZES = ["Standard_D2s_v3", "Standard_D4s_v3", "Standard_D8s_v3"]
 
 
-def _creds(ctx: WorkflowContext) -> dict:
+def _creds(ctx: WorkflowContext, with_location: bool = True) -> dict:
     r = ctx.resolver
-    return {
+    cfg = {
         "azure_subscription_id": r.value("azure_subscription_id",
                                          "Azure Subscription ID"),
         "azure_client_id": r.value("azure_client_id", "Azure Client ID"),
         "azure_client_secret": r.value("azure_client_secret", "Azure Client Secret"),
         "azure_tenant_id": r.value("azure_tenant_id", "Azure Tenant ID"),
-        "azure_location": r.choose("azure_location", "Azure Location",
-                                   [(x, x) for x in LOCATIONS],
-                                   default=LOCATIONS[0]),
     }
+    if with_location:
+        cfg["azure_location"] = r.choose(
+            "azure_location", "Azure Location",
+            [(x, x) for x in LOCATIONS], default=LOCATIONS[0])
+    return cfg
 
 
 def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> None:
@@ -63,10 +65,18 @@ def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
                 hostname: str, host_label: str) -> str:
     r = ctx.resolver
     cfg = base_node_config(ctx, "azure-k8s-host", cluster_key, hostname, host_label)
-    cfg.update(_creds(ctx))
+    # No location prompt for nodes: placement comes from the cluster module
+    # (azure_location interpolation below) — prompting would discard the
+    # answer.
+    cfg.update(_creds(ctx, with_location=False))
     cfg["azure_size"] = r.choose("azure_size", "Azure VM Size",
                                  [(s, s) for s in VM_SIZES], default=VM_SIZES[0])
     cfg["azure_subnet_id"] = f"${{module.{cluster_key}.azure_subnet_id}}"
+    # Real-path placement: hosts land in the cluster's resource group and
+    # location (the azure-k8s HCL module exports both).
+    cfg["azure_resource_group"] = \
+        f"${{module.{cluster_key}.azure_resource_group}}"
+    cfg["azure_location"] = f"${{module.{cluster_key}.azure_location}}"
     cfg["azure_public_key_path"] = r.value(
         "azure_public_key_path", "Azure Public Key Path",
         default="~/.ssh/id_rsa.pub")

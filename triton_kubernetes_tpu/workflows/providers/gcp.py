@@ -46,19 +46,23 @@ def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> Non
     r = ctx.resolver
     cfg = base_manager_config(ctx, "gcp-manager", name)
     cfg.update(_creds(ctx))
-    regions = ctx.choices("gcp", "regions", REGIONS)
+    # Prompt-supplied credentials reach the live catalog through context —
+    # interactive sessions only have them now.
+    cat_ctx = {"credentials_path": cfg["gcp_path_to_credentials"],
+               "project": cfg["gcp_project_id"]}
+    regions = ctx.choices("gcp", "regions", REGIONS, cat_ctx)
     cfg["gcp_compute_region"] = r.choose(
         "gcp_compute_region", "GCP Region", [(x, x) for x in regions],
         default=regions[0])
     cfg["gcp_zone"] = r.value("gcp_zone", "GCP Zone",
                               default=f"{cfg['gcp_compute_region']}-a")
     machine_types = ctx.choices("gcp", "machine_types", MACHINE_TYPES,
-                                {"zone": cfg["gcp_zone"]})
+                                {"zone": cfg["gcp_zone"], **cat_ctx})
     cfg["gcp_machine_type"] = r.choose(
         "gcp_machine_type", "GCP Machine Type",
         [(t, t) for t in machine_types],
         default=machine_types[min(1, len(machine_types) - 1)])
-    images = ctx.choices("gcp", "images", IMAGES)
+    images = ctx.choices("gcp", "images", IMAGES, cat_ctx)
     cfg["gcp_image"] = r.choose("gcp_image", "GCP Image",
                                 [(i, i) for i in images], default=images[0])
     state.set_manager(cfg)
@@ -68,7 +72,10 @@ def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str
     r = ctx.resolver
     cfg = base_cluster_config(ctx, "gcp-k8s", name)
     cfg.update(_creds(ctx))
-    regions = ctx.choices("gcp", "regions", REGIONS)
+    regions = ctx.choices(
+        "gcp", "regions", REGIONS,
+        {"credentials_path": cfg["gcp_path_to_credentials"],
+         "project": cfg["gcp_project_id"]})
     cfg["gcp_compute_region"] = r.choose(
         "gcp_compute_region", "GCP Region", [(x, x) for x in regions],
         default=regions[0])
@@ -120,13 +127,15 @@ def gke_cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) ->
         "gcp_additional_zones": r.value("gcp_additional_zones",
                                         "GCP Additional Zones", default=[]),
     }
+    cat_ctx = {"zone": cfg["gcp_zone"],
+               "credentials_path": creds["gcp_path_to_credentials"],
+               "project": creds["gcp_project_id"]}
     machine_types = ctx.choices("gke", "machine_types", MACHINE_TYPES,
-                                {"zone": cfg["gcp_zone"]})
+                                cat_ctx)
     # Valid master versions from the live serverConfig when the catalog has
     # them (create/cluster_gke.go's GetServerconfig prompt); free-form with
     # a default otherwise.
-    versions = ctx.choices("gke", "k8s_versions", [],
-                           {"zone": cfg["gcp_zone"]})
+    versions = ctx.choices("gke", "k8s_versions", [], cat_ctx)
     cfg.update({
         "gcp_machine_type": r.choose(
             "gcp_machine_type", "GCP Machine Type",

@@ -29,6 +29,9 @@ def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str
     """GKE control plane destined for TPU node pools."""
     r = ctx.resolver
     creds = _creds(ctx)
+    # Default must come from the same list as the options: a catalog that
+    # narrows the region set would otherwise default outside it.
+    regions = ctx.choices("gcp-tpu", "regions", TPU_REGIONS)
     cfg = {
         "source": module_source(ctx, "gcp-tpu-k8s"),
         "name": name,
@@ -36,10 +39,9 @@ def cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) -> str
         "manager_access_key": "${module.cluster-manager.manager_access_key}",
         "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
         **creds,
-        "gcp_region": r.choose(
-            "gcp_region", "GCP Region (TPU-capable)",
-            [(x, x) for x in ctx.choices("gcp-tpu", "regions", TPU_REGIONS)],
-            default=TPU_REGIONS[0]),
+        "gcp_region": r.choose("gcp_region", "GCP Region (TPU-capable)",
+                               [(x, x) for x in regions],
+                               default=regions[0]),
         "k8s_version": r.value("k8s_version", "Kubernetes Version", default="1.31"),
         "system_node_count": int(r.value("system_node_count",
                                          "System Pool Node Count", default=1)),
