@@ -50,8 +50,11 @@ class FakeRunner:
             self.kind_clusters.discard(argv[argv.index("--name") + 1])
             return ""
         if argv[0] == "kubectl" and list(argv[3:5]) == ["get", "nodes"]:
+            conditions = getattr(self, "conditions", {})
             return json.dumps({"items": [
-                {"metadata": {"name": n["name"], "labels": n["labels"]}}
+                {"metadata": {"name": n["name"], "labels": n["labels"]},
+                 "status": {"conditions": conditions.get(
+                     n["name"], [{"type": "Ready", "status": "True"}])}}
                 for n in self.nodes]})
         return ""
 
@@ -359,3 +362,24 @@ def test_integration_hello_world_runs_and_destroys(tmp_path):
     res = subprocess.run(["kind", "get", "clusters"],
                         capture_output=True, text=True)
     assert "tk8s-it1c" not in res.stdout.split()
+
+
+def test_node_health_reads_kubelet_conditions(tmp_path):
+    runner = FakeRunner(nodes=[
+        {"name": "tk8s-dev-control-plane",
+         "labels": {"node-role.kubernetes.io/control-plane": ""}},
+        {"name": "tk8s-dev-worker", "labels": {}},
+    ])
+    runner.conditions = {
+        "tk8s-dev-control-plane": [{"type": "Ready", "status": "True"}],
+        "tk8s-dev-worker": [{"type": "Ready", "status": "False",
+                             "reason": "KubeletNotReady"}],
+    }
+    d = LocalK8sDriver(provisioner="kind", runner=runner,
+                       kubeconfig_dir=str(tmp_path / "kc"))
+    d.bootstrap_manager("m1", "https://10.0.0.1")
+    c = d.create_or_get_cluster("https://10.0.0.1", "dev")
+    health = d.node_health(c["id"])
+    assert health["tk8s-dev-control-plane"]["ready"]
+    assert not health["tk8s-dev-worker"]["ready"]
+    assert health["tk8s-dev-worker"]["reason"] == "KubeletNotReady"

@@ -239,3 +239,28 @@ def test_simulator_and_server_share_the_protocol():
     node = sim.register_node(cluster["registration_token"], "n1", ["worker"],
                              ca_checksum=cluster["ca_checksum"])
     assert node["roles"] == ["worker"]
+
+
+def test_stale_heartbeat_flips_node_to_notready(server, monkeypatch):
+    """Failure detection on the control plane: three missed agent
+    heartbeats turn the node NotReady in the nodes listing."""
+    import time as time_mod
+
+    import triton_kubernetes_tpu.manager.server as srv
+
+    client = ManagerClient(server.url)
+    client.init_token(url=server.url)
+    cluster = client.create_or_get_cluster("dev")
+    client.register_node(cluster["registration_token"], "n1", ["worker"])
+    nodes = client.nodes(cluster["id"])
+    assert nodes[0]["state"] == "Ready"
+    # Age the heartbeat past the staleness window.
+    real_now = time_mod.time()
+    monkeypatch.setattr(srv.time, "time",
+                        lambda: real_now + srv.HEARTBEAT_STALE_S + 1)
+    nodes = client.nodes(cluster["id"])
+    assert nodes[0]["state"] == "NotReady"
+    # A fresh heartbeat recovers it.
+    client.register_node(cluster["registration_token"], "n1", ["worker"])
+    nodes = client.nodes(cluster["id"])
+    assert nodes[0]["state"] == "Ready"

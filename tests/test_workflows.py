@@ -349,3 +349,55 @@ def test_get_manager_and_cluster_outputs():
     cout = get_cluster(make_ctx({"cluster_manager": "mgr1",
                                  "cluster_name": "ml"}, backend=ctx.backend))
     assert cout["cluster_id"].startswith("c-")
+
+
+def test_get_cluster_surfaces_node_health():
+    """Failure detection consumed end-to-end: `get cluster` reports every
+    registered node's health; a simulated probe failure shows up NotReady."""
+    from triton_kubernetes_tpu.backends import MemoryBackend
+    from triton_kubernetes_tpu.config import Config, InputResolver
+    from triton_kubernetes_tpu.executor import LocalExecutor
+    from triton_kubernetes_tpu.workflows import (
+        WorkflowContext, get_cluster, new_cluster, new_manager)
+
+    cfg = Config()
+    for k, v in {"manager_cloud_provider": "bare-metal", "name": "m1",
+                 "host": "10.0.0.1"}.items():
+        cfg.set(k, v)
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None)
+    ctx = WorkflowContext(backend=be, executor=ex,
+                          resolver=InputResolver(cfg, None, True))
+    assert new_manager(ctx) == "m1"
+
+    cfg2 = Config()
+    for k, v in {"cluster_manager": "m1", "name": "c1",
+                 "cluster_cloud_provider": "bare-metal", "host": "10.0.0.2",
+                 "nodes": [{"hostname": "n", "node_count": 2,
+                            "rancher_host_label": "worker"}]}.items():
+        cfg2.set(k, v)
+    ctx2 = WorkflowContext(backend=be, executor=ex,
+                           resolver=InputResolver(cfg2, None, True))
+    new_cluster(ctx2)
+
+    cfg3 = Config()
+    cfg3.set("cluster_manager", "m1")
+    cfg3.set("cluster_name", "c1")
+    ctx3 = WorkflowContext(backend=be, executor=ex,
+                           resolver=InputResolver(cfg3, None, True))
+    out = get_cluster(ctx3)
+    assert out["node_health"] == {"n-1": {"ready": True, "reason": ""},
+                                  "n-2": {"ready": True, "reason": ""}}
+
+    # A health probe failure recorded on the cloud is visible on read.
+    doc = be.state("m1")
+    view = ex.cloud_view(doc)
+    view.set_node_health(out["cluster_id"], "n-2", False, "TpuUnhealthy")
+    from triton_kubernetes_tpu.executor.engine import (
+        load_executor_state, save_executor_state)
+    est = load_executor_state(doc)
+    est.cloud = view.to_dict()
+    save_executor_state(doc, est)
+    out2 = get_cluster(ctx3)
+    assert out2["node_health"]["n-2"] == {"ready": False,
+                                          "reason": "TpuUnhealthy"}

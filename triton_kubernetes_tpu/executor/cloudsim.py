@@ -153,6 +153,25 @@ class CloudSimulator:
             raise CloudSimError(f"no such cluster {cluster_id!r}")
         return self.clusters[cluster_id]
 
+    # ------------------------------------------------------------ node health
+    def set_node_health(self, cluster_id: str, hostname: str, ready: bool,
+                        reason: str = "") -> None:
+        """Record a health transition (what the slice-health probe's
+        readiness flip or a failed agent heartbeat reports)."""
+        c = self.cluster_by_id(cluster_id)
+        if hostname not in c["nodes"]:
+            raise CloudSimError(f"no node {hostname!r} in {cluster_id!r}")
+        c["nodes"][hostname]["health"] = {"ready": ready, "reason": reason}
+
+    def node_health(self, cluster_id: str) -> Dict[str, Dict[str, Any]]:
+        """{node: {ready, reason}} — the consumer side of the health story
+        (SURVEY.md §5: slice-health readiness + node-repair surfacing).
+        Registered agents default Ready; the real local driver overrides
+        this with actual kubelet conditions."""
+        c = self.cluster_by_id(cluster_id)
+        return {h: dict(n.get("health", {"ready": True, "reason": ""}))
+                for h, n in c["nodes"].items()}
+
     # --------------------------------------------------------------- hosted k8s
     def create_hosted_cluster(self, kind: str, name: str, **attrs: Any) -> Dict[str, Any]:
         """Hosted control plane (GKE/AKS analog): no agent registration —

@@ -302,6 +302,26 @@ class LocalK8sDriver(CloudSimulator):
                             ["rollout", "status", f"{kind}/{name}",
                              f"--timeout={timeout}"])
 
+    def node_health(self, cluster_id: str) -> Dict[str, Dict[str, Any]]:
+        """Real kubelet Ready conditions per node (keyed by real node
+        name) — what `get cluster` surfaces for failure detection."""
+        out = self.kubectl(cluster_id, ["get", "nodes", "-o", "json"])
+        try:
+            items = json.loads(out or "{}").get("items", [])
+        except json.JSONDecodeError as e:
+            raise LocalK8sError(
+                f"unparseable node status for {cluster_id!r}") from e
+        health: Dict[str, Dict[str, Any]] = {}
+        for i in items:
+            conds = {c.get("type"): c
+                     for c in (i.get("status") or {}).get("conditions", [])}
+            ready = conds.get("Ready", {})
+            health[i["metadata"]["name"]] = {
+                "ready": ready.get("status") == "True",
+                "reason": ready.get("reason", ""),
+            }
+        return health
+
     # --------------------------------------------------------- teardown
     def delete_resource(self, rtype: str, name: str) -> None:
         if rtype == "cluster" and name in self.clusters:

@@ -31,11 +31,16 @@ import json
 import os
 import secrets
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from . import protocol
+
+# Agents heartbeat every 60s (manager/agent.py default); three missed
+# beats flips a node to NotReady in the nodes listing.
+HEARTBEAT_STALE_S = 180.0
 
 
 class ManagerState:
@@ -185,7 +190,17 @@ class _Handler(BaseHTTPRequestHandler):
                         self._json(404, {"type": "error",
                                          "message": f"no cluster {cid}"})
                         return
-                    nodes = list(self.state.clusters[cid]["nodes"].values())
+                    # Failure detection: nodes whose agent heartbeat went
+                    # stale (> 3 heartbeat intervals) report NotReady.
+                    now = time.time()
+                    nodes = []
+                    for n in self.state.clusters[cid]["nodes"].values():
+                        n = dict(n)
+                        seen = n.get("last_seen")
+                        n["state"] = ("Ready" if seen is None
+                                      or now - seen < HEARTBEAT_STALE_S
+                                      else "NotReady")
+                        nodes.append(n)
                 self._json(200, {"type": "collection", "data": nodes})
             else:
                 self._json(404, {"type": "error", "message": "not found"})
@@ -221,6 +236,9 @@ class _Handler(BaseHTTPRequestHandler):
                     except protocol.ProtocolError as e:
                         self._json(403, {"type": "error", "message": str(e)})
                         return
+                    # Heartbeat: the agent re-registers periodically
+                    # (manager/agent.py); staleness drives NotReady below.
+                    node["last_seen"] = time.time()
                     self.state._save_locked()
                 self._json(200, node)
                 return

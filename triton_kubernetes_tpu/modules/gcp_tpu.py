@@ -110,6 +110,8 @@ class GcpTpuNodePool(Module):
         Variable("reserved", default=False),
         Variable("spot", default=False),
         Variable("runtime_image", default=""),
+        # Failure recovery: GKE replaces failed slice hosts (SURVEY.md §5).
+        Variable("auto_repair", default=True),
     ]
 
     def apply(self, config: Dict[str, Any], ctx: DriverContext
@@ -130,6 +132,8 @@ class GcpTpuNodePool(Module):
             placement_policy={"type": "COMPACT", "tpu_topology": spec.topology},
             reserved=bool(config.get("reserved")),
             spot=bool(config.get("spot")),
+            management={"auto_repair": bool(config.get("auto_repair", True)),
+                        "auto_upgrade": False},
         )
         cluster_id = config["cluster_id"]
         kwargs = {}

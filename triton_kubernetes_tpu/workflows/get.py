@@ -25,4 +25,28 @@ def get_cluster(ctx: WorkflowContext) -> Dict[str, Any]:
     state = ctx.backend.state(manager)
     _, cluster_key = select_cluster(ctx, state)
     state.set_backend_config(ctx.backend.executor_backend_config(manager))
-    return ctx.executor.output(state, cluster_key)
+    outputs = ctx.executor.output(state, cluster_key)
+    health = _node_health(ctx, state, outputs.get("cluster_id"))
+    if health is not None:
+        outputs = {**outputs, "node_health": health}
+    return outputs
+
+
+def _node_health(ctx: WorkflowContext, state, cluster_id) -> Any:
+    """Best-effort live node health for the `get cluster` read (SURVEY.md
+    §5 failure-detection obligation): real kubelet conditions when the
+    doc's driver is real and its binaries are present, the recorded agent
+    health otherwise, nothing if the executor has no cloud view."""
+    if not cluster_id or not hasattr(ctx.executor, "cloud_view"):
+        return None
+    view = ctx.executor.cloud_view(state)
+    try:
+        from ..executor.drivers import make_driver
+
+        driver = make_driver(state, view.to_dict())
+        return driver.node_health(cluster_id)
+    except Exception:
+        try:
+            return view.node_health(cluster_id)
+        except Exception:
+            return None
