@@ -10,11 +10,15 @@ set -euo pipefail
 : "${MANAGER_ACCESS_KEY:?}" "${MANAGER_SECRET_KEY:?}"
 
 export KUBECONFIG=$(mktemp)
-trap 'rm -f "$KUBECONFIG"; gcloud auth revoke --quiet >/dev/null 2>&1 || true' EXIT
+ACTIVATED=0
+# Revoke only the account this script activated — never the operator's own.
+trap 'rm -f "$KUBECONFIG"; [ "$ACTIVATED" = 1 ] && gcloud auth revoke --quiet >/dev/null 2>&1 || true' EXIT
 
 gcloud auth activate-service-account --key-file="$GCP_CREDENTIALS" --quiet
+ACTIVATED=1
+# --location handles both zonal (gke-k8s) and regional (gcp-tpu-k8s) clusters.
 gcloud container clusters get-credentials "$CLUSTER_NAME" \
-  --region "$GCP_REGION" --project "$GCP_PROJECT" --quiet
+  --location "$GCP_REGION" --project "$GCP_PROJECT" --quiet
 
 curl -kfsS -u "$MANAGER_ACCESS_KEY:$MANAGER_SECRET_KEY" \
   "$MANAGER_URL/v3/import/$CLUSTER_ID.yaml" | kubectl apply -f -

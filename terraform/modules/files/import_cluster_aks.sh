@@ -1,0 +1,24 @@
+#!/bin/bash
+# local-exec: fetch AKS credentials and apply the manager's import manifest
+# into the hosted cluster. Reference analog: modules/aks-rancher-k8s/
+# main.tf:58+ (az aks get-credentials -> curl import yaml | kubectl apply).
+set -euo pipefail
+
+: "${AZURE_CLIENT_ID:?}" "${AZURE_CLIENT_SECRET:?}" "${AZURE_TENANT_ID:?}"
+: "${AZURE_RESOURCE_GROUP:?}" "${CLUSTER_NAME:?}" "${CLUSTER_ID:?}"
+: "${MANAGER_URL:?}" "${MANAGER_ACCESS_KEY:?}" "${MANAGER_SECRET_KEY:?}"
+
+export KUBECONFIG=$(mktemp)
+LOGGED_IN=0
+# Log out only the service principal this script logged in — never the
+# operator's own az session.
+trap 'rm -f "$KUBECONFIG"; [ "$LOGGED_IN" = 1 ] && az logout --username "$AZURE_CLIENT_ID" >/dev/null 2>&1 || true' EXIT
+
+az login --service-principal -u "$AZURE_CLIENT_ID" -p "$AZURE_CLIENT_SECRET" \
+  --tenant "$AZURE_TENANT_ID" --output none
+LOGGED_IN=1
+az aks get-credentials --resource-group "$AZURE_RESOURCE_GROUP" \
+  --name "$CLUSTER_NAME" --file "$KUBECONFIG" --output none
+
+curl -kfsS -u "$MANAGER_ACCESS_KEY:$MANAGER_SECRET_KEY" \
+  "$MANAGER_URL/v3/import/$CLUSTER_ID.yaml" | kubectl apply -f -

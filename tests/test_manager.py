@@ -264,3 +264,35 @@ def test_stale_heartbeat_flips_node_to_notready(server, monkeypatch):
     client.register_node(cluster["registration_token"], "n1", ["worker"])
     nodes = client.nodes(cluster["id"])
     assert nodes[0]["state"] == "Ready"
+
+
+def test_import_manifest_endpoint(client):
+    """GET /v3/import/<id>.yaml serves a kubectl-appliable agent Deployment
+    carrying the cluster's join material — what files/import_cluster.sh
+    pipes into hosted clusters (the reference's /v3/import/<token>.yaml)."""
+    import urllib.request
+
+    from triton_kubernetes_tpu.topology.validate import validate_manifest
+
+    cluster = client.create_or_get_cluster("hosted1", kind="gke")
+    req = urllib.request.Request(
+        f"{client.url}/v3/import/{cluster['id']}.yaml",
+        headers={"Authorization": "Basic " + __import__("base64").b64encode(
+            f"{client.access_key}:{client.secret_key}".encode()).decode()})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = json.load(resp)  # JSON is valid YAML
+    validate_manifest(body)
+    container = body["spec"]["template"]["spec"]["containers"][0]
+    # The agent's CLI contract is satisfied: join material arrives as args.
+    args = container["args"]
+    assert args[args.index("--token") + 1] == cluster["registration_token"]
+    assert args[args.index("--ca-checksum") + 1] == cluster["ca_checksum"]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TK8S_TOKEN"] == cluster["registration_token"]
+    # Unknown cluster is an authenticated 404 (not just the auth gate).
+    req404 = urllib.request.Request(
+        f"{client.url}/v3/import/c-nope.yaml",
+        headers=dict(req.headers))
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req404, timeout=10)
+    assert exc.value.code == 404

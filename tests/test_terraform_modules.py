@@ -24,6 +24,8 @@ HCL_MODULES = [
     "aws-manager", "aws-k8s", "aws-k8s-host",
     "bare-metal-manager", "bare-metal-k8s", "bare-metal-k8s-host",
     "azure-manager", "azure-rke-manager", "azure-k8s", "azure-k8s-host",
+    "gcp-k8s", "gcp-k8s-host", "gke-k8s", "aks-k8s",
+    "vsphere-k8s", "vsphere-k8s-host",
     "k8s-backup-gcs", "k8s-backup-s3",
 ]
 

@@ -12,11 +12,12 @@ Wire surface (Rancher-v3-flavored, the contract of the scripts):
 method    path                                   auth
 ========  =====================================  ====================
 GET       /v3                                    none (health)
+GET       /v3/settings/cacerts                   none (public CA)
 POST      /v3-admin/init-token                   loopback only
 GET       /v3/cluster?name=N                     basic
 POST      /v3/cluster                            basic
 POST      /v3/clusterregistrationtoken           basic
-GET       /v3/settings/cacerts                   basic
+GET       /v3/import/<id>.yaml                   basic (hosted import)
 POST      /v3/clusters/<id>?action=generateKubeconfig  basic
 GET       /v3/clusters/<id>/nodes                basic
 POST      /v3/agent/register                     registration token
@@ -175,6 +176,46 @@ class _Handler(BaseHTTPRequestHandler):
                                                   self.state.salt)})
                 return
             if not self._require_auth():
+                return
+            if url.path.startswith("/v3/import/") and \
+                    url.path.endswith(".yaml"):
+                # Hosted-cluster import manifest (the reference's
+                # /v3/import/<token>.yaml, gke-rancher-k8s/main.tf:50-82):
+                # the agent Deployment with this cluster's join material.
+                # Emitted as JSON — valid YAML, kubectl-appliable.
+                cid = url.path[len("/v3/import/"):-len(".yaml")]
+                with self.state.lock:
+                    cluster = self.state.clusters.get(cid)
+                    if cluster is None:
+                        self._json(404, {"type": "error",
+                                         "message": f"no cluster {cid}"})
+                        return
+                    from ..modules.base import agent_import_manifest
+
+                    server_url = self.state.url or f"https://{self.state.name}"
+                    m = agent_import_manifest()
+                    container = m["spec"]["template"]["spec"]["containers"][0]
+                    # The agent's CLI contract (manager/agent.py): join
+                    # material as args; env mirrors it for inspection.
+                    container["args"] = [
+                        "--server", server_url,
+                        "--token", cluster["registration_token"],
+                        "--ca-checksum", cluster["ca_checksum"],
+                        "--worker",
+                    ]
+                    container["env"] = [
+                        {"name": "TK8S_SERVER", "value": server_url},
+                        {"name": "TK8S_TOKEN",
+                         "value": cluster["registration_token"]},
+                        {"name": "TK8S_CA_CHECKSUM",
+                         "value": cluster["ca_checksum"]},
+                    ]
+                body = json.dumps(m).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/yaml")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             if url.path == "/v3/cluster":
                 name = (parse_qs(url.query).get("name") or [""])[0]
