@@ -74,7 +74,9 @@ def test_tpu_fork_end_to_end(tpu_doc):
     # libtpu runtime + device plugin + health DaemonSets installed.
     cluster_id = ex.output(doc, ckey)["cluster_id"]
     kinds = [m["metadata"]["name"] for m in cloud.get_manifests(cluster_id, "DaemonSet")]
-    assert set(kinds) == {"tpu-jax-runtime", "tpu-device-plugin", "tpu-slice-health"}
+    # Runtime/health are per-chip-count variants (v5p-64: 4 chips/host).
+    assert set(kinds) == {"tpu-jax-runtime-4c", "tpu-device-plugin",
+                          "tpu-slice-health-4c"}
 
 
 def test_tpu_jobset_module(tpu_doc):

@@ -89,11 +89,15 @@ def test_nodepool_locals_mirror_generation_table():
     TPU_GENERATIONS — drift would place pools on wrong machine types."""
     hcl = _load("gcp-tpu-nodepool", "main.tf.json")
     table = hcl["locals"]["generations"]
+    single = hcl["locals"]["single_host"]
     assert set(table) == set(TPU_GENERATIONS)
+    assert set(single) == set(TPU_GENERATIONS)
     for gen_name, gen in TPU_GENERATIONS.items():
         assert table[gen_name]["machine_type"] == gen.machine_type
         assert table[gen_name]["gke_accelerator"] == gen.gke_accelerator
         assert gen.chips_per_host == 4  # hardcoded as local.chips_per_host
+        assert single[gen_name] == {str(c): mt
+                                    for c, mt in gen.single_host_types}
 
 
 def test_executor_rewrites_sources_to_local_tree(tmp_path):

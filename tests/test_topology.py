@@ -28,11 +28,14 @@ def test_parse_accelerator():
 @pytest.mark.parametrize("acc,topo,hosts", [
     ("v5e-1", "1x1", 1),
     ("v5e-4", "2x2", 1),
-    ("v5e-8", "2x4", 2),
+    # v5e-8/v6e-8 ride the single-host 8-chip machines (ct5lp-hightpu-8t /
+    # ct6e-standard-8t): every hop on-board, 1-node pools.
+    ("v5e-8", "2x4", 1),
+    ("v5e-16", "4x4", 4),
     ("v5e-256", "16x16", 64),
     ("v5p-64", "4x4x4", 16),
     ("v5p-256", "4x8x8", 64),
-    ("v6e-8", "2x4", 2),
+    ("v6e-8", "2x4", 1),
 ])
 def test_default_topologies(acc, topo, hosts):
     spec = SliceSpec.from_accelerator(acc)

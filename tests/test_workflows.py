@@ -269,7 +269,7 @@ def test_tpu_cluster_silent_flow():
     assert gke["node_pools"]["pool0"]["placement_policy"]["type"] == "COMPACT"
     cid = cctx.executor.output(doc, ckey)["cluster_id"]
     ds = [m["metadata"]["name"] for m in cloud.get_manifests(cid, "DaemonSet")]
-    assert "tpu-jax-runtime" in ds
+    assert any(n.startswith("tpu-jax-runtime") for n in ds)
 
 
 def test_tpu_node_added_to_existing_cluster():
@@ -283,7 +283,8 @@ def test_tpu_node_added_to_existing_cluster():
     assert new_node(nctx) == ["pool1"]
     doc = ctx.backend.state("mgr1")
     out = nctx.executor.output(doc, "node_gcp-tpu_ml_pool1")
-    assert out["num_hosts"] == 2
+    # v5e-8 rides the single-host ct5lp-hightpu-8t machine: 1-node pool.
+    assert out["num_hosts"] == 1
 
 
 # -------------------------------------------------------------------- backup

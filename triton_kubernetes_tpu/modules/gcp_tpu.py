@@ -126,7 +126,7 @@ class GcpTpuNodePool(Module):
             "gke", cluster_name, pool_name,
             node_count=spec.num_hosts,
             node_labels=labels,
-            machine_type=spec.generation.machine_type,
+            machine_type=spec.machine_type,
             accelerator=spec.generation.gke_accelerator,
             tpu_topology=spec.topology,  # GKE placement: physical slice shape
             placement_policy={"type": "COMPACT", "tpu_topology": spec.topology},
@@ -161,12 +161,16 @@ class GcpTpuNodePool(Module):
         if cluster:
             pools = cluster.get("node_pools", {})
             pools.pop(cfg.get("pool_name", ""), None)
-            # Last TPU pool gone: uninstall the TPU DaemonSets too.
+            # Last TPU pool gone: uninstall the TPU DaemonSets too (the
+            # runtime/health sets are per-chip-count variants, so sweep by
+            # prefix rather than fixed names).
             if not any(p.get("tpu_topology") for p in pools.values()):
                 cluster_id = applied.get("outputs", {}).get("cluster_id", "")
-                for ds in ("tpu-jax-runtime", "tpu-device-plugin",
-                           "tpu-slice-health"):
-                    ctx.cloud.delete_manifest(cluster_id, "DaemonSet", ds)
+                names = [m["metadata"]["name"] for m in
+                         ctx.cloud.get_manifests(cluster_id, "DaemonSet")]
+                for ds in names:
+                    if ds.startswith("tpu-"):
+                        ctx.cloud.delete_manifest(cluster_id, "DaemonSet", ds)
         super().destroy(applied, ctx)
 
 

@@ -21,24 +21,39 @@ DEFAULT_RUNTIME_IMAGE = "tk8s/jax-tpu-runtime:0.1.0"
 DEFAULT_DEVICE_PLUGIN_IMAGE = "tk8s/tpu-device-plugin:0.1.0"
 
 
-def _tpu_node_selector(spec: SliceSpec) -> Dict[str, str]:
-    return {GKE_ACCELERATOR_LABEL: spec.generation.gke_accelerator}
+def _tpu_node_selector(spec: SliceSpec,
+                       per_host: bool = False) -> Dict[str, str]:
+    sel = {GKE_ACCELERATOR_LABEL: spec.generation.gke_accelerator}
+    if per_host:
+        # Manifests that embed the per-slice chip count must only land on
+        # nodes with that count — a generation can mix 4- and 8-chip hosts
+        # (ct5lp-hightpu-4t vs -8t) in one cluster.
+        sel["tpu.tk8s.io/chips-per-host"] = str(spec.chips_per_host)
+    return sel
+
+
+def _chip_variant(name: str, spec: SliceSpec) -> str:
+    """Per-chip-count manifest name (``tpu-jax-runtime-8c``): pools with
+    the same chips/host share one DaemonSet; different counts coexist
+    instead of overwriting each other's env/assertions."""
+    return f"{name}-{spec.chips_per_host}c"
 
 
 def render_tpu_runtime_daemonset(spec: SliceSpec,
                                  image: str = DEFAULT_RUNTIME_IMAGE,
                                  namespace: str = "kube-system") -> Dict[str, Any]:
     """libtpu + JAX/XLA runtime DaemonSet (nvidia-docker analog, TPU-native)."""
+    name = _chip_variant("tpu-jax-runtime", spec)
     return {
         "apiVersion": "apps/v1",
         "kind": "DaemonSet",
-        "metadata": {"name": "tpu-jax-runtime", "namespace": namespace},
+        "metadata": {"name": name, "namespace": namespace},
         "spec": {
-            "selector": {"matchLabels": {"app": "tpu-jax-runtime"}},
+            "selector": {"matchLabels": {"app": name}},
             "template": {
-                "metadata": {"labels": {"app": "tpu-jax-runtime"}},
+                "metadata": {"labels": {"app": name}},
                 "spec": {
-                    "nodeSelector": _tpu_node_selector(spec),
+                    "nodeSelector": _tpu_node_selector(spec, per_host=True),
                     "hostNetwork": True,  # ICI/DCN init needs host networking
                     "containers": [{
                         "name": "runtime",
@@ -50,7 +65,7 @@ def render_tpu_runtime_daemonset(spec: SliceSpec,
                         ],
                         "env": [
                             {"name": "TPU_CHIPS_PER_HOST",
-                             "value": str(spec.generation.chips_per_host)},
+                             "value": str(spec.chips_per_host)},
                         ],
                     }],
                     "volumes": [
@@ -100,27 +115,28 @@ def render_slice_health_daemonset(spec: SliceSpec,
                                   image: str = DEFAULT_RUNTIME_IMAGE,
                                   namespace: str = "kube-system") -> Dict[str, Any]:
     """Readiness = libtpu enumerates all local chips (slice-health probe)."""
+    name = _chip_variant("tpu-slice-health", spec)
     return {
         "apiVersion": "apps/v1",
         "kind": "DaemonSet",
-        "metadata": {"name": "tpu-slice-health", "namespace": namespace},
+        "metadata": {"name": name, "namespace": namespace},
         "spec": {
-            "selector": {"matchLabels": {"app": "tpu-slice-health"}},
+            "selector": {"matchLabels": {"app": name}},
             "template": {
-                "metadata": {"labels": {"app": "tpu-slice-health"}},
+                "metadata": {"labels": {"app": name}},
                 "spec": {
-                    "nodeSelector": _tpu_node_selector(spec),
+                    "nodeSelector": _tpu_node_selector(spec, per_host=True),
                     "containers": [{
                         "name": "probe",
                         "image": image,
                         "command": ["python", "-c",
                                     "import jax; assert len(jax.local_devices()) == "
-                                    f"{spec.generation.chips_per_host}"],
+                                    f"{spec.chips_per_host}"],
                         "readinessProbe": {
                             "exec": {"command": [
                                 "python", "-c",
                                 "import jax; assert len(jax.local_devices()) == "
-                                f"{spec.generation.chips_per_host}"]},
+                                f"{spec.chips_per_host}"]},
                             "periodSeconds": 60,
                         },
                     }],

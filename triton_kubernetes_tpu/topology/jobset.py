@@ -52,7 +52,7 @@ def render_jobset(
         "TPU_WORKER_HOSTNAMES": hostnames,
         "JAX_COORDINATOR_ADDRESS": coordinator,
         "TPU_TOPOLOGY": spec.topology,
-        "TPU_CHIPS_PER_HOST": str(spec.generation.chips_per_host),
+        "TPU_CHIPS_PER_HOST": str(spec.chips_per_host),
         "NUM_TPU_WORKERS": str(n),
     }
     base_env.update(env or {})
@@ -70,7 +70,7 @@ def render_jobset(
             }]
         ),
         "ports": [{"containerPort": COORDINATOR_PORT}],
-        "resources": {"limits": {"google.com/tpu": str(spec.generation.chips_per_host)}},
+        "resources": {"limits": {"google.com/tpu": str(spec.chips_per_host)}},
     }
     return {
         "apiVersion": "batch/v1",

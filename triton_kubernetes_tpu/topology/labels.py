@@ -40,7 +40,7 @@ def host_labels_for_slice(spec: SliceSpec, slice_id: str) -> List[Dict[str, str]
             f"{LABEL_PREFIX}/slice-id": slice_id,
             f"{LABEL_PREFIX}/worker-id": str(worker_id),
             f"{LABEL_PREFIX}/num-workers": str(spec.num_hosts),
-            f"{LABEL_PREFIX}/chips-per-host": str(spec.generation.chips_per_host),
+            f"{LABEL_PREFIX}/chips-per-host": str(spec.chips_per_host),
         }
         for axis, c in zip(AXIS_NAMES, coord):
             labels[f"{LABEL_PREFIX}/ici-{axis}"] = str(c)
