@@ -159,3 +159,22 @@ def test_simulator_rejects_invalid_manifest():
     sim.apply_manifest(c["id"], {
         "apiVersion": "velero.io/v1", "kind": "Restore",
         "metadata": {"name": "r1"}, "spec": {"backupName": "b"}})
+
+
+def test_daemonset_variants_distinct_across_shapes():
+    """Runtime/health DaemonSets are per-machine-shape: mixed chip counts
+    AND mixed generations with the same chips/host coexist, selected by
+    the instance-type label Kubernetes sets on every node (works on both
+    provisioning paths, no custom labeling required)."""
+    from triton_kubernetes_tpu.topology.daemonsets import (
+        render_slice_health_daemonset, render_tpu_runtime_daemonset)
+
+    v5e8 = SliceSpec.from_accelerator("v5e-8")      # ct5lp-hightpu-8t
+    v5e16 = SliceSpec.from_accelerator("v5e-16")    # ct5lp-hightpu-4t
+    v5p64 = SliceSpec.from_accelerator("v5p-64")    # ct5p-hightpu-4t (4c too)
+    names = {render_tpu_runtime_daemonset(s)["metadata"]["name"]
+             for s in (v5e8, v5e16, v5p64)}
+    assert len(names) == 3  # no collisions, incl. same-chips cross-gen
+    ds = render_slice_health_daemonset(v5e8)
+    sel = ds["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel["node.kubernetes.io/instance-type"] == "ct5lp-hightpu-8t"

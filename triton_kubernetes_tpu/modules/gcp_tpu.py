@@ -169,7 +169,10 @@ class GcpTpuNodePool(Module):
                 names = [m["metadata"]["name"] for m in
                          ctx.cloud.get_manifests(cluster_id, "DaemonSet")]
                 for ds in names:
-                    if ds.startswith("tpu-"):
+                    # Only what apply() installs — never an operator's own
+                    # tpu-* workloads.
+                    if ds == "tpu-device-plugin" or ds.startswith(
+                            ("tpu-jax-runtime-", "tpu-slice-health-")):
                         ctx.cloud.delete_manifest(cluster_id, "DaemonSet", ds)
         super().destroy(applied, ctx)
 

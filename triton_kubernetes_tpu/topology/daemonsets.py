@@ -26,17 +26,21 @@ def _tpu_node_selector(spec: SliceSpec,
     sel = {GKE_ACCELERATOR_LABEL: spec.generation.gke_accelerator}
     if per_host:
         # Manifests that embed the per-slice chip count must only land on
-        # nodes with that count — a generation can mix 4- and 8-chip hosts
-        # (ct5lp-hightpu-4t vs -8t) in one cluster.
-        sel["tpu.tk8s.io/chips-per-host"] = str(spec.chips_per_host)
+        # hosts of the matching machine shape — a cluster can mix 4- and
+        # 8-chip hosts of one generation (ct5lp-hightpu-4t vs -8t). The
+        # instance-type label is set by Kubernetes itself on every node,
+        # so this matches on BOTH provisioning paths (in-process and
+        # terraform) with no custom labeling required.
+        sel["node.kubernetes.io/instance-type"] = spec.machine_type
     return sel
 
 
 def _chip_variant(name: str, spec: SliceSpec) -> str:
-    """Per-chip-count manifest name (``tpu-jax-runtime-8c``): pools with
-    the same chips/host share one DaemonSet; different counts coexist
-    instead of overwriting each other's env/assertions."""
-    return f"{name}-{spec.chips_per_host}c"
+    """Per-machine-shape manifest name (``tpu-jax-runtime-ct5lp-hightpu-8t``):
+    pools on the same machine type share one DaemonSet; different shapes —
+    including same chips/host across generations — coexist instead of
+    overwriting each other's env/assertions."""
+    return f"{name}-{spec.machine_type}"
 
 
 def render_tpu_runtime_daemonset(spec: SliceSpec,

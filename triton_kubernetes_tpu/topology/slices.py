@@ -160,7 +160,10 @@ class SliceSpec:
     @property
     def chips_per_host(self) -> int:
         """Chips each worker VM owns — per-slice, not per-generation (a
-        single-host v5e-8 host owns all 8)."""
+        single-host v5e-8 host owns all 8; a sub-host v5p-2 host is
+        *granted* 2 even though the machine has 4 — the device plugin
+        gates enumeration to the granted count, so health asserts and
+        google.com/tpu limits use this value consistently)."""
         return self.chips // self.num_hosts
 
     @property
