@@ -74,10 +74,10 @@ def test_tpu_fork_end_to_end(tpu_doc):
     # libtpu runtime + device plugin + health DaemonSets installed.
     cluster_id = ex.output(doc, ckey)["cluster_id"]
     kinds = [m["metadata"]["name"] for m in cloud.get_manifests(cluster_id, "DaemonSet")]
-    # Runtime/health are per-machine-shape variants (v5p-64: ct5p hosts).
-    assert set(kinds) == {"tpu-jax-runtime-ct5p-hightpu-4t",
-                          "tpu-device-plugin",
-                          "tpu-slice-health-ct5p-hightpu-4t"}
+    # Runtime/health are per-(shape, grant) variants; plugin per-generation.
+    assert set(kinds) == {"tpu-jax-runtime-ct5p-hightpu-4t-4c",
+                          "tpu-device-plugin-v5p",
+                          "tpu-slice-health-ct5p-hightpu-4t-4c"}
 
 
 def test_tpu_jobset_module(tpu_doc):

@@ -169,12 +169,21 @@ def test_daemonset_variants_distinct_across_shapes():
     from triton_kubernetes_tpu.topology.daemonsets import (
         render_slice_health_daemonset, render_tpu_runtime_daemonset)
 
-    v5e8 = SliceSpec.from_accelerator("v5e-8")      # ct5lp-hightpu-8t
-    v5e16 = SliceSpec.from_accelerator("v5e-16")    # ct5lp-hightpu-4t
-    v5p64 = SliceSpec.from_accelerator("v5p-64")    # ct5p-hightpu-4t (4c too)
+    v5e8 = SliceSpec.from_accelerator("v5e-8")      # ct5lp-hightpu-8t, 8c
+    v5e16 = SliceSpec.from_accelerator("v5e-16")    # ct5lp-hightpu-4t, 4c
+    v5p64 = SliceSpec.from_accelerator("v5p-64")    # ct5p-hightpu-4t, 4c
+    v5p2 = SliceSpec.from_accelerator("v5p-2")      # ct5p-hightpu-4t, 2c grant
     names = {render_tpu_runtime_daemonset(s)["metadata"]["name"]
-             for s in (v5e8, v5e16, v5p64)}
-    assert len(names) == 3  # no collisions, incl. same-chips cross-gen
+             for s in (v5e8, v5e16, v5p64, v5p2)}
+    # No collisions: cross-gen same-chips AND sub-host grants on one shape.
+    assert len(names) == 4
     ds = render_slice_health_daemonset(v5e8)
     sel = ds["spec"]["template"]["spec"]["nodeSelector"]
     assert sel["node.kubernetes.io/instance-type"] == "ct5lp-hightpu-8t"
+    assert sel["tpu.tk8s.io/chips-per-host"] == "8"
+    # Device plugin: one per generation, selector survives mixed clusters.
+    from triton_kubernetes_tpu.topology.daemonsets import (
+        render_tpu_device_plugin)
+    p_e = render_tpu_device_plugin(v5e8)
+    p_p = render_tpu_device_plugin(v5p64)
+    assert p_e["metadata"]["name"] != p_p["metadata"]["name"]

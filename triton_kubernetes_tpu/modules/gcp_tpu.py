@@ -162,8 +162,8 @@ class GcpTpuNodePool(Module):
             pools = cluster.get("node_pools", {})
             pools.pop(cfg.get("pool_name", ""), None)
             # Last TPU pool gone: uninstall the TPU DaemonSets too (the
-            # runtime/health sets are per-chip-count variants, so sweep by
-            # prefix rather than fixed names).
+            # sets are per-(machine shape, grant) / per-generation
+            # variants, so sweep by prefix rather than fixed names).
             if not any(p.get("tpu_topology") for p in pools.values()):
                 cluster_id = applied.get("outputs", {}).get("cluster_id", "")
                 names = [m["metadata"]["name"] for m in
@@ -171,8 +171,8 @@ class GcpTpuNodePool(Module):
                 for ds in names:
                     # Only what apply() installs — never an operator's own
                     # tpu-* workloads.
-                    if ds == "tpu-device-plugin" or ds.startswith(
-                            ("tpu-jax-runtime-", "tpu-slice-health-")):
+                    if ds.startswith(("tpu-jax-runtime-", "tpu-slice-health-",
+                                      "tpu-device-plugin")):
                         ctx.cloud.delete_manifest(cluster_id, "DaemonSet", ds)
         super().destroy(applied, ctx)
 

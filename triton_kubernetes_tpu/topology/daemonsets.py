@@ -26,21 +26,23 @@ def _tpu_node_selector(spec: SliceSpec,
     sel = {GKE_ACCELERATOR_LABEL: spec.generation.gke_accelerator}
     if per_host:
         # Manifests that embed the per-slice chip count must only land on
-        # hosts of the matching machine shape — a cluster can mix 4- and
-        # 8-chip hosts of one generation (ct5lp-hightpu-4t vs -8t). The
-        # instance-type label is set by Kubernetes itself on every node,
-        # so this matches on BOTH provisioning paths (in-process and
-        # terraform) with no custom labeling required.
+        # matching hosts — a cluster can mix 4- and 8-chip hosts of one
+        # generation (ct5lp-hightpu-4t vs -8t), and sub-host pools grant
+        # fewer chips than the machine has. instance-type is set by
+        # Kubernetes itself; chips-per-host is written by both
+        # provisioning paths (topology/labels.py and the HCL nodepool).
         sel["node.kubernetes.io/instance-type"] = spec.machine_type
+        sel["tpu.tk8s.io/chips-per-host"] = str(spec.chips_per_host)
     return sel
 
 
 def _chip_variant(name: str, spec: SliceSpec) -> str:
-    """Per-machine-shape manifest name (``tpu-jax-runtime-ct5lp-hightpu-8t``):
-    pools on the same machine type share one DaemonSet; different shapes —
+    """Per-(machine shape, chip grant) manifest name
+    (``tpu-jax-runtime-ct5lp-hightpu-8t-8c``): pools with the same shape
+    AND grant share one DaemonSet; different shapes or sub-host grants —
     including same chips/host across generations — coexist instead of
     overwriting each other's env/assertions."""
-    return f"{name}-{spec.machine_type}"
+    return f"{name}-{spec.machine_type}-{spec.chips_per_host}c"
 
 
 def render_tpu_runtime_daemonset(spec: SliceSpec,
@@ -85,15 +87,19 @@ def render_tpu_runtime_daemonset(spec: SliceSpec,
 def render_tpu_device_plugin(spec: SliceSpec,
                              image: str = DEFAULT_DEVICE_PLUGIN_IMAGE,
                              namespace: str = "kube-system") -> Dict[str, Any]:
-    """Device plugin advertising ``google.com/tpu`` (nvidia-device-plugin analog)."""
+    """Device plugin advertising ``google.com/tpu`` (nvidia-device-plugin
+    analog). Per-generation name: its selector is the generation
+    accelerator label, so mixed-generation clusters keep one plugin per
+    generation instead of the last apply stealing the other's nodes."""
+    name = f"tpu-device-plugin-{spec.generation.name}"
     return {
         "apiVersion": "apps/v1",
         "kind": "DaemonSet",
-        "metadata": {"name": "tpu-device-plugin", "namespace": namespace},
+        "metadata": {"name": name, "namespace": namespace},
         "spec": {
-            "selector": {"matchLabels": {"app": "tpu-device-plugin"}},
+            "selector": {"matchLabels": {"app": name}},
             "template": {
-                "metadata": {"labels": {"app": "tpu-device-plugin"}},
+                "metadata": {"labels": {"app": name}},
                 "spec": {
                     "nodeSelector": _tpu_node_selector(spec),
                     "priorityClassName": "system-node-critical",
