@@ -1,0 +1,177 @@
+"""TPU device plugin: the kubelet v1beta1 gRPC protocol, spoken for real.
+
+A fake kubelet (Registration service) and a real client drive the plugin
+server over unix sockets — registration, options, the ListAndWatch device
+stream, and Allocate (env + /dev/accel* device specs) all execute over
+actual gRPC with the hand-encoded protobuf framing."""
+
+import os
+import threading
+from concurrent import futures
+
+import grpc
+import pytest
+
+from triton_kubernetes_tpu.manager.device_plugin import (
+    DevicePluginServer,
+    decode_fields,
+    enumerate_tpu_chips,
+    list_and_watch_response,
+    parse_allocate_request,
+    register_request,
+)
+
+IDENT = (lambda b: b, lambda b: b)
+
+
+class FakeKubelet:
+    """Registration service capturing RegisterRequest fields."""
+
+    def __init__(self, socket_path):
+        self.socket_path = socket_path
+        self.requests = []
+        self.event = threading.Event()
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+
+        def register(request: bytes, ctx) -> bytes:
+            fields = {f: v for f, _, v in decode_fields(request)}
+            self.requests.append({
+                "version": fields[1].decode(),
+                "endpoint": fields[2].decode(),
+                "resource": fields[3].decode(),
+            })
+            self.event.set()
+            return b""
+
+        self.server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler("v1beta1.Registration", {
+                "Register": grpc.unary_unary_rpc_method_handler(
+                    register, *IDENT),
+            }),))
+        self.server.add_insecure_port(f"unix://{socket_path}")
+        self.server.start()
+
+    def stop(self):
+        self.server.stop(grace=1).wait()
+
+
+@pytest.fixture()
+def plugin(tmp_path):
+    kubelet_sock = str(tmp_path / "kubelet.sock")
+    plugin_sock = str(tmp_path / "tk8s-tpu.sock")
+    kubelet = FakeKubelet(kubelet_sock)
+    p = DevicePluginServer(plugin_sock, kubelet_sock,
+                           device_ids=["0", "1", "2", "3"],
+                           watch_interval=0.1)
+    p.start()
+    yield p, kubelet
+    p.stop()
+    kubelet.stop()
+
+
+def _channel(p):
+    return grpc.insecure_channel(f"unix://{p.plugin_socket}")
+
+
+def test_registers_with_kubelet(plugin):
+    p, kubelet = plugin
+    p.register()
+    assert kubelet.event.wait(5)
+    req = kubelet.requests[0]
+    assert req["version"] == "v1beta1"
+    assert req["resource"] == "google.com/tpu"
+    assert req["endpoint"] == os.path.basename(p.plugin_socket)
+
+
+def test_list_and_watch_streams_devices(plugin):
+    p, _ = plugin
+    ch = _channel(p)
+    stream = ch.unary_stream("/v1beta1.DevicePlugin/ListAndWatch",
+                             request_serializer=IDENT[0],
+                             response_deserializer=IDENT[1])
+    it = stream(b"")
+    first = next(it)
+    devices = [dict((f, v) for f, _, v in decode_fields(val))
+               for field, _, val in decode_fields(first) if field == 1]
+    assert [d[1].decode() for d in devices] == ["0", "1", "2", "3"]
+    assert all(d[2].decode() == "Healthy" for d in devices)
+    next(it)  # heartbeat re-advertisement arrives
+    it.cancel()
+    ch.close()
+
+
+def test_allocate_returns_device_specs_and_env(plugin):
+    p, _ = plugin
+    ch = _channel(p)
+    allocate = ch.unary_unary("/v1beta1.DevicePlugin/Allocate",
+                              request_serializer=IDENT[0],
+                              response_deserializer=IDENT[1])
+    # AllocateRequest: one container asking for chips 1 and 3.
+    from triton_kubernetes_tpu.manager.device_plugin import enc_msg, enc_str
+    creq = enc_str(1, "1") + enc_str(1, "3")
+    resp = allocate(enc_msg(1, creq))
+    containers = [val for f, _, val in decode_fields(resp) if f == 1]
+    assert len(containers) == 1
+    envs = {}
+    dev_specs = []
+    for f, _, val in decode_fields(containers[0]):
+        if f == 1:
+            kv = {ff: vv for ff, _, vv in decode_fields(val)}
+            envs[kv[1].decode()] = kv[2].decode()
+        elif f == 3:
+            kv = {ff: vv for ff, _, vv in decode_fields(val)}
+            dev_specs.append((kv[1].decode(), kv[3].decode()))
+    assert envs == {"TPU_VISIBLE_CHIPS": "1,3"}
+    assert ("/dev/accel1", "rw") in dev_specs
+    assert ("/dev/accel3", "rw") in dev_specs
+    ch.close()
+
+
+def test_options_and_roundtrip_helpers(plugin):
+    p, _ = plugin
+    ch = _channel(p)
+    options = ch.unary_unary("/v1beta1.DevicePlugin/GetDevicePluginOptions",
+                             request_serializer=IDENT[0],
+                             response_deserializer=IDENT[1])
+    fields = {f: v for f, _, v in decode_fields(options(b""))}
+    assert fields == {1: 0, 2: 0}
+    # Encoder/decoder round-trips.
+    assert parse_allocate_request(b"") == []
+    lw = list_and_watch_response(["7"])
+    (field, _, dev), = decode_fields(lw)
+    assert field == 1 and decode_fields(dev)[0][2] == b"7"
+    rr = {f: v for f, _, v in decode_fields(register_request("x.sock"))}
+    assert rr[2] == b"x.sock"
+    ch.close()
+
+
+def test_enumerate_tpu_chips(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_CHIP_COUNT", raising=False)
+    for i in (0, 1, 3):
+        (tmp_path / f"accel{i}").touch()
+    (tmp_path / "accelfoo").touch()  # non-numeric suffix ignored
+    assert enumerate_tpu_chips(str(tmp_path)) == ["0", "1", "3"]
+    monkeypatch.setenv("TPU_CHIP_COUNT", "8")
+    assert enumerate_tpu_chips(str(tmp_path)) == [str(i) for i in range(8)]
+
+
+def test_reregisters_after_kubelet_restart(tmp_path):
+    kubelet_sock = str(tmp_path / "kubelet.sock")
+    kubelet = FakeKubelet(kubelet_sock)
+    p = DevicePluginServer(str(tmp_path / "p.sock"), kubelet_sock,
+                           device_ids=["0"])
+    p.start()
+    p.register()
+    assert kubelet.event.wait(5)
+    assert not p.kubelet_restarted()  # baseline primed, no restart yet
+    # Kubelet restart: socket recreated with a new inode (grpc removes it
+    # on shutdown already).
+    kubelet.stop()
+    if os.path.exists(kubelet_sock):
+        os.unlink(kubelet_sock)
+    kubelet2 = FakeKubelet(kubelet_sock)
+    assert p.kubelet_restarted()  # detected -> main() re-registers
+    p.register()
+    assert kubelet2.event.wait(5)
+    p.stop()
+    kubelet2.stop()

@@ -74,9 +74,9 @@ def test_tpu_fork_end_to_end(tpu_doc):
     # libtpu runtime + device plugin + health DaemonSets installed.
     cluster_id = ex.output(doc, ckey)["cluster_id"]
     kinds = [m["metadata"]["name"] for m in cloud.get_manifests(cluster_id, "DaemonSet")]
-    # Runtime/health are per-(shape, grant) variants; plugin per-generation.
+    # All three sets are per-(machine shape, chip grant) variants.
     assert set(kinds) == {"tpu-jax-runtime-ct5p-hightpu-4t-4c",
-                          "tpu-device-plugin-v5p",
+                          "tpu-device-plugin-ct5p-hightpu-4t-4c",
                           "tpu-slice-health-ct5p-hightpu-4t-4c"}
 
 

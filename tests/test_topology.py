@@ -181,9 +181,13 @@ def test_daemonset_variants_distinct_across_shapes():
     sel = ds["spec"]["template"]["spec"]["nodeSelector"]
     assert sel["node.kubernetes.io/instance-type"] == "ct5lp-hightpu-8t"
     assert sel["tpu.tk8s.io/chips-per-host"] == "8"
-    # Device plugin: one per generation, selector survives mixed clusters.
+    # Device plugin: per-(shape, grant) too, and told its grant so a
+    # sub-host pool advertises the granted count, not the machine's.
     from triton_kubernetes_tpu.topology.daemonsets import (
         render_tpu_device_plugin)
     p_e = render_tpu_device_plugin(v5e8)
-    p_p = render_tpu_device_plugin(v5p64)
+    p_p = render_tpu_device_plugin(v5p2)
     assert p_e["metadata"]["name"] != p_p["metadata"]["name"]
+    env = {e["name"]: e["value"] for e in
+           p_p["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPU_CHIP_COUNT"] == "2"

@@ -1,0 +1,295 @@
+"""``tk8s-device-plugin`` — a real Kubernetes device plugin for TPU chips.
+
+What runs in the ``tk8s/tpu-device-plugin`` image (the DaemonSet rendered
+by topology/daemonsets.py): it registers with the kubelet over the device
+plugin v1beta1 gRPC API and advertises ``google.com/tpu`` resources, one
+per local TPU chip — the nvidia-device-plugin analog of the reference's
+GPU-era host plumbing (SURVEY.md §2.5 device-plumbing row).
+
+The kubelet protocol is spoken directly: the handful of v1beta1 messages
+are hand-encoded protobuf (this environment has grpc but no codegen
+toolchain, and the messages are tiny), with grpc carrying raw bytes via
+identity serializers. Framing follows the public
+k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1 definitions:
+
+  Registration.Register(RegisterRequest{version, endpoint, resource_name})
+  DevicePlugin.GetDevicePluginOptions(Empty) -> DevicePluginOptions
+  DevicePlugin.ListAndWatch(Empty) -> stream ListAndWatchResponse{devices}
+  DevicePlugin.Allocate(AllocateRequest) -> AllocateResponse{envs, devices}
+  DevicePlugin.PreStartContainer / GetPreferredAllocation -> empty
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import threading
+import time
+from concurrent import futures
+from typing import Dict, Iterator, List, Optional
+
+import grpc
+
+API_VERSION = "v1beta1"
+RESOURCE_NAME = "google.com/tpu"
+KUBELET_SOCKET = "/var/lib/kubelet/device-plugins/kubelet.sock"
+PLUGIN_SOCKET = "/var/lib/kubelet/device-plugins/tk8s-tpu.sock"
+HEALTHY = "Healthy"
+
+
+# --------------------------------------------------------------- protobuf
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _varint((field << 3) | wire_type)
+
+
+def enc_str(field: int, value: str) -> bytes:
+    data = value.encode()
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def enc_msg(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def enc_bool(field: int, value: bool) -> bytes:
+    return _tag(field, 0) + _varint(1 if value else 0)
+
+
+def _read_varint(data: bytes, i: int) -> tuple:
+    """(value, next_index) — 7-bit little-endian groups."""
+    val = 0
+    shift = 0
+    while True:
+        b = data[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            return val, i
+
+
+def decode_fields(data: bytes) -> List[tuple]:
+    """[(field, wire_type, value)] — value is int for varint, bytes for
+    length-delimited. Only the wire types these messages use."""
+    out = []
+    i = 0
+    while i < len(data):
+        key, i = _read_varint(data, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, i = _read_varint(data, i)
+            out.append((field, wt, val))
+        elif wt == 2:
+            ln, i = _read_varint(data, i)
+            out.append((field, wt, data[i:i + ln]))
+            i += ln
+        else:  # pragma: no cover - not produced by this protocol
+            raise ValueError(f"unsupported wire type {wt}")
+    return out
+
+
+def _map_entry(key: str, value: str) -> bytes:
+    return enc_str(1, key) + enc_str(2, value)
+
+
+# ------------------------------------------------------------ the messages
+def register_request(endpoint: str, resource: str = RESOURCE_NAME) -> bytes:
+    return (enc_str(1, API_VERSION) + enc_str(2, endpoint)
+            + enc_str(3, resource))
+
+
+def device_plugin_options() -> bytes:
+    return enc_bool(1, False) + enc_bool(2, False)
+
+
+def list_and_watch_response(device_ids: List[str],
+                            health: str = HEALTHY) -> bytes:
+    body = b""
+    for did in device_ids:
+        dev = enc_str(1, did) + enc_str(2, health)
+        body += enc_msg(1, dev)
+    return body
+
+
+def parse_allocate_request(data: bytes) -> List[List[str]]:
+    """AllocateRequest -> device-id lists, one per container."""
+    containers = []
+    for field, wt, val in decode_fields(data):
+        if field == 1 and wt == 2:
+            ids = [v.decode() for f, w, v in decode_fields(val)
+                   if f == 1 and w == 2]
+            containers.append(ids)
+    return containers
+
+
+def allocate_response(per_container: List[List[str]]) -> bytes:
+    out = b""
+    for ids in per_container:
+        container = b""
+        container += enc_msg(1, _map_entry(
+            "TPU_VISIBLE_CHIPS", ",".join(sorted(ids))))
+        for did in sorted(ids):
+            path = f"/dev/accel{did}"
+            spec = enc_str(1, path) + enc_str(2, path) + enc_str(3, "rw")
+            container += enc_msg(3, spec)
+        out += enc_msg(1, container)
+    return out
+
+
+# ------------------------------------------------------------- enumeration
+def enumerate_tpu_chips(dev_root: str = "/dev") -> List[str]:
+    """Local chip ids from the accel device nodes GKE TPU hosts expose;
+    TPU_CHIP_COUNT overrides for environments without /dev/accel*."""
+    forced = os.environ.get("TPU_CHIP_COUNT")
+    if forced:
+        return [str(i) for i in range(int(forced))]
+    chips = []
+    for path in sorted(glob.glob(os.path.join(dev_root, "accel*"))):
+        suffix = path.rsplit("accel", 1)[1]
+        if suffix.isdigit():
+            chips.append(suffix)
+    return chips
+
+
+# ---------------------------------------------------------------- services
+_IDENT = (lambda b: b, lambda b: b)
+
+
+class DevicePluginServer:
+    """Serves DevicePlugin on a unix socket and registers with the kubelet.
+
+    ``with DevicePluginServer(...) as p:`` for tests; ``serve_forever`` in
+    the container.
+    """
+
+    def __init__(self, plugin_socket: str = PLUGIN_SOCKET,
+                 kubelet_socket: str = KUBELET_SOCKET,
+                 device_ids: Optional[List[str]] = None,
+                 watch_interval: float = 10.0):
+        self.plugin_socket = plugin_socket
+        self.kubelet_socket = kubelet_socket
+        self.device_ids = (device_ids if device_ids is not None
+                           else enumerate_tpu_chips())
+        self.watch_interval = watch_interval
+        self._stop = threading.Event()
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self.server.add_generic_rpc_handlers((self._handlers(),))
+
+    # ---- DevicePlugin service
+    def _handlers(self):
+        def options(request: bytes, ctx) -> bytes:
+            return device_plugin_options()
+
+        def list_and_watch(request: bytes, ctx) -> Iterator[bytes]:
+            # Initial inventory, then re-advertise on a heartbeat so a
+            # kubelet restart converges (health flips would go here too).
+            yield list_and_watch_response(self.device_ids)
+            while not self._stop.wait(self.watch_interval):
+                yield list_and_watch_response(self.device_ids)
+
+        def allocate(request: bytes, ctx) -> bytes:
+            return allocate_response(parse_allocate_request(request))
+
+        def empty(request: bytes, ctx) -> bytes:
+            return b""
+
+        svc = "v1beta1.DevicePlugin"
+        return grpc.method_handlers_generic_handler(svc, {
+            "GetDevicePluginOptions":
+                grpc.unary_unary_rpc_method_handler(options, *_IDENT),
+            "ListAndWatch":
+                grpc.unary_stream_rpc_method_handler(list_and_watch, *_IDENT),
+            "Allocate":
+                grpc.unary_unary_rpc_method_handler(allocate, *_IDENT),
+            "PreStartContainer":
+                grpc.unary_unary_rpc_method_handler(empty, *_IDENT),
+            "GetPreferredAllocation":
+                grpc.unary_unary_rpc_method_handler(empty, *_IDENT),
+        })
+
+    # ---- lifecycle
+    def start(self) -> "DevicePluginServer":
+        if os.path.exists(self.plugin_socket):
+            os.unlink(self.plugin_socket)
+        os.makedirs(os.path.dirname(self.plugin_socket) or ".", exist_ok=True)
+        self.server.add_insecure_port(f"unix://{self.plugin_socket}")
+        self.server.start()
+        return self
+
+    def register(self, timeout: float = 10.0) -> None:
+        """Registration.Register against the kubelet socket."""
+        channel = grpc.insecure_channel(f"unix://{self.kubelet_socket}")
+        register = channel.unary_unary(
+            "/v1beta1.Registration/Register",
+            request_serializer=_IDENT[0], response_deserializer=_IDENT[1])
+        register(register_request(os.path.basename(self.plugin_socket)),
+                 timeout=timeout)
+        channel.close()
+
+    def kubelet_restarted(self) -> bool:
+        """True when kubelet.sock was recreated since the last check — a
+        kubelet restart clears its plugin registry, so the plugin must
+        re-register (real plugins fsnotify this; we poll the inode)."""
+        try:
+            st = os.stat(self.kubelet_socket)
+        except OSError:
+            return False  # kubelet down; nothing to register against yet
+        # Inode numbers get recycled on tmpfs, so pair with creation time.
+        ident = (st.st_ino, st.st_ctime_ns)
+        last = getattr(self, "_kubelet_ident", None)
+        self._kubelet_ident = ident
+        return last is not None and ident != last
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.stop(grace=1).wait()
+        if os.path.exists(self.plugin_socket):
+            os.unlink(self.plugin_socket)
+
+    def __enter__(self) -> "DevicePluginServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="tk8s-device-plugin")
+    p.add_argument("--plugin-socket", default=PLUGIN_SOCKET)
+    p.add_argument("--kubelet-socket", default=KUBELET_SOCKET)
+    args = p.parse_args(argv)
+    plugin = DevicePluginServer(args.plugin_socket, args.kubelet_socket)
+    if not plugin.device_ids:
+        print("tk8s-device-plugin: no TPU chips found", file=sys.stderr)
+        return 1
+    plugin.start()
+    plugin.register()
+    plugin.kubelet_restarted()  # prime the inode baseline
+    print(f"tk8s-device-plugin: advertising {len(plugin.device_ids)} x "
+          f"{RESOURCE_NAME}", file=sys.stderr)
+    try:
+        while True:  # pragma: no cover - container loop
+            time.sleep(5)
+            if plugin.kubelet_restarted():
+                print("tk8s-device-plugin: kubelet restarted, "
+                      "re-registering", file=sys.stderr)
+                plugin.register()
+    except KeyboardInterrupt:  # pragma: no cover
+        plugin.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -143,6 +143,12 @@ class GcpTpuNodePool(Module):
                          render_tpu_device_plugin(spec),
                          render_slice_health_daemonset(spec, **kwargs)):
             ctx.cloud.apply_manifest(cluster_id, manifest)
+        # Clusters provisioned before the per-shape variant scheme carry
+        # fixed-name copies whose pods would fight the new ones over the
+        # kubelet socket — retire them on the way in.
+        for legacy in ("tpu-jax-runtime", "tpu-device-plugin",
+                       "tpu-slice-health"):
+            ctx.cloud.delete_manifest(cluster_id, "DaemonSet", legacy)
         resources = [Resource("gke_node_pool", f"{cluster_name}/{pool_name}")]
         return ({
             "slice_id": slice_id,

@@ -88,10 +88,13 @@ def render_tpu_device_plugin(spec: SliceSpec,
                              image: str = DEFAULT_DEVICE_PLUGIN_IMAGE,
                              namespace: str = "kube-system") -> Dict[str, Any]:
     """Device plugin advertising ``google.com/tpu`` (nvidia-device-plugin
-    analog). Per-generation name: its selector is the generation
-    accelerator label, so mixed-generation clusters keep one plugin per
-    generation instead of the last apply stealing the other's nodes."""
-    name = f"tpu-device-plugin-{spec.generation.name}"
+    analog; triton_kubernetes_tpu/manager/device_plugin.py). Keyed by
+    (machine shape, chip grant) like the runtime/health sets — each node
+    belongs to exactly one pool, so exactly one variant matches it — and
+    told its grant via TPU_CHIP_COUNT, so a sub-host v5p-2 pool advertises
+    2 chips even though the host has 4 (the gating slices.py's
+    chips_per_host contract relies on)."""
+    name = _chip_variant("tpu-device-plugin", spec)
     return {
         "apiVersion": "apps/v1",
         "kind": "DaemonSet",
@@ -101,11 +104,13 @@ def render_tpu_device_plugin(spec: SliceSpec,
             "template": {
                 "metadata": {"labels": {"app": name}},
                 "spec": {
-                    "nodeSelector": _tpu_node_selector(spec),
+                    "nodeSelector": _tpu_node_selector(spec, per_host=True),
                     "priorityClassName": "system-node-critical",
                     "containers": [{
                         "name": "device-plugin",
                         "image": image,
+                        "env": [{"name": "TPU_CHIP_COUNT",
+                                 "value": str(spec.chips_per_host)}],
                         "volumeMounts": [{
                             "name": "device-plugin-sock",
                             "mountPath": "/var/lib/kubelet/device-plugins",
