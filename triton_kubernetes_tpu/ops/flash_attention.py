@@ -41,7 +41,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-DEFAULT_BLOCK_Q = 512
+# Block sweep on v5e (llama3-bench, seq 2048, 2026-07-30, tok/s):
+# q512/k1024 35.0k, q256/k1024 32.8k, q512/k512 33.1k, q1024/k1024 35.6k,
+# q512/k2048 34.2k. Larger q blocks amortize the causal-mask bookkeeping;
+# both dims are clamped to the (128-padded) sequence at call time.
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
