@@ -89,3 +89,34 @@ def test_bad_gqa_ratio_rejected():
     q, k, v = _qkv(1, 64, 64, 3, 2, 32)
     with pytest.raises(ValueError, match="not a multiple"):
         flash_attention(q, k, v, interpret=True)
+
+
+def test_block_picker_never_inflates_padding():
+    from triton_kubernetes_tpu.ops.flash_attention import _pick_block
+
+    assert _pick_block(1024, 2048) == 1024  # divides: keep the default
+    assert _pick_block(1024, 1280) == 640   # divisor, no padding
+    assert _pick_block(1024, 640) == 640    # short seq: clamp
+    assert _pick_block(1024, 100) == 128    # pads to one 128 block
+    assert _pick_block(512, 1280) == 256    # honors smaller defaults
+    assert _pick_block(1024, 128 * 7) == 896  # <= default: one full block
+    assert _pick_block(512, 128 * 7) == 128   # 896 has no 128-mult divisor <= 512 but 128
+
+
+def test_flash_matches_dense_at_non_power_of_two_seq():
+    """seq 1280: the picker selects 640 blocks; output must still match
+    dense exactly (interpret mode)."""
+    import jax
+    import numpy as np
+
+    from triton_kubernetes_tpu.ops.attention import causal_attention
+    from triton_kubernetes_tpu.ops.flash_attention import flash_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (1, 1280, 4, 32), jnp.float32)
+    k = jax.random.normal(k2, (1, 1280, 2, 32), jnp.float32)
+    v = jax.random.normal(k3, (1, 1280, 2, 32), jnp.float32)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

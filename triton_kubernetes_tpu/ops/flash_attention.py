@@ -43,10 +43,22 @@ NEG_INF = -1e30
 
 # Block sweep on v5e (llama3-bench, seq 2048, 2026-07-30, tok/s):
 # q512/k1024 35.0k, q256/k1024 32.8k, q512/k512 33.1k, q1024/k1024 35.6k,
-# q512/k2048 34.2k. Larger q blocks amortize the causal-mask bookkeeping;
-# both dims are clamped to the (128-padded) sequence at call time.
+# q512/k2048 34.2k. Larger q blocks amortize the causal-mask bookkeeping.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+
+
+def _pick_block(default: int, s: int) -> int:
+    """Largest 128-multiple <= default that divides the 128-padded
+    sequence — a big default must never inflate padding (seq 1280 with
+    block 1024 would pad to 2048; picking 640 pads nothing)."""
+    sp = _round_up(s, 128)
+    if sp <= default:
+        return sp
+    for b in range(default - default % 128, 127, -128):
+        if sp % b == 0:
+            return b
+    return 128
 
 
 def _causal_mask(s, qi, ki, block_q, block_k, sk):
@@ -379,7 +391,7 @@ def flash_attention(q, k, v, block_q: int = DEFAULT_BLOCK_Q,
     _, sk, hkv, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
-    block_q = min(block_q, _round_up(sq, 128))
-    block_k = min(block_k, _round_up(sk, 128))
+    block_q = _pick_block(block_q, sq)
+    block_k = _pick_block(block_k, sk)
     return _make_flash(b, sq, sk, hq, hkv, d, block_q, block_k,
                        interpret)(q, k, v)

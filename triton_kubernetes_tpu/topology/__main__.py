@@ -83,8 +83,19 @@ def main(argv: List[str] | None = None) -> int:
 
     json.dump(_as_list(items), sys.stdout, indent=2, sort_keys=True)
     sys.stdout.write("\n")
+    sys.stdout.flush()
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # Script mode only: a downstream `| head` closing early is a quiet
+    # exit, not a traceback. The devnull dup2 prevents the interpreter's
+    # shutdown flush from re-raising; in-process callers of main() keep
+    # their stdout and see the exception instead.
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
