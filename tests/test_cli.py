@@ -125,3 +125,32 @@ def test_destroy_cluster_via_cli(capsys):
     assert rc == 0
     doc = be.state("m1")
     assert doc.clusters() == {}
+
+
+def test_validate_verb_clean_and_corrupted(capsys):
+    """`validate` structurally checks the module tree plus every stored
+    document: 0 on a workflow-written store, 1 (with diagnostics) after
+    hand-corruption — the operator-facing twin of executor preflight."""
+    be = MemoryBackend()
+    ex = LocalExecutor()
+    assert main([
+        "--non-interactive",
+        "--set", "manager_cloud_provider=bare-metal",
+        "--set", "name=m1",
+        "--set", "host=10.0.0.5",
+        "create", "manager",
+    ], backend=be, executor=ex) == 0
+    capsys.readouterr()
+
+    assert main(["validate"], backend=be) == 0
+    assert "OK" in capsys.readouterr().out
+
+    doc = be.state("m1")
+    doc.set("module.cluster-manager.no_such_variable", "x")
+    doc.set("module.cluster-manager.bad_ref",
+            "${module.cluster-manager.rancher_url}")
+    be.persist(doc)
+    assert main(["validate"], backend=be) == 1
+    err = capsys.readouterr().err
+    assert "no_such_variable" in err
+    assert "rancher_url" in err

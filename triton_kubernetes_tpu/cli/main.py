@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -150,6 +151,11 @@ def build_parser() -> argparse.ArgumentParser:
     restore = sub.add_parser("restore", help="restore from a backup")
     restore.add_argument("kind", choices=["backup"])
 
+    sub.add_parser(
+        "validate",
+        help="structurally validate the shipped terraform module tree and "
+             "every stored state document (no terraform binary needed)")
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -186,6 +192,33 @@ def main(argv: Optional[List[str]] = None,
 
     try:
         from ..catalogs import make_catalog
+
+        if args.command == "validate":
+            from ..executor.terraform import default_modules_root
+            from ..executor.tf_validate import (validate_document,
+                                                validate_modules_tree)
+
+            root = (str(config.get("terraform_modules_root"))
+                    if config.is_set("terraform_modules_root")
+                    else default_modules_root())
+            if os.path.isdir(root):
+                problems = validate_modules_tree(root)
+            else:
+                # A missing tree is an error, not vacuously clean — a
+                # typo'd terraform_modules_root must not print OK.
+                problems = {root: ["modules root does not exist"]}
+            be = backend if backend is not None else choose_backend(resolver)
+            for name in be.states():
+                errs = validate_document(be.state(name), modules_root=root)
+                if errs:
+                    problems[f"state:{name}"] = errs
+            if problems:
+                for target, errs in sorted(problems.items()):
+                    for e in errs:
+                        logger.error(e, target=target)
+                return 1
+            print("validated: module tree and all state documents OK")
+            return 0
 
         be = backend if backend is not None else choose_backend(resolver)
         ex = executor if executor is not None else choose_executor(

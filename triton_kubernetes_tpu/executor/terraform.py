@@ -114,7 +114,32 @@ class TerraformExecutor:
             shutil.copytree(self.plugin_dir, dst)
         return td
 
+    def preflight(self, doc: StateDocument, strict: bool = True) -> None:
+        """Structural validation before shelling out — the reference let
+        `terraform init` discover doc typos mid-run; failing in-process
+        with a list of real messages is strictly better (and the only
+        check available on machines without the binary).
+
+        ``strict=False`` (the destroy path) warns instead of raising: a doc
+        that stopped validating must never make live cloud resources
+        undeletable through the tool."""
+        import sys
+
+        from .engine import ApplyError
+        from .tf_validate import validate_document
+
+        errors = validate_document(doc, modules_root=self.modules_root)
+        if not errors:
+            return
+        msg = ("document failed terraform preflight validation:\n  "
+               + "\n  ".join(errors))
+        if strict:
+            raise ApplyError(msg)
+        print(f"warning: {msg}\nproceeding with destroy anyway",
+              file=sys.stderr)
+
     def apply(self, doc: StateDocument, targets: Optional[List[str]] = None) -> None:
+        self.preflight(doc)
         with self._workdir(doc) as cwd:
             self._run(["init", "-force-copy"], cwd)
             args = ["apply", "-auto-approve"]
@@ -123,6 +148,7 @@ class TerraformExecutor:
             self._run(args, cwd)
 
     def destroy(self, doc: StateDocument, targets: Optional[List[str]] = None) -> None:
+        self.preflight(doc, strict=False)
         with self._workdir(doc) as cwd:
             self._run(["init", "-force-copy"], cwd)
             args = ["destroy", "-auto-approve"]

@@ -1,0 +1,674 @@
+"""Structural validator for Terraform-JSON module trees and root documents.
+
+The reference never shipped a validator — its modules were parsed by the
+``terraform`` binary on every user run (shell/run_terraform.go:95-104:
+``init`` + ``apply`` IS the product), so a block-shape typo surfaced on the
+first user's machine. This framework authors its HCL tree in Terraform JSON
+syntax precisely so it can be machine-checked *without* the binary:
+
+* root-block grammar per file (``resource``/``data``/``variable``/``output``
+  shapes, required attributes for the resource types the tree uses);
+* every ``${var.x}`` resolves to a declared variable, ``${local.x}`` to a
+  ``locals`` entry, resource/data references to declared blocks;
+* ``depends_on`` entries resolve;
+* function-call names are real Terraform builtins (catches ``templtefile``);
+* ``${path.module}/...`` file references exist on disk;
+* ``templatefile(...)`` calls pass every variable the template consumes;
+* root documents: module sources resolve, required variables are present,
+  unknown variables are flagged, and every ``${module.k.out}`` names a
+  declared module and one of its registered OUTPUTS (the deferred-resolution
+  contract of create/cluster.go:297-300).
+
+Used three ways: the test suite validates all shipped modules; the
+``TerraformExecutor`` preflights every document before shelling out (so a
+bad doc fails in-process with a real message instead of mid-apply); and the
+CLI exposes ``validate`` for operators editing documents by hand.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Expression scanning
+
+_BUILTIN_HEADS = {"var", "local", "module", "data", "path", "each", "count",
+                  "self", "terraform"}
+
+_PATH_ATTRS = {"module", "root", "cwd"}
+
+# Terraform language builtins (the subset is generous; unknown names are the
+# signal we want — a typo'd call fails `terraform init` on a user machine).
+KNOWN_FUNCTIONS = {
+    "abs", "alltrue", "anytrue", "base64decode", "base64encode", "basename",
+    "can", "ceil", "chomp", "cidrhost", "cidrnetmask", "cidrsubnet",
+    "coalesce", "coalescelist", "compact", "concat", "contains", "dirname",
+    "distinct", "element", "endswith", "file", "filebase64", "fileexists",
+    "flatten", "floor", "format", "formatlist", "indent", "index", "join",
+    "jsondecode", "jsonencode", "keys", "length", "list", "log", "lookup",
+    "lower", "map", "max", "md5", "merge", "min", "one", "pathexpand",
+    "pow", "range", "regex", "regexall", "replace", "reverse", "sensitive",
+    "setproduct", "setunion", "sha1", "sha256", "signum", "slice", "sort",
+    "split", "startswith", "strcontains", "substr", "sum", "templatefile",
+    "timestamp", "title", "tobool", "tolist", "tomap", "tonumber", "toset",
+    "tostring", "trim", "trimprefix", "trimspace", "trimsuffix", "try",
+    "upper", "urlencode", "uuid", "values", "yamldecode", "yamlencode",
+    "zipmap",
+}
+
+# Provider local-name for each resource/data type prefix used in the tree.
+_PROVIDER_OF_PREFIX = {
+    "aws": "aws", "google": "google", "azurerm": "azurerm",
+    "vsphere": "vsphere", "null": "null", "local": "local",
+    "external": "external", "triton": "triton", "random": "random",
+    "tls": "tls",
+}
+
+# Required top-level attributes per resource type (conservative: only
+# attributes that `terraform validate` itself would reject as missing).
+REQUIRED_ATTRS: Dict[str, Tuple[str, ...]] = {
+    "aws_vpc": ("cidr_block",),
+    "aws_subnet": ("vpc_id", "cidr_block"),
+    "aws_internet_gateway": ("vpc_id",),
+    "aws_route_table": ("vpc_id",),
+    "aws_route": ("route_table_id",),
+    "aws_route_table_association": ("subnet_id", "route_table_id"),
+    "aws_security_group_rule": ("type", "from_port", "to_port", "protocol",
+                                "security_group_id"),
+    "aws_key_pair": ("public_key",),
+    "aws_instance": ("ami", "instance_type"),
+    "aws_ebs_volume": ("availability_zone", "size"),
+    "aws_volume_attachment": ("device_name", "volume_id", "instance_id"),
+    "google_compute_network": ("name",),
+    "google_compute_firewall": ("name", "network"),
+    "google_compute_instance": ("name", "machine_type", "zone", "boot_disk",
+                                "network_interface"),
+    "google_compute_disk": ("name", "zone"),
+    "google_compute_attached_disk": ("disk", "instance"),
+    "google_container_cluster": ("name", "location"),
+    "google_container_node_pool": ("cluster",),
+    "azurerm_resource_group": ("name", "location"),
+    "azurerm_virtual_network": ("name", "location", "resource_group_name",
+                                "address_space"),
+    "azurerm_subnet": ("name", "resource_group_name", "virtual_network_name",
+                       "address_prefixes"),
+    "azurerm_network_security_group": ("name", "location",
+                                       "resource_group_name"),
+    "azurerm_network_security_rule": ("name", "priority", "direction",
+                                      "access", "protocol",
+                                      "resource_group_name",
+                                      "network_security_group_name"),
+    "azurerm_subnet_network_security_group_association": (
+        "subnet_id", "network_security_group_id"),
+    "azurerm_public_ip": ("name", "location", "resource_group_name",
+                          "allocation_method"),
+    "azurerm_network_interface": ("name", "location", "resource_group_name",
+                                  "ip_configuration"),
+    "azurerm_linux_virtual_machine": ("name", "location",
+                                      "resource_group_name", "size",
+                                      "admin_username",
+                                      "network_interface_ids", "os_disk"),
+    "azurerm_managed_disk": ("name", "location", "resource_group_name",
+                             "storage_account_type", "create_option"),
+    "azurerm_virtual_machine_data_disk_attachment": (
+        "managed_disk_id", "virtual_machine_id", "lun", "caching"),
+    "azurerm_kubernetes_cluster": ("name", "location", "resource_group_name",
+                                   "dns_prefix"),
+    "vsphere_virtual_machine": ("name", "resource_pool_id",),
+    "local_sensitive_file": ("filename",),
+    "null_resource": (),
+    "triton_machine": ("package", "image"),
+    "kubernetes_deployment": ("metadata", "spec"),
+}
+
+_ROOT_KEYS = {"//", "terraform", "provider", "variable", "output", "locals",
+              "resource", "data", "module"}
+
+_VARIABLE_KEYS = {"description", "default", "type", "sensitive", "nullable",
+                  "validation"}
+
+_META_ARGS = {"count", "for_each", "provider", "depends_on", "lifecycle",
+              "provisioner", "connection", "triggers", "//"}
+
+
+def interpolation_exprs(s: str) -> List[str]:
+    """Extract every top-level ``${...}`` expression from a string,
+    brace-balanced (object constructors and nested interpolations stay inside
+    one expression), honoring ``$${`` escapes."""
+    out: List[str] = []
+    i = 0
+    n = len(s)
+    while i < n:
+        j = s.find("${", i)
+        if j < 0:
+            break
+        if j > 0 and s[j - 1] == "$":  # $${ literal escape
+            i = j + 2
+            continue
+        depth = 1
+        k = j + 2
+        while k < n and depth:
+            c = s[k]
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+            k += 1
+        out.append(s[j + 2:k - 1])
+        i = k
+    return out
+
+
+_STRING_LIT = re.compile(r'"(?:[^"\\]|\\.)*"')
+_FOR_VARS = re.compile(r"\bfor\s+([A-Za-z_]\w*)(?:\s*,\s*([A-Za-z_]\w*))?\s+in\b")
+_REF = re.compile(
+    r"(?<![\w.\"'-])([A-Za-z_][\w-]*)((?:\.(?:[A-Za-z_*0-9][\w-]*)|\[[^\]]*\])+)")
+_FUNC = re.compile(r"(?<![\w.])([a-z][a-z0-9_]*)\s*\(")
+
+
+def _strip_strings(expr: str) -> Tuple[str, List[str]]:
+    """Replace string literals with spaces, returning nested interpolation
+    expressions found inside them for recursive scanning."""
+    nested: List[str] = []
+
+    def repl(m: re.Match) -> str:
+        nested.extend(interpolation_exprs(m.group(0)[1:-1]))
+        return " " * len(m.group(0))
+
+    return _STRING_LIT.sub(repl, expr), nested
+
+
+def expression_refs(expr: str) -> Tuple[List[Tuple[str, List[str]]], Set[str]]:
+    """All (head, path-segments) references and all function-call names in a
+    Terraform expression, recursing into nested string interpolations."""
+    refs: List[Tuple[str, List[str]]] = []
+    funcs: Set[str] = set()
+    queue = [expr]
+    while queue:
+        e = queue.pop()
+        stripped, nested = _strip_strings(e)
+        queue.extend(nested)
+        loop_vars = set()
+        for m in _FOR_VARS.finditer(stripped):
+            loop_vars.update(g for g in m.groups() if g)
+        for m in _FUNC.finditer(stripped):
+            funcs.add(m.group(1))
+        for m in _REF.finditer(stripped):
+            head = m.group(1)
+            if head in loop_vars:
+                continue
+            segs = [s for s in re.split(r"\.|\[[^\]]*\]", m.group(2)) if s]
+            refs.append((head, segs))
+    return refs, funcs
+
+
+def _walk_strings(value: Any):
+    if isinstance(value, str):
+        yield value
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            if k == "//":
+                continue
+            yield from _walk_strings(v)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _walk_strings(v)
+
+
+def _walk_key(value: Any, key: str):
+    """Yield every value held under `key` anywhere in a JSON tree."""
+    if isinstance(value, dict):
+        for k, v in value.items():
+            if k == key:
+                yield v
+            else:
+                yield from _walk_key(v, key)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _walk_key(v, key)
+
+
+# ---------------------------------------------------------------------------
+# Module-directory validation
+
+
+class _ModuleFiles:
+    def __init__(self, path: str):
+        self.path = path
+        self.docs: Dict[str, Dict[str, Any]] = {}
+        self.errors: List[str] = []
+        for fname in ("main.tf.json", "variables.tf.json", "outputs.tf.json"):
+            fpath = os.path.join(path, fname)
+            if not os.path.isfile(fpath):
+                self.errors.append(f"{fname}: missing")
+                self.docs[fname] = {}
+                continue
+            try:
+                with open(fpath) as f:
+                    doc = json.load(f)
+            except ValueError as e:
+                self.errors.append(f"{fname}: invalid JSON: {e}")
+                doc = {}
+            if not isinstance(doc, dict):
+                self.errors.append(f"{fname}: root must be a JSON object")
+                doc = {}
+            self.docs[fname] = doc
+
+
+def validate_module_dir(path: str) -> List[str]:
+    """Validate one HCL-JSON module directory; returns error strings
+    (empty = valid)."""
+    name = os.path.basename(path.rstrip("/"))
+    mf = _ModuleFiles(path)
+    errors = [f"{name}/{e}" for e in mf.errors]
+
+    main = mf.docs["main.tf.json"]
+    variables = mf.docs["variables.tf.json"].get("variable", {})
+    outputs = mf.docs["outputs.tf.json"].get("output", {})
+
+    def err(msg: str) -> None:
+        errors.append(f"{name}: {msg}")
+
+    # --- root-block grammar -------------------------------------------------
+    for fname, doc in mf.docs.items():
+        for key in doc:
+            if key not in _ROOT_KEYS:
+                errors.append(f"{name}/{fname}: unknown root block {key!r}")
+
+    if not isinstance(variables, dict):
+        err("variables.tf.json: 'variable' must be an object")
+        variables = {}
+    for vname, vbody in variables.items():
+        if not isinstance(vbody, dict):
+            err(f"variable {vname!r}: body must be an object")
+            continue
+        unknown = set(vbody) - _VARIABLE_KEYS - {"//"}
+        if unknown:
+            err(f"variable {vname!r}: unknown keys {sorted(unknown)}")
+
+    if not isinstance(outputs, dict):
+        err("outputs.tf.json: 'output' must be an object")
+        outputs = {}
+    for oname, obody in outputs.items():
+        if not isinstance(obody, dict) or "value" not in obody:
+            err(f"output {oname!r}: must be an object with a 'value'")
+
+    # --- gather declarations ------------------------------------------------
+    locals_decl: Set[str] = set()
+    resources: Dict[str, Set[str]] = {}
+    datas: Dict[str, Set[str]] = {}
+    required_providers: Set[str] = set()
+    for doc in mf.docs.values():
+        loc = doc.get("locals", {})
+        if isinstance(loc, dict):
+            locals_decl.update(k for k in loc if k != "//")
+        for rtype, insts in (doc.get("resource", {}) or {}).items():
+            if not isinstance(insts, dict):
+                err(f"resource {rtype!r}: must map names to bodies")
+                continue
+            resources.setdefault(rtype, set()).update(insts)
+        for dtype, insts in (doc.get("data", {}) or {}).items():
+            if not isinstance(insts, dict):
+                err(f"data {dtype!r}: must map names to bodies")
+                continue
+            datas.setdefault(dtype, set()).update(insts)
+        tf = doc.get("terraform", {})
+        if isinstance(tf, dict):
+            required_providers.update(tf.get("required_providers", {}) or {})
+
+    # --- resource shapes + provider coverage --------------------------------
+    for rtype, insts in (main.get("resource", {}) or {}).items():
+        prefix = rtype.split("_", 1)[0]
+        provider = _PROVIDER_OF_PREFIX.get(prefix)
+        if provider and required_providers and \
+                provider not in required_providers:
+            err(f"resource {rtype!r}: provider {provider!r} not in "
+                f"required_providers {sorted(required_providers)}")
+        required = REQUIRED_ATTRS.get(rtype)
+        for iname, body in insts.items():
+            if not isinstance(body, dict):
+                err(f"resource {rtype}.{iname}: body must be an object")
+                continue
+            if required is None:
+                continue
+            for attr in required:
+                if attr not in body:
+                    err(f"resource {rtype}.{iname}: missing required "
+                        f"attribute {attr!r}")
+
+    # --- reference resolution -----------------------------------------------
+    used_vars: Set[str] = set()
+    for doc in mf.docs.values():
+        for s in _walk_strings(doc):
+            for expr in interpolation_exprs(s):
+                refs, funcs = expression_refs(expr)
+                for fn in funcs - KNOWN_FUNCTIONS:
+                    err(f"unknown function {fn!r} in ${{{expr[:60]}}}")
+                for head, segs in refs:
+                    if head == "var":
+                        if segs and segs[0] not in variables:
+                            err(f"undeclared variable var.{segs[0]} "
+                                f"in ${{{expr[:60]}}}")
+                        elif segs:
+                            used_vars.add(segs[0])
+                    elif head == "local":
+                        if segs and segs[0] not in locals_decl:
+                            err(f"undeclared local.{segs[0]} "
+                                f"in ${{{expr[:60]}}}")
+                    elif head == "module":
+                        err(f"module reference ${{{expr[:60]}}} inside a "
+                            f"module (submodule calls are not used here)")
+                    elif head == "data":
+                        if len(segs) >= 2 and (
+                                segs[0] not in datas or
+                                segs[1] not in datas[segs[0]]):
+                            err(f"unresolved data.{'.'.join(segs[:2])} "
+                                f"in ${{{expr[:60]}}}")
+                    elif head == "path":
+                        if segs and segs[0] not in _PATH_ATTRS:
+                            err(f"unknown path.{segs[0]}")
+                    elif head in ("each", "count", "self", "terraform"):
+                        pass
+                    else:
+                        # resource reference
+                        if head not in resources or (
+                                segs and resources[head] and
+                                segs[0] not in resources[head]):
+                            if head in resources:
+                                err(f"unresolved resource {head}.{segs[0]}")
+                            elif "_" in head:
+                                err(f"unresolved reference "
+                                    f"{head}.{'.'.join(segs)} "
+                                    f"in ${{{expr[:60]}}}")
+                            # bare single-word heads that aren't declared
+                            # resources are most likely expression locals we
+                            # failed to scope — stay silent rather than
+                            # false-positive.
+
+    for vname in variables:
+        if vname not in used_vars:
+            # Declared-but-unused is legal terraform; only surface it when
+            # the variable is required (no default) — then the module
+            # demands an input it never reads, which is a doc-contract bug.
+            # A "//" annotation in the variable body opts out (doc-level
+            # passthrough vars that node modules copy, the reference's
+            # create/node_vsphere.go currentState.Get pattern).
+            if "default" not in variables[vname] and \
+                    "//" not in variables[vname]:
+                err(f"required variable {vname!r} is never referenced")
+
+    # --- depends_on ---------------------------------------------------------
+    for doc in mf.docs.values():
+        for deps in _walk_key(doc, "depends_on"):
+            if not isinstance(deps, (list, tuple)):
+                err("depends_on must be a list")
+                continue
+            for dep in deps:
+                segs = str(dep).split(".")
+                if segs[0] == "data":
+                    ok = len(segs) >= 3 and segs[1] in datas and \
+                        segs[2] in datas[segs[1]]
+                elif segs[0] == "module":
+                    ok = False
+                else:
+                    ok = len(segs) >= 2 and segs[0] in resources and \
+                        segs[1] in resources[segs[0]]
+                if not ok:
+                    err(f"depends_on entry {dep!r} does not resolve")
+
+    # --- file references + templatefile contracts ---------------------------
+    errors.extend(f"{name}: {e}" for e in _check_files(path, mf))
+    return errors
+
+
+_PATH_REF = re.compile(r"\$\{path\.module\}/((?:\.\./)?[A-Za-z0-9._/-]+)")
+_TPL_CALL = re.compile(r"templatefile\(")
+
+
+def _check_files(path: str, mf: _ModuleFiles) -> List[str]:
+    errors: List[str] = []
+    raw = json.dumps(mf.docs["main.tf.json"])
+    for rel in sorted(set(_PATH_REF.findall(raw))):
+        fpath = os.path.normpath(os.path.join(path, rel))
+        if not os.path.isfile(fpath):
+            errors.append(f"referenced file {rel} does not exist")
+    # templatefile(path, {args}) — every ${ident} the template consumes must
+    # be passed (terraform fails at apply otherwise; we fail here).
+    for s in _walk_strings(mf.docs["main.tf.json"]):
+        for m in _TPL_CALL.finditer(s):
+            call = _balanced_call(s, m.end() - 1)
+            if call is None:
+                continue
+            pm = _PATH_REF.search(call)
+            if pm is None:
+                continue
+            tpl_path = os.path.normpath(os.path.join(path, pm.group(1)))
+            if not os.path.isfile(tpl_path):
+                continue  # existence already reported
+            passed = _toplevel_object_keys(call)
+            with open(tpl_path) as f:
+                tpl = f.read()
+            needed = _template_vars(tpl)
+            missing = needed - passed
+            if missing:
+                errors.append(
+                    f"templatefile({pm.group(1)}): template consumes "
+                    f"{sorted(missing)} but call passes {sorted(passed)}")
+    return errors
+
+
+def _toplevel_object_keys(call: str) -> Set[str]:
+    """Keys of the outermost object literal in a templatefile(...) call —
+    nested map keys must not mask a missing top-level template variable.
+    String literals (which may contain '{' via ${path.module}) are skipped;
+    only `key =` pairs at object depth 1 directly inside the call's own
+    parentheses count."""
+    keys: Set[str] = set()
+    paren = brace = 0
+    anchor = -1
+    i, n = 0, len(call)
+    while i < n:
+        c = call[i]
+        if c == '"':
+            i += 1
+            while i < n and call[i] != '"':
+                i += 2 if call[i] == "\\" else 1
+        elif c == "(":
+            paren += 1
+        elif c == ")":
+            paren -= 1
+        elif c == "{":
+            brace += 1
+            if brace == 1 and paren == 1:
+                anchor = i
+        elif c == "}":
+            brace -= 1
+        elif c == "=" and brace == 1 and paren == 1 and anchor >= 0:
+            if (i + 1 >= n or call[i + 1] != "=") and \
+                    call[i - 1] not in "!<>=":
+                m = re.search(r"(\w+)\s*$", call[anchor + 1:i])
+                if m:
+                    keys.add(m.group(1))
+        elif c == "," and brace == 1 and paren == 1:
+            anchor = i
+        i += 1
+    return keys
+
+
+def _balanced_call(s: str, open_paren: int) -> Optional[str]:
+    depth = 0
+    for k in range(open_paren, len(s)):
+        if s[k] == "(":
+            depth += 1
+        elif s[k] == ")":
+            depth -= 1
+            if depth == 0:
+                return s[open_paren:k + 1]
+    return None
+
+
+def _template_vars(tpl: str) -> Set[str]:
+    """Variables a .tpl template consumes: heads of ${...} interpolations
+    and %{ for/if } directives that are plain identifiers (function calls
+    and $${bash} escapes excluded)."""
+    needed: Set[str] = set()
+    loop_vars: Set[str] = set()
+    for m in _FOR_VARS.finditer(tpl):
+        loop_vars.update(g for g in m.groups() if g)
+    for expr in interpolation_exprs(tpl):
+        refs, _funcs = expression_refs(expr)
+        for head, _segs in refs:
+            if head not in _BUILTIN_HEADS:
+                needed.add(head)
+        for m in re.finditer(r"\b([A-Za-z_]\w*)\b", expr):
+            tok = m.group(1)
+            if (tok not in KNOWN_FUNCTIONS and tok not in _BUILTIN_HEADS
+                    and not re.search(rf"{tok}\s*\(", expr)
+                    and not re.search(rf"[.\"']{tok}", expr)):
+                needed.add(tok)
+    # %{ if cond }/%{ for x in y } directives
+    for m in re.finditer(r"%\{[^}]*\}", tpl):
+        body = m.group(0)[2:-1]
+        refs, _funcs = expression_refs(body)
+        for head, _segs in refs:
+            if head not in _BUILTIN_HEADS:
+                needed.add(head)
+    return {t for t in needed
+            if t not in loop_vars and t not in ("if", "for", "in", "else",
+                                                "endif", "endfor", "true",
+                                                "false", "null")}
+
+
+def validate_modules_tree(root: str) -> Dict[str, List[str]]:
+    """Validate every module directory under a tree root; returns
+    {module_name: [errors]} for modules with problems."""
+    bad: Dict[str, List[str]] = {}
+    for entry in sorted(os.listdir(root)):
+        path = os.path.join(root, entry)
+        if not os.path.isdir(path) or entry == "files":
+            continue
+        errs = validate_module_dir(path)
+        if errs:
+            bad[entry] = errs
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# Root-document validation
+
+_DOC_ROOT_KEYS = _ROOT_KEYS | {"driver", "executor", "catalog"}
+
+
+def validate_document(doc: Any, modules_root: Optional[str] = None,
+                      use_registry: bool = True) -> List[str]:
+    """Validate a generated root document (the ``main.tf.json`` the executor
+    emits): module sources resolve, required variables present, unknown
+    variables flagged, every ``${module.k.out}`` names a declared module and
+    a registered output."""
+    data = doc.to_dict() if hasattr(doc, "to_dict") else doc
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["root document must be a JSON object"]
+    for key in data:
+        if key not in _DOC_ROOT_KEYS:
+            errors.append(f"unknown root block {key!r}")
+
+    modules = data.get("module", {}) or {}
+    if not isinstance(modules, dict):
+        return errors + ["'module' must be an object"]
+
+    # Resolve each module's declared variables/outputs from the registry or
+    # the on-disk HCL tree.
+    known_outputs: Dict[str, Optional[Set[str]]] = {}
+    for key, cfg in modules.items():
+        if not isinstance(cfg, dict):
+            errors.append(f"module.{key}: body must be an object")
+            continue
+        source = cfg.get("source", "")
+        if not source:
+            errors.append(f"module.{key}: missing 'source'")
+            continue
+        spec = _module_spec(source, modules_root, use_registry)
+        if spec is None:
+            known_outputs[key] = None  # unknown source: outputs unchecked
+            continue
+        var_names, required, outputs = spec
+        known_outputs[key] = outputs
+        given = {k for k in cfg if k not in ("source", "//")}
+        for missing in sorted(required - given):
+            errors.append(f"module.{key}: required variable {missing!r} "
+                          f"not set")
+        for unknown in sorted(given - var_names):
+            errors.append(f"module.{key}: unknown variable {unknown!r} "
+                          f"(declared: none of {sorted(var_names)[:8]}...)")
+
+    # ${module.k.out} references anywhere in the doc.
+    for s in _walk_strings(data):
+        for expr in interpolation_exprs(s):
+            refs, _funcs = expression_refs(expr)
+            for head, segs in refs:
+                if head != "module" or not segs:
+                    continue
+                mkey = segs[0]
+                if mkey not in modules:
+                    errors.append(f"${{{expr[:70]}}}: unknown module "
+                                  f"{mkey!r}")
+                    continue
+                outs = known_outputs.get(mkey)
+                if outs is not None and len(segs) >= 2 and \
+                        segs[1] not in outs:
+                    errors.append(f"${{{expr[:70]}}}: module {mkey!r} has "
+                                  f"no output {segs[1]!r}")
+    return errors
+
+
+def _module_spec(source: str, modules_root: Optional[str],
+                 use_registry: bool
+                 ) -> Optional[Tuple[Set[str], Set[str], Set[str]]]:
+    """(variables, required-variables, outputs) for a module source.
+
+    A document can be executed by either the in-process registry module or
+    its HCL twin (the TerraformExecutor rewrites sources to the tree), and
+    the twin may declare extra optional variables (ssh_user, registry
+    creds). Validation must not reject a doc either path accepts, so the
+    two specs are merged: variables and outputs are unioned, and a variable
+    counts as required only if every spec that knows it requires it."""
+    specs = []
+    if use_registry:
+        try:
+            from ..modules import get_module
+            mod = get_module(source)
+            specs.append(({v.name for v in mod.VARIABLES},
+                          {v.name for v in mod.VARIABLES if v.required},
+                          set(mod.OUTPUTS)))
+        except Exception:
+            pass
+    if modules_root:
+        try:
+            from ..modules.registry import module_name_from_source
+            name = module_name_from_source(source)
+        except Exception:
+            name = os.path.basename(source)
+        path = os.path.join(modules_root, name)
+        if os.path.isdir(path):
+            mf = _ModuleFiles(path)
+            variables = mf.docs["variables.tf.json"].get("variable", {})
+            outputs = mf.docs["outputs.tf.json"].get("output", {})
+            if isinstance(variables, dict) and isinstance(outputs, dict):
+                specs.append((set(variables),
+                              {v for v, b in variables.items()
+                               if isinstance(b, dict) and "default" not in b},
+                              set(outputs)))
+    if not specs:
+        return None
+    var_names: Set[str] = set()
+    outputs_u: Set[str] = set()
+    for vs, _req, outs in specs:
+        var_names |= vs
+        outputs_u |= outs
+    required = {v for v in var_names
+                if all(v in req for vs, req, _ in specs if v in vs)}
+    return var_names, required, outputs_u
