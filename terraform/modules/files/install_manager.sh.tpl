@@ -22,20 +22,18 @@ fi
 
 # Wait for the API, then mint an admin token (create-or-get: rerunning the
 # provisioner must not rotate credentials out from under saved state). The
-# image serves plain HTTP on 80; 443 is live when a TLS frontend is layered
-# on, so probe both.
+# image serves HTTPS on 443 with its self-signed cert (the cert IS the
+# cacerts body agents pin via --ca-checksum); -k here is the trust
+# bootstrap, every agent re-anchors to the pinned cert afterwards.
 for i in $(seq 1 120); do
   curl -kfsS "https://${host}/v3" >/dev/null 2>&1 && break
-  curl -fsS "http://${host}/v3" >/dev/null 2>&1 && break
   sleep 5
 done
 
-# The minted URL must be reachable by agents and data.external programs:
-# the image serves HTTP on 80 (put a TLS frontend on 443 and pass
-# manager_url_scheme=https to change this).
+# The minted URL must be reachable by agents and data.external programs.
 if ! sudo test -s /root/tk8s_api_key.json; then
   sudo docker exec tk8s-manager tk8s-admin init-token \
-    --server http://127.0.0.1:80 \
+    --server https://127.0.0.1:443 \
     %{ if admin_password != "" ~} --admin-password '${admin_password}' %{ endif ~} \
-    --url "http://${host}" --json | sudo tee /root/tk8s_api_key.json >/dev/null
+    --url "https://${host}" --json | sudo tee /root/tk8s_api_key.json >/dev/null
 fi

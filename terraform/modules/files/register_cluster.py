@@ -23,6 +23,35 @@ import urllib.parse
 import urllib.request
 
 
+_PINNED = {"ctx": None}
+
+
+def _context():
+    if _PINNED["ctx"] is not None:
+        return _PINNED["ctx"]
+    # Un-pinned bootstrap (reference curls with -k): only ever used for the
+    # first cacerts fetch; pin() swaps in a verifying context after.
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+def pin(base, auth):
+    """Fetch the manager's cacerts, then anchor every later request's SSL
+    context to exactly that PEM: a relay MITM cannot complete subsequent
+    handshakes without the manager's private key, so the emitted
+    ca_checksum really belongs to the server that answers the API calls.
+    Plain-http managers (dev mode) have nothing to pin."""
+    cacerts = request("GET", f"{base}/v3/settings/cacerts", auth)["value"]
+    if base.startswith("https://"):
+        ctx = ssl.create_default_context(cadata=cacerts)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        _PINNED["ctx"] = ctx
+    return cacerts
+
+
 def request(method, url, auth, body=None):
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method, headers={
@@ -30,11 +59,7 @@ def request(method, url, auth, body=None):
         "Authorization": "Basic "
         + base64.b64encode(auth.encode()).decode(),
     })
-    # Self-signed manager certs are the norm (reference curls with -k).
-    ctx = ssl.create_default_context()
-    ctx.check_hostname = False
-    ctx.verify_mode = ssl.CERT_NONE
-    with urllib.request.urlopen(req, timeout=60, context=ctx) as resp:
+    with urllib.request.urlopen(req, timeout=60, context=_context()) as resp:
         return json.load(resp)
 
 
@@ -42,6 +67,11 @@ def main():
     q = json.load(sys.stdin)
     base = q["manager_url"].rstrip("/")
     auth = f"{q['access_key']}:{q['secret_key']}"
+
+    # Trust bootstrap first: all the calls below run TLS-verified against
+    # the served cert, and its sha256 is the checksum this program emits.
+    cacerts = pin(base, auth)
+    checksum = hashlib.sha256(cacerts.encode()).hexdigest()
 
     # Create-or-get: look the cluster up by name first
     # (rancher_cluster.sh:17-28 contract).
@@ -57,9 +87,6 @@ def main():
 
     token = request("POST", f"{base}/v3/clusterregistrationtoken", auth,
                     {"clusterId": cluster_id})["token"]
-
-    cacerts = request("GET", f"{base}/v3/settings/cacerts", auth)["value"]
-    checksum = hashlib.sha256(cacerts.encode()).hexdigest()
 
     json.dump({"cluster_id": cluster_id, "registration_token": token,
                "ca_checksum": checksum}, sys.stdout)

@@ -296,3 +296,126 @@ def test_import_manifest_endpoint(client):
     with pytest.raises(urllib.error.HTTPError) as exc:
         urllib.request.urlopen(req404, timeout=10)
     assert exc.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# TLS: the ca-checksum pin binding the actual wire (round-3 verdict #5).
+
+@pytest.fixture()
+def tls_server(tmp_path):
+    with ManagerServer("m1", state_path=str(tmp_path / "state.json"),
+                       tls=True) as s:
+        yield s
+
+
+def test_tls_server_serves_https_and_real_cert_as_cacerts(tls_server):
+    assert tls_server.url.startswith("https://")
+    c = ManagerClient(tls_server.url)
+    cacerts = c.cacerts()
+    # The cacerts body IS the TLS certificate that terminates connections.
+    assert "BEGIN CERTIFICATE" in cacerts
+    assert cacerts == tls_server.state.tls_cert
+    # And clusters pin its hash.
+    c.init_token(url=tls_server.url)
+    cluster = c.create_or_get_cluster("dev")
+    assert cluster["ca_checksum"] == \
+        hashlib.sha256(cacerts.encode()).hexdigest()
+
+
+def test_agent_joins_over_tls_with_correct_pin(tls_server, capsys):
+    client = ManagerClient(tls_server.url)
+    client.init_token(url=tls_server.url)
+    cluster = client.create_or_get_cluster("dev")
+    rc = agent_main(["--server", tls_server.url,
+                     "--token", cluster["registration_token"],
+                     "--ca-checksum", cluster["ca_checksum"],
+                     "--hostname", "host-1", "--worker", "--once"])
+    assert rc == 0
+    assert client.nodes(cluster["id"])[0]["hostname"] == "host-1"
+
+
+def test_agent_refuses_bad_pin_over_tls(tls_server, capsys):
+    client = ManagerClient(tls_server.url)
+    client.init_token(url=tls_server.url)
+    cluster = client.create_or_get_cluster("dev")
+    rc = agent_main(["--server", tls_server.url,
+                     "--token", cluster["registration_token"],
+                     "--ca-checksum", "e" * 64, "--once"])
+    assert rc == 1
+    assert "CA" in capsys.readouterr().err
+
+
+def test_pinned_client_rejects_wrong_certificate(tls_server):
+    """True pinning: a client anchored to a DIFFERENT cert cannot complete
+    the handshake — exactly what defeats a cacerts-relay MITM (which can
+    echo the real PEM but cannot terminate TLS for it)."""
+    from triton_kubernetes_tpu.manager.tls import mint_self_signed
+
+    other_cert, _ = mint_self_signed("mallory")
+    c = ManagerClient(tls_server.url, ca_pem=other_cert, retries=0)
+    with pytest.raises(ManagerClientError, match="unreachable"):
+        c.ping()
+
+
+def test_pin_ca_anchors_the_channel(tls_server):
+    c = ManagerClient(tls_server.url)
+    served = c.pin_ca(hashlib.sha256(
+        tls_server.state.tls_cert.encode()).hexdigest())
+    assert served == hashlib.sha256(
+        tls_server.state.tls_cert.encode()).hexdigest()
+    # Subsequent requests run on the pinned (CERT_REQUIRED) context.
+    assert c.ca_pem == tls_server.state.tls_cert
+    assert c.ping()["type"] == "apiRoot"
+
+
+def test_tls_identity_survives_restart(tmp_path):
+    path = str(tmp_path / "state.json")
+    with ManagerServer("m1", state_path=path, tls=True) as s:
+        cert1 = s.state.tls_cert
+        assert cert1
+    with ManagerServer("m1", state_path=path, tls=True) as s2:
+        # Same cert after restart: agents' pins stay valid.
+        assert s2.state.tls_cert == cert1
+
+
+def test_register_cluster_program_over_tls(tls_server):
+    """The terraform data.external program pins the served cert and runs
+    its API calls TLS-verified against it."""
+    script = f"{default_modules_root()}/files/register_cluster.py"
+    creds = ManagerClient(tls_server.url).init_token(url=tls_server.url)
+    query = json.dumps({
+        "manager_url": tls_server.url,
+        "access_key": creds["access_key"],
+        "secret_key": creds["secret_key"],
+        "cluster_name": "tpu-train",
+        "kind": "gke-tpu",
+    })
+    out = subprocess.run([sys.executable, script], input=query,
+                         capture_output=True, text=True, check=True)
+    r = json.loads(out.stdout)
+    assert r["ca_checksum"] == hashlib.sha256(
+        tls_server.state.tls_cert.encode()).hexdigest()
+
+
+def test_tls_upgrade_repins_existing_clusters(tmp_path):
+    """A plain-HTTP manager that upgrades to TLS must refresh every
+    existing cluster's ca_checksum to the real cert — stale stand-in pins
+    would lock all future agents out of pre-existing clusters."""
+    path = str(tmp_path / "state.json")
+    with ManagerServer("m1", state_path=path) as s:
+        c = ManagerClient(s.url)
+        c.init_token(url=s.url)
+        old = c.create_or_get_cluster("dev")["ca_checksum"]
+    with ManagerServer("m1", state_path=path, tls=True) as s2:
+        c2 = ManagerClient(s2.url)
+        c2.init_token()
+        cluster = c2.create_or_get_cluster("dev")
+        new = cluster["ca_checksum"]
+        assert new != old
+        assert new == hashlib.sha256(
+            s2.state.tls_cert.encode()).hexdigest()
+        # And an agent joins with the refreshed pin.
+        rc = agent_main(["--server", s2.url,
+                         "--token", cluster["registration_token"],
+                         "--ca-checksum", new, "--once"])
+        assert rc == 0

@@ -26,25 +26,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     serve = sub.add_parser("serve", help="run the control-plane server")
     serve.add_argument("--name", default="tk8s-manager")
     serve.add_argument("--host", default="0.0.0.0")
-    serve.add_argument("--port", type=int, default=80)
+    serve.add_argument("--port", type=int, default=None,
+                       help="default: 443 with TLS, 80 without")
     serve.add_argument("--state", default="/var/lib/tk8s/state.json",
                        help="JSON state file (persists credentials/clusters)")
+    serve.add_argument("--no-tls", action="store_true",
+                       help="serve plain HTTP (dev only; the agents' "
+                            "--ca-checksum pin then authenticates nothing "
+                            "on the wire)")
 
     tok = sub.add_parser("init-token",
                          help="create-or-get the admin API credentials")
     tok.add_argument("--url", default="",
                      help="public manager URL embedded in the output")
     tok.add_argument("--admin-password", default="")
-    tok.add_argument("--server", default="http://127.0.0.1:80",
+    tok.add_argument("--server", default="https://127.0.0.1:443",
                      help="loopback address of the running server")
     tok.add_argument("--json", action="store_true", dest="as_json")
 
     args = p.parse_args(argv)
 
     if args.command == "serve":
-        server = ManagerServer(args.name, host=args.host, port=args.port,
-                               state_path=args.state)
-        print(f"tk8s-manager {args.name!r} serving on "
+        tls = not args.no_tls
+        port = args.port if args.port is not None else (443 if tls else 80)
+        server = ManagerServer(args.name, host=args.host, port=port,
+                               state_path=args.state, tls=tls)
+        print(f"tk8s-manager {args.name!r} serving "
+              f"{'https' if tls else 'http'} on "
               f"{args.host}:{server.address[1]}", file=sys.stderr)
         try:
             server.serve_forever()

@@ -50,18 +50,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     client = ManagerClient(args.server)
     # CA pinning before anything else (install_rancher_agent contract): the
-    # server re-verifies on registration, but a clear client-side error
-    # beats a 403 when the operator pinned the wrong manager.
+    # pin gates registration AND — over HTTPS — re-anchors the client's SSL
+    # context to the served cert, so every later call proves the manager
+    # holds the pinned key (manager/tls.py trust model).
     if args.ca_checksum:
         try:
-            served = client.ca_checksum()
+            client.pin_ca(args.ca_checksum)
         except ManagerClientError as e:
-            print(f"tk8s-agent: cannot fetch cacerts: {e}", file=sys.stderr)
-            return 1
-        if served != args.ca_checksum:
-            print("tk8s-agent: CA checksum mismatch — refusing to register "
-                  f"(pinned {args.ca_checksum[:12]}..., "
-                  f"server {served[:12]}...)", file=sys.stderr)
+            print(f"tk8s-agent: CA pin failed — refusing to register: {e}",
+                  file=sys.stderr)
             return 1
 
     try:

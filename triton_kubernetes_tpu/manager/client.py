@@ -17,6 +17,7 @@ import json
 import ssl
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional
 
@@ -26,9 +27,10 @@ class ManagerClientError(RuntimeError):
 
 
 def _insecure_context() -> ssl.SSLContext:
-    # Self-signed manager certs are the norm (the reference curls with -k,
-    # register_cluster.py sets the same); trust is carried by the CA-checksum
-    # pin, not the web PKI.
+    # The un-pinned bootstrap context (the reference's curl -k): used only
+    # to fetch /v3/settings/cacerts before a pin exists. It authenticates
+    # nothing — call pin_ca() so every later request runs on a context
+    # that trusts exactly the pinned cert.
     ctx = ssl.create_default_context()
     ctx.check_hostname = False
     ctx.verify_mode = ssl.CERT_NONE
@@ -38,13 +40,46 @@ def _insecure_context() -> ssl.SSLContext:
 class ManagerClient:
     def __init__(self, url: str, access_key: str = "", secret_key: str = "",
                  retries: int = 3, backoff: float = 0.2,
-                 sleep=time.sleep):
+                 sleep=time.sleep, ca_pem: str = ""):
         self.url = url.rstrip("/")
         self.access_key = access_key
         self.secret_key = secret_key
         self.retries = retries
         self.backoff = backoff
         self._sleep = sleep
+        self.ca_pem = ca_pem
+        self._ctx_cache: Optional[ssl.SSLContext] = None
+        self._ctx_pem = ""
+
+    def _context(self) -> ssl.SSLContext:
+        if self.ca_pem:
+            if self._ctx_cache is None or self._ctx_pem != self.ca_pem:
+                from .tls import pinned_context
+
+                self._ctx_cache = pinned_context(self.ca_pem)
+                self._ctx_pem = self.ca_pem
+            return self._ctx_cache
+        return _insecure_context()
+
+    def pin_ca(self, ca_checksum: str) -> str:
+        """Checksum-bound trust bootstrap (install_rancher_agent.sh.tpl:35
+        contract, upgraded to actually bind the channel): fetch cacerts
+        un-verified, require sha256(PEM) == pin, then anchor every
+        subsequent request's SSL context to exactly that PEM. Returns the
+        served checksum. An MITM either presents its own cacerts (pin
+        mismatch here) or relays the real one (and then cannot complete
+        later handshakes without the manager's key)."""
+        served_pem = self.cacerts()
+        served = hashlib.sha256(served_pem.encode()).hexdigest()
+        if ca_checksum and served != ca_checksum:
+            raise ManagerClientError(
+                f"CA checksum mismatch: pinned {ca_checksum[:12]}..., "
+                f"server {served[:12]}...")
+        if self.url.startswith("https://"):
+            self.ca_pem = served_pem
+            # Holder-of-key proof: one request over the now-pinned context.
+            self.ping()
+        return served
 
     # ------------------------------------------------------------ transport
     def _request(self, method: str, path: str,
@@ -63,7 +98,7 @@ class ManagerClient:
                 method=method)
             try:
                 with urllib.request.urlopen(
-                        req, timeout=30, context=_insecure_context()) as resp:
+                        req, timeout=30, context=self._context()) as resp:
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as e:
                 detail = ""
@@ -100,7 +135,8 @@ class ManagerClient:
     def create_or_get_cluster(self, name: str, **attrs: Any) -> Dict[str, Any]:
         """The rancher_cluster.sh contract, typed: lookup by name first,
         create if absent — idempotent under retries by construction."""
-        found = self._request("GET", f"/v3/cluster?name={name}")["data"]
+        quoted = urllib.parse.quote(name, safe="")
+        found = self._request("GET", f"/v3/cluster?name={quoted}")["data"]
         if found:
             return found[0]
         return self._request("POST", "/v3/cluster", {"name": name, **attrs})

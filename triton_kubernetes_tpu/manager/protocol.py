@@ -51,11 +51,14 @@ def cacerts_pem(name: str, salt: str = "") -> str:
     )
 
 
-def ca_checksum(name: str, salt: str = "") -> str:
+def ca_checksum(name: str, salt: str = "",
+                cacerts: Optional[str] = None) -> str:
     """sha256 over the exact cacerts body — what agents pass as
     ``--ca-checksum`` and register_cluster emits (rancher_cluster.sh:94-97
-    analog)."""
-    return hashlib.sha256(cacerts_pem(name, salt).encode()).hexdigest()
+    analog). ``cacerts`` overrides the deterministic stand-in with the real
+    TLS certificate when the manager serves HTTPS (manager/tls.py)."""
+    body = cacerts if cacerts is not None else cacerts_pem(name, salt)
+    return hashlib.sha256(body.encode()).hexdigest()
 
 
 def cluster_id(manager_name: str, cluster_name: str) -> str:
@@ -64,13 +67,21 @@ def cluster_id(manager_name: str, cluster_name: str) -> str:
 
 def create_or_get_cluster(clusters: Dict[str, Dict[str, Any]],
                           manager_name: str, cluster_name: str,
-                          salt: str = "", **attrs: Any) -> Dict[str, Any]:
+                          salt: str = "", cacerts: Optional[str] = None,
+                          **attrs: Any) -> Dict[str, Any]:
     """Idempotent create-or-get by (manager, name) — rancher_cluster.sh:17-28
     contract. Existing records absorb attr updates (k8s_version bumps) but
-    keep identity, token, and nodes."""
+    keep identity, token, and nodes. ``cacerts`` is the served CA body the
+    checksum pins (the real TLS cert on HTTPS managers)."""
     for c in clusters.values():
         if c["manager"] == manager_name and c["name"] == cluster_name:
             c.update(attrs)
+            if cacerts is not None:
+                # The served CA can change legitimately (a plain-HTTP
+                # manager upgraded to TLS mints a real cert); the pin must
+                # track what /v3/settings/cacerts actually serves or every
+                # later agent join fails the checksum.
+                c["ca_checksum"] = ca_checksum(manager_name, salt, cacerts)
             return c
     cid = cluster_id(manager_name, cluster_name)
     cluster = {
@@ -78,7 +89,7 @@ def create_or_get_cluster(clusters: Dict[str, Dict[str, Any]],
         "name": cluster_name,
         "manager": manager_name,
         "registration_token": _h(cid, salt, "reg")[:40],
-        "ca_checksum": ca_checksum(manager_name, salt),
+        "ca_checksum": ca_checksum(manager_name, salt, cacerts),
         "nodes": {},
         **attrs,
     }
@@ -106,12 +117,15 @@ def register_node(clusters: Dict[str, Dict[str, Any]], token: str,
         if c["registration_token"] == token:
             if ca_checksum_pin and ca_checksum_pin != c["ca_checksum"]:
                 raise ProtocolError(f"CA checksum mismatch for {hostname}")
-            c["nodes"][hostname] = {
+            # Merge, don't replace: heartbeats re-register and must not wipe
+            # fields other writers own (e.g. the simulator's 'health' entry).
+            node = c["nodes"].setdefault(hostname, {})
+            node.update({
                 "hostname": hostname,
                 "roles": sorted(roles),
                 "labels": dict(labels or {}),
-            }
-            return c["nodes"][hostname]
+            })
+            return node
     raise ProtocolError(f"invalid registration token for {hostname}")
 
 

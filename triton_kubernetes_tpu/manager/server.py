@@ -62,6 +62,8 @@ class ManagerState:
         self.salt = ""
         self.credentials: Dict[str, str] = {}
         self.clusters: Dict[str, Dict[str, Any]] = {}
+        self.tls_cert = ""
+        self.tls_key = ""
         if path and os.path.isfile(path):
             with open(path) as f:
                 d = json.load(f)
@@ -70,6 +72,8 @@ class ManagerState:
             self.salt = d.get("salt", "")
             self.credentials = d.get("credentials", {})
             self.clusters = d.get("clusters", {})
+            self.tls_cert = d.get("tls_cert", "")
+            self.tls_key = d.get("tls_key", "")
         if not self.salt:
             # Random, persisted: every derived token/credential becomes
             # unpredictable while protocol.py itself stays deterministic.
@@ -81,11 +85,38 @@ class ManagerState:
             return
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump({"name": self.name, "url": self.url, "salt": self.salt,
                        "credentials": self.credentials,
-                       "clusters": self.clusters}, f, indent=2)
+                       "clusters": self.clusters,
+                       "tls_cert": self.tls_cert,
+                       "tls_key": self.tls_key}, f, indent=2)
         os.replace(tmp, self.path)
+
+    def ensure_tls(self) -> None:
+        """Mint-or-keep the manager's TLS identity (persisted: a restarted
+        container serves the same cert, so existing agent pins stay
+        valid). First mint re-pins every existing cluster's ca_checksum —
+        a manager upgraded from plain HTTP serves a different cacerts body
+        from then on, and stale pins would lock all agents out."""
+        with self.lock:
+            if not self.tls_cert:
+                from .tls import mint_self_signed
+
+                self.tls_cert, self.tls_key = mint_self_signed(self.name)
+                new_sum = protocol.ca_checksum(self.name, self.salt,
+                                               self.tls_cert)
+                for c in self.clusters.values():
+                    c["ca_checksum"] = new_sum
+                self._save_locked()
+
+    def cacerts(self) -> str:
+        """The body served at /v3/settings/cacerts and hashed into every
+        cluster's ca_checksum: the real TLS cert when serving HTTPS, else
+        the deterministic stand-in (plain-HTTP dev mode, where the pin
+        still gates registration but cannot bind the channel)."""
+        return self.tls_cert or protocol.cacerts_pem(self.name, self.salt)
 
     def init_token(self, url: str, admin_password: str = "") -> Dict[str, str]:
         """Create-or-get the admin API credentials (setup_rancher.sh.tpl
@@ -169,11 +200,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if url.path == "/v3/settings/cacerts":
                 # Public like Rancher's: agents verify their --ca-checksum
-                # pin against this before holding any credentials.
-                self._json(200, {
-                    "name": "cacerts",
-                    "value": protocol.cacerts_pem(self.state.name,
-                                                  self.state.salt)})
+                # pin against this before holding any credentials (and,
+                # over HTTPS, re-anchor their SSL context to it).
+                self._json(200, {"name": "cacerts",
+                                 "value": self.state.cacerts()})
                 return
             if not self._require_auth():
                 return
@@ -298,7 +328,8 @@ class _Handler(BaseHTTPRequestHandler):
                 with self.state.lock:
                     c = protocol.create_or_get_cluster(
                         self.state.clusters, self.state.name, d["name"],
-                        self.state.salt, **attrs)
+                        self.state.salt, cacerts=self.state.cacerts(),
+                        **attrs)
                     self.state._save_locked()
                 self._json(201, c)
             elif url.path == "/v3/clusterregistrationtoken":
@@ -341,11 +372,19 @@ class ManagerServer:
     tests; ``serve_forever`` under ``tk8s-admin serve`` in the image."""
 
     def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0,
-                 state_path: Optional[str] = None):
+                 state_path: Optional[str] = None, tls: bool = False):
         self.state = ManagerState(name, state_path)
+        self.tls = tls
         handler = type("Handler", (_Handler,), {"state": self.state})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
+        if tls:
+            from .tls import server_context
+
+            self.state.ensure_tls()
+            ctx = server_context(self.state.tls_cert, self.state.tls_key)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -355,7 +394,7 @@ class ManagerServer:
     @property
     def url(self) -> str:
         host, port = self.address
-        return f"http://{host}:{port}"
+        return f"{'https' if self.tls else 'http'}://{host}:{port}"
 
     def start(self) -> "ManagerServer":
         # Tight poll so embedded servers stop quickly (tests start dozens).
