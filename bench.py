@@ -75,7 +75,13 @@ def _child() -> None:
     mesh = create_mesh(MeshConfig(fsdp=1), devices=[device])
     opt = make_optimizer(warmup_steps=10, decay_steps=1000)
     state = init_state(config, mesh, opt)
-    step = make_train_step(config, mesh, opt)
+    # Resolve attention explicitly so kernel forfeits (dense-einsum
+    # fallbacks) are visible in the published metrics, not just as
+    # warnings on stderr.
+    from triton_kubernetes_tpu.train.trainer import _resolve_attention
+
+    attn = _resolve_attention(None, mesh)
+    step = make_train_step(config, mesh, opt, attention_fn=attn)
 
     gen = synthetic_batches(config.vocab_size, batch_size, seq_len)
     batches = [
@@ -133,6 +139,7 @@ def _child() -> None:
         "device": device.device_kind,
         "platform": device.platform,
         "loss": round(last_loss, 4),
+        "attention_forfeits": list(getattr(attn, "forfeits", [])),
         # BASELINE gate context: 40% MFU on Llama-3-8B @ v5p means this
         # many tokens/s/chip; this_chip_equiv is the same 40%-MFU bar for
         # the 8B model on the chip actually measured.

@@ -167,3 +167,69 @@ def test_checkpoint_roundtrip(tmp_path, cpu_mesh_devices):
         np.asarray(jax.device_get(state.params["embed"])))
     assert int(restored.step) == int(state.step)
     mgr.close()
+
+
+def test_flash_kernel_survives_kv_heads_below_tensor(cpu_mesh_devices,
+                                                     monkeypatch):
+    """hkv < tensor (llama3's hkv=4 on tensor=8) must NOT forfeit the
+    kernel: kv heads are repeated to the tensor degree (exact — repeat's
+    transpose group-sums dk/dv) and the shard-mapped kernel runs. Numerics
+    must match the dense path."""
+    from triton_kubernetes_tpu.ops.flash_attention import flash_attention
+    from triton_kubernetes_tpu.train import trainer
+
+    monkeypatch.setattr(
+        trainer, "auto_attention",
+        lambda platform=None: (
+            lambda q, k, v, positions: flash_attention(
+                q, k, v, 32, 32, interpret=True)))
+
+    cfg = get_config("llama-test")  # hq=4, hkv=2
+    mesh = create_mesh(MeshConfig(data=2, tensor=4))  # tensor > hkv
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    batch = next(synthetic_batches(cfg.vocab_size, 4, 32))
+    tokens = jnp.asarray(batch["tokens"])
+
+    attn = trainer._resolve_attention(None, mesh)
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt, attention_fn=attn)
+    state, metrics = step(state, {"tokens": tokens})
+    assert attn.forfeits == []  # the kernel ran; no dense fallback
+    flash_loss = float(metrics["loss"])
+
+    monkeypatch.setattr(trainer, "auto_attention", lambda platform=None: None)
+    state2 = init_state(cfg, mesh, opt)
+    step2 = make_train_step(cfg, mesh, opt)
+    state2, metrics2 = step2(state2, {"tokens": tokens})
+    np.testing.assert_allclose(flash_loss, float(metrics2["loss"]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_forfeit_is_loud(cpu_mesh_devices, monkeypatch):
+    """When no exact sharding exists (hq not divisible by tensor), the dense
+    fallback must warn and record the reason — never silently eat ~2x."""
+    import warnings as _warnings
+
+    from triton_kubernetes_tpu.ops.flash_attention import flash_attention
+    from triton_kubernetes_tpu.train import trainer
+
+    monkeypatch.setattr(
+        trainer, "auto_attention",
+        lambda platform=None: (
+            lambda q, k, v, positions: flash_attention(
+                q, k, v, 32, 32, interpret=True)))
+
+    cfg = get_config("llama-test")  # hq=4 -> tensor=8 cannot divide
+    mesh = create_mesh(MeshConfig(tensor=8))
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    batch = next(synthetic_batches(cfg.vocab_size, 4, 32))
+
+    attn = trainer._resolve_attention(None, mesh)
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt, attention_fn=attn)
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        state, metrics = step(state, {"tokens": jnp.asarray(batch["tokens"])})
+    assert attn.forfeits, "dense fallback must be recorded"
+    assert any("dense einsum" in str(w.message) for w in caught)
+    assert np.isfinite(float(metrics["loss"]))

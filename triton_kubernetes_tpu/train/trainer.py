@@ -17,6 +17,7 @@ State layout notes:
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.struct
@@ -97,13 +98,32 @@ def _resolve_attention(attention_fn, mesh: Mesh):
     tensor = mesh.shape[AXIS_TENSOR]
 
     def attn(q, k, v, positions):
-        # The per-shard view is only exact when the tensor axis divides
-        # every head count (e.g. llama3-bench hkv=4 on tensor=8 fails);
-        # those configs keep the einsum path, which GSPMD partitions fine.
+        # The per-shard view is exact only when the tensor axis divides
+        # every head count. GQA kv heads with hkv < tensor (llama3's hkv=4
+        # on a tensor=8 mesh — exactly the large-mesh configs where the
+        # kernel matters) are repeated up to `tensor` first: each shard then
+        # holds the one kv head its q-head group reads, the kernel's GQA
+        # grouping handles the (hq/tensor):1 ratio, and repeat's transpose
+        # group-sums dk/dv exactly. Remaining misfits fall back to the
+        # dense einsum — loudly, because the ~2x step-time cost would
+        # otherwise look like a mystery regression (round-3 verdict).
+        hq, hkv = q.shape[2], k.shape[2]
+        if hq % tensor == 0 and hkv % tensor != 0 and tensor % hkv == 0:
+            reps = tensor // hkv
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
         if q.shape[2] % tensor or k.shape[2] % tensor:
+            reason = (f"attention falls back to the dense einsum: head "
+                      f"counts (hq={hq}, hkv={hkv}) are not divisible by "
+                      f"the tensor axis ({tensor}) and kv heads cannot be "
+                      f"repeated to cover it; expect ~2x attention cost")
+            attn.forfeits.append(reason)
+            warnings.warn(reason, stacklevel=2)
             return llama._dense_attention(q, k, v, positions)
         return kernel(q, k, v)
 
+    # Trace-time record of every kernel forfeit, for bench/telemetry.
+    attn.forfeits = []
     return attn
 
 
