@@ -134,7 +134,8 @@ def test_options_and_roundtrip_helpers(plugin):
                              request_serializer=IDENT[0],
                              response_deserializer=IDENT[1])
     fields = {f: v for f, _, v in decode_fields(options(b""))}
-    assert fields == {1: 0, 2: 0}
+    # get_preferred_allocation_available advertised (field 2).
+    assert fields == {1: 0, 2: 1}
     # Encoder/decoder round-trips.
     assert parse_allocate_request(b"") == []
     lw = list_and_watch_response(["7"])
@@ -175,3 +176,92 @@ def test_reregisters_after_kubelet_restart(tmp_path):
     assert kubelet2.event.wait(5)
     p.stop()
     kubelet2.stop()
+
+
+def test_unhealthy_transition_on_vanished_device(tmp_path):
+    """Kill a chip's device node and observe the Unhealthy transition on a
+    live ListAndWatch stream — the health contract that makes the kubelet
+    stop scheduling onto a wedged chip (round-3 verdict #6)."""
+    dev_root = tmp_path / "dev"
+    dev_root.mkdir()
+    for i in range(4):
+        (dev_root / f"accel{i}").touch()
+    plugin_sock = str(tmp_path / "tk8s-tpu.sock")
+    p = DevicePluginServer(plugin_sock, str(tmp_path / "kubelet.sock"),
+                           watch_interval=0.1, dev_root=str(dev_root))
+    assert p.device_ids == ["0", "1", "2", "3"]
+    p.start()
+    try:
+        ch = _channel(p)
+        stream = ch.unary_stream("/v1beta1.DevicePlugin/ListAndWatch",
+                                 request_serializer=IDENT[0],
+                                 response_deserializer=IDENT[1])
+        it = stream(b"")
+
+        def health_of(resp):
+            return {
+                dict((f, v) for f, _, v in decode_fields(val))[1].decode():
+                dict((f, v) for f, _, v in decode_fields(val))[2].decode()
+                for field, _, val in decode_fields(resp) if field == 1}
+
+        assert health_of(next(it)) == {str(i): "Healthy" for i in range(4)}
+        os.unlink(dev_root / "accel2")  # chip 2 vanishes
+        deadline = 50
+        for _ in range(deadline):
+            h = health_of(next(it))
+            if h.get("2") == "Unhealthy":
+                break
+        else:
+            raise AssertionError("no Unhealthy transition observed")
+        # The other chips keep being advertised Healthy alongside.
+        assert h == {"0": "Healthy", "1": "Healthy",
+                     "2": "Unhealthy", "3": "Healthy"}
+        it.cancel()
+        ch.close()
+    finally:
+        p.stop()
+
+
+def test_get_preferred_allocation_is_ici_contiguous(plugin):
+    """GetPreferredAllocation picks ICI-adjacent chips on the host's 2x2
+    mesh instead of a diagonal straddle."""
+    from triton_kubernetes_tpu.manager.device_plugin import (
+        enc_msg, enc_str, enc_bool, _tag, _varint, preferred_chips)
+
+    p, _ = plugin
+    ch = _channel(p)
+    preferred = ch.unary_unary(
+        "/v1beta1.DevicePlugin/GetPreferredAllocation",
+        request_serializer=IDENT[0], response_deserializer=IDENT[1])
+    # One container: available {0,1,3}, size 2. 0-1 share an ICI link;
+    # 0-3 and 1-3... 1,3 are column-adjacent on the 2x2 grid (1=(0,1),
+    # 3=(1,1)), 0,1 row-adjacent; 0,3 is the diagonal (distance 2).
+    creq = (enc_str(1, "0") + enc_str(1, "1") + enc_str(1, "3")
+            + _tag(3, 0) + _varint(2))
+    resp = preferred(enc_msg(1, creq))
+    containers = [val for f, _, val in decode_fields(resp) if f == 1]
+    ids = sorted(v.decode() for f, _, v in decode_fields(containers[0])
+                 if f == 1)
+    assert ids in (["0", "1"], ["1", "3"])  # never the 0,3 diagonal
+
+    # Pure-function cases: must_include honored; full host = all chips.
+    assert preferred_chips(["0", "1", "2", "3"], ["3"], 2) in (
+        ["1", "3"], ["2", "3"])
+    assert preferred_chips(["0", "1", "2", "3"], [], 4) == \
+        ["0", "1", "2", "3"]
+    # 2x4 single-host v5e-8: ids 0..7, cols=4; {0,4} column pair beats
+    # {0,5} diagonal.
+    eight = [str(i) for i in range(8)]
+    got = preferred_chips(eight, ["0"], 2)
+    assert got in (["0", "1"], ["0", "4"])
+    ch.close()
+
+
+def test_preferred_chips_uses_host_chip_count_for_geometry():
+    """With high-id chips already allocated, the grid geometry must come
+    from the host's total chip count, not the max available id: on a 2x4
+    v5e-8 host, available {0,2,3} with size 2 must pick the truly adjacent
+    {2,3}, not the would-be-adjacent-on-2x2 {0,2}."""
+    from triton_kubernetes_tpu.manager.device_plugin import preferred_chips
+
+    assert preferred_chips(["0", "2", "3"], [], 2, n_total=8) == ["2", "3"]

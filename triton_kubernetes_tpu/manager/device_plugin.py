@@ -37,6 +37,7 @@ RESOURCE_NAME = "google.com/tpu"
 KUBELET_SOCKET = "/var/lib/kubelet/device-plugins/kubelet.sock"
 PLUGIN_SOCKET = "/var/lib/kubelet/device-plugins/tk8s-tpu.sock"
 HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
 
 
 # --------------------------------------------------------------- protobuf
@@ -111,16 +112,93 @@ def register_request(endpoint: str, resource: str = RESOURCE_NAME) -> bytes:
 
 
 def device_plugin_options() -> bytes:
-    return enc_bool(1, False) + enc_bool(2, False)
+    # pre_start_required=False, get_preferred_allocation_available=True —
+    # the kubelet only calls GetPreferredAllocation when advertised.
+    return enc_bool(1, False) + enc_bool(2, True)
 
 
 def list_and_watch_response(device_ids: List[str],
-                            health: str = HEALTHY) -> bytes:
+                            health: str = HEALTHY,
+                            health_map: Optional[Dict[str, str]] = None
+                            ) -> bytes:
     body = b""
     for did in device_ids:
-        dev = enc_str(1, did) + enc_str(2, health)
+        dev = enc_str(1, did) + enc_str(
+            2, (health_map or {}).get(did, health))
         body += enc_msg(1, dev)
     return body
+
+
+def parse_preferred_allocation_request(data: bytes) -> List[tuple]:
+    """PreferredAllocationRequest -> [(available_ids, must_include_ids,
+    size)] per container."""
+    out = []
+    for field, wt, val in decode_fields(data):
+        if field == 1 and wt == 2:
+            available, must, size = [], [], 0
+            for f, w, v in decode_fields(val):
+                if f == 1 and w == 2:
+                    available.append(v.decode())
+                elif f == 2 and w == 2:
+                    must.append(v.decode())
+                elif f == 3 and w == 0:
+                    size = v
+            out.append((available, must, size))
+    return out
+
+
+def preferred_allocation_response(per_container: List[List[str]]) -> bytes:
+    out = b""
+    for ids in per_container:
+        container = b""
+        for did in ids:
+            container += enc_str(1, did)
+        out += enc_msg(1, container)
+    return out
+
+
+def preferred_chips(available: List[str], must_include: List[str],
+                    size: int, n_total: Optional[int] = None) -> List[str]:
+    """ICI-contiguous chip choice for one host.
+
+    TPU hosts wire their local chips in a small 2D mesh (2x2 on 4-chip
+    ct5p/ct5lp hosts, 2x4 on single-host v5e-8). A multi-chip grant that
+    straddles that mesh non-contiguously pays extra ICI hops on every
+    collective, so prefer the subset minimizing total pairwise Manhattan
+    distance in grid coordinates (chip id -> (id // cols, id % cols)).
+    Host chip counts are tiny, so exact search over combinations is fine.
+    """
+    import itertools
+
+    if size <= 0 or size > len(available):
+        return []
+    must = [d for d in must_include if d in available]
+    rest = [d for d in available if d not in must]
+    if len(must) > size:
+        return []
+    if n_total is None:
+        # Fallback when the host's chip count isn't known (pure-function
+        # callers); the server always passes len(device_ids) — inferring
+        # from *available* ids alone guesses the wrong geometry once
+        # high-id chips are already allocated.
+        n_total = max((int(d) for d in available if d.isdigit()),
+                      default=0) + 1
+    cols = 2 if n_total <= 4 else 4
+
+    def coord(did: str) -> tuple:
+        i = int(did) if did.isdigit() else 0
+        return (i // cols, i % cols)
+
+    def score(combo) -> tuple:
+        pts = [coord(d) for d in combo]
+        dist = sum(abs(a[0] - b[0]) + abs(a[1] - b[1])
+                   for a, b in itertools.combinations(pts, 2))
+        return (dist, tuple(sorted(combo)))
+
+    best = min((tuple(must) + extra
+                for extra in itertools.combinations(rest, size - len(must))),
+               key=score)
+    return sorted(best)
 
 
 def parse_allocate_request(data: bytes) -> List[List[str]]:
@@ -177,15 +255,38 @@ class DevicePluginServer:
     def __init__(self, plugin_socket: str = PLUGIN_SOCKET,
                  kubelet_socket: str = KUBELET_SOCKET,
                  device_ids: Optional[List[str]] = None,
-                 watch_interval: float = 10.0):
+                 watch_interval: float = 10.0,
+                 dev_root: str = "/dev",
+                 health_probe=None):
         self.plugin_socket = plugin_socket
         self.kubelet_socket = kubelet_socket
         self.device_ids = (device_ids if device_ids is not None
-                           else enumerate_tpu_chips())
+                           else enumerate_tpu_chips(dev_root))
         self.watch_interval = watch_interval
+        self.dev_root = dev_root
+        # health_probe(device_id) -> bool; the default — when the plugin
+        # enumerated its chips from dev_root itself — is that the accel
+        # device node still exists (a vanished /dev/accel* is how a
+        # wedged/removed chip presents on GKE TPU hosts). The whole point
+        # of ListAndWatch is the Unhealthy transition: kubelet stops
+        # scheduling onto the chip and evicts pods holding it. Explicitly
+        # provided device_ids (tests, TPU_CHIP_COUNT) have no node to
+        # probe and stay Healthy unless a probe is given.
+        if health_probe is None and device_ids is None and \
+                not os.environ.get("TPU_CHIP_COUNT"):
+            health_probe = lambda did: os.path.exists(  # noqa: E731
+                os.path.join(self.dev_root, f"accel{did}"))
+        self._probe = health_probe
         self._stop = threading.Event()
         self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
         self.server.add_generic_rpc_handlers((self._handlers(),))
+
+    def health_map(self) -> Dict[str, str]:
+        """Current per-chip health."""
+        if self._probe is None:
+            return {did: HEALTHY for did in self.device_ids}
+        return {did: HEALTHY if self._probe(did) else UNHEALTHY
+                for did in self.device_ids}
 
     # ---- DevicePlugin service
     def _handlers(self):
@@ -193,14 +294,32 @@ class DevicePluginServer:
             return device_plugin_options()
 
         def list_and_watch(request: bytes, ctx) -> Iterator[bytes]:
-            # Initial inventory, then re-advertise on a heartbeat so a
-            # kubelet restart converges (health flips would go here too).
-            yield list_and_watch_response(self.device_ids)
-            while not self._stop.wait(self.watch_interval):
-                yield list_and_watch_response(self.device_ids)
+            # Initial inventory, then re-advertise whenever health changes
+            # (vanished /dev/accel* flips a chip Unhealthy) and on a slow
+            # heartbeat so a kubelet restart converges.
+            health = self.health_map()
+            yield list_and_watch_response(self.device_ids, health_map=health)
+            beats = 0
+            while not self._stop.wait(min(self.watch_interval, 1.0)):
+                beats += 1
+                current = self.health_map()
+                if current != health or \
+                        beats * min(self.watch_interval, 1.0) >= \
+                        self.watch_interval:
+                    health = current
+                    beats = 0
+                    yield list_and_watch_response(self.device_ids,
+                                                  health_map=health)
 
         def allocate(request: bytes, ctx) -> bytes:
             return allocate_response(parse_allocate_request(request))
+
+        def preferred(request: bytes, ctx) -> bytes:
+            return preferred_allocation_response([
+                preferred_chips(available, must, size,
+                                n_total=len(self.device_ids))
+                for available, must, size
+                in parse_preferred_allocation_request(request)])
 
         def empty(request: bytes, ctx) -> bytes:
             return b""
@@ -216,7 +335,7 @@ class DevicePluginServer:
             "PreStartContainer":
                 grpc.unary_unary_rpc_method_handler(empty, *_IDENT),
             "GetPreferredAllocation":
-                grpc.unary_unary_rpc_method_handler(empty, *_IDENT),
+                grpc.unary_unary_rpc_method_handler(preferred, *_IDENT),
         })
 
     # ---- lifecycle

@@ -176,9 +176,12 @@ class GcpTpuNodePool(Module):
                          ctx.cloud.get_manifests(cluster_id, "DaemonSet")]
                 for ds in names:
                     # Only what apply() installs — never an operator's own
-                    # tpu-* workloads.
-                    if ds.startswith(("tpu-jax-runtime-", "tpu-slice-health-",
-                                      "tpu-device-plugin")):
+                    # tpu-* workloads. Match both the variant scheme
+                    # (base-<suffix>) and the legacy fixed names from
+                    # pre-variant clusters destroyed without a re-apply.
+                    if any(ds == base or ds.startswith(base + "-")
+                           for base in ("tpu-jax-runtime", "tpu-slice-health",
+                                        "tpu-device-plugin")):
                         ctx.cloud.delete_manifest(cluster_id, "DaemonSet", ds)
         super().destroy(applied, ctx)
 

@@ -29,15 +29,34 @@ def get_cluster(ctx: WorkflowContext) -> Dict[str, Any]:
     health = _node_health(ctx, state, outputs.get("cluster_id"))
     if health is not None:
         outputs = {**outputs, "node_health": health}
+        # Consume NotReady (round-3 verdict #9): dead hosts surface with a
+        # concrete recovery action instead of sitting in a listing nobody
+        # reads. The reference's agents ride `--restart=unless-stopped` +
+        # Rancher reconciliation; a host that stays NotReady past the
+        # heartbeat window needs replacement.
+        dead = sorted(h for h, st in health.items() if not st.get("ready"))
+        if dead:
+            outputs["unhealthy_nodes"] = dead
+            outputs["hint"] = (
+                "node(s) not ready — replace with: destroy node "
+                "(--set node=<hostname>) then create node; agent details: "
+                + "; ".join(f"{h}: {health[h].get('reason') or 'NotReady'}"
+                            for h in dead))
     return outputs
 
 
 def _node_health(ctx: WorkflowContext, state, cluster_id) -> Any:
-    """Best-effort live node health for the `get cluster` read (SURVEY.md
-    §5 failure-detection obligation): real kubelet conditions when the
-    doc's driver is real and its binaries are present, the recorded agent
-    health otherwise, nothing if the executor has no cloud view."""
-    if not cluster_id or not hasattr(ctx.executor, "cloud_view"):
+    """Best-effort node health for the `get cluster` read (SURVEY.md §5
+    failure-detection obligation), in trust order: the live tk8s-manager
+    nodes listing (heartbeat-driven NotReady, manager/server.py), real
+    kubelet conditions when the doc's driver is real and its binaries are
+    present, the simulator's recorded agent health otherwise."""
+    if not cluster_id:
+        return None
+    live = _live_manager_health(ctx, state, cluster_id)
+    if live is not None:
+        return live
+    if not hasattr(ctx.executor, "cloud_view"):
         return None
     view = ctx.executor.cloud_view(state)
     try:
@@ -50,3 +69,36 @@ def _node_health(ctx: WorkflowContext, state, cluster_id) -> Any:
             return view.node_health(cluster_id)
         except Exception:
             return None
+
+
+def _live_manager_health(ctx: WorkflowContext, state,
+                         cluster_id) -> Any:
+    """GET /v3/clusters/<id>/nodes against the real control plane when the
+    manager module's applied outputs carry a reachable URL + credentials;
+    None (fall through) otherwise. This is the consumer of the server's
+    heartbeat-staleness NotReady flip."""
+    try:
+        mgr = ctx.executor.output(state, MANAGER_KEY)
+    except Exception:
+        return None
+    url = mgr.get("manager_url", "")
+    if not url.startswith(("http://", "https://")):
+        return None
+    try:
+        from ..manager.client import ManagerClient
+
+        client = ManagerClient(url, mgr.get("manager_access_key", ""),
+                               mgr.get("manager_secret_key", ""), retries=0)
+        nodes = client.nodes(cluster_id)
+    except Exception:
+        return None
+    if not nodes:
+        # Hosted clusters (GKE/AKS) never run tk8s agents — an empty
+        # listing is "no data", not "no nodes"; fall through to the
+        # driver/kubelet view.
+        return None
+    return {n["hostname"]: {"ready": n.get("state") != "NotReady",
+                            "reason": ("stale agent heartbeat"
+                                       if n.get("state") == "NotReady"
+                                       else "")}
+            for n in nodes}
