@@ -136,7 +136,8 @@ def test_static_catalog_and_make_catalog():
     cfg = Config()
     assert isinstance(make_catalog(cfg), Catalog)
     cfg.set("catalog", "live")
-    assert isinstance(make_catalog(cfg), LiveGcpCatalog)
+    live = make_catalog(cfg)
+    assert any(isinstance(c, LiveGcpCatalog) for c in live.catalogs)
     cfg.set("catalog", "nope")
     with pytest.raises(ValidationError):
         make_catalog(cfg)
@@ -147,3 +148,138 @@ def test_tpu_regions_not_answered_by_generic_lookup(gcp_api):
     live catalog must decline 'gcp-tpu'/'regions' so the static
     TPU-capable list keeps enforcing the constraint."""
     assert _live(gcp_api).choices("gcp-tpu", "regions") is None
+
+
+# ---------------------------------------------------------------------------
+# Azure: ARM REST against a fake server (reference create/manager_azure.go
+# :23-578, cluster_aks.go orchestrators).
+
+class FakeAzureApi(BaseHTTPRequestHandler):
+    subscriptions = ["sub-aaaa", "sub-bbbb"]
+    locations = ["West US 2", "East US", "Made Up West"]
+    vm_sizes = ["Standard_D2s_v3", "Standard_NC6", "Standard_Fake_v9"]
+    aks_versions = ["1.31.2", "1.30.7"]
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(url.query))
+        path = url.path
+        base = f"http://{self.headers['Host']}"
+
+        def paged(values):
+            # One-item nextLink pages so ARM pagination really executes.
+            start = int(q.get("skip") or 0)
+            out = {"value": values[start:start + 1]}
+            if start + 1 < len(values):
+                sep = "&" if "?" in self.path else "?"
+                nxt = self.path.split("skip=")[0].rstrip("?&")
+                out["nextLink"] = f"{base}{nxt}{sep}skip={start + 1}"
+            return out
+
+        if path == "/subscriptions":
+            self._json(paged([{"subscriptionId": s, "displayName": s}
+                              for s in self.subscriptions]))
+        elif path.endswith("/locations"):
+            self._json(paged([{"name": n.replace(" ", "").lower(),
+                               "displayName": n} for n in self.locations]))
+        elif path.endswith("/vmSizes"):
+            assert "/locations/madeupwest/" in path or \
+                "/locations/westus2/" in path or "/locations/eastus" in path
+            self._json(paged([{"name": s} for s in self.vm_sizes]))
+        elif path.endswith("/orchestrators"):
+            self._json({"properties": {"orchestrators": [
+                {"orchestratorVersion": v} for v in self.aks_versions]}})
+        else:
+            self._json({"value": []})
+
+
+@pytest.fixture()
+def azure_api():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeAzureApi)
+    t = threading.Thread(
+        target=lambda: httpd.serve_forever(poll_interval=0.05), daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _live_azure(azure_api):
+    from triton_kubernetes_tpu.catalogs.azure import LiveAzureCatalog
+
+    return LiveAzureCatalog(subscription_id="sub-aaaa",
+                            management_endpoint=azure_api)
+
+
+def test_azure_live_lookups_and_pagination(azure_api):
+    cat = _live_azure(azure_api)
+    assert cat.subscriptions() == FakeAzureApi.subscriptions
+    assert cat.locations() == FakeAzureApi.locations  # nextLink pages
+    assert cat.vm_sizes("West US 2") == FakeAzureApi.vm_sizes
+    assert cat.k8s_versions("East US") == FakeAzureApi.aks_versions
+
+
+def test_azure_choices_seam_and_degradation(azure_api):
+    from triton_kubernetes_tpu.catalogs.azure import LiveAzureCatalog
+
+    cat = _live_azure(azure_api)
+    assert cat.choices("azure", "locations") == FakeAzureApi.locations
+    assert cat.choices("azure", "vm_sizes",
+                       {"location": "Made Up West"}) == FakeAzureApi.vm_sizes
+    assert cat.choices("aks", "k8s_versions",
+                       {"location": "East US"}) == FakeAzureApi.aks_versions
+    # Location-scoped kinds without a location degrade to static (node
+    # flows collect no location — it arrives via interpolation).
+    assert cat.choices("azure", "vm_sizes") is None
+    assert cat.choices("aks", "k8s_versions") is None
+    assert cat.choices("gcp", "regions") is None  # not this catalog's cloud
+    dead = LiveAzureCatalog(subscription_id="s",
+                            management_endpoint="http://127.0.0.1:9")
+    assert dead.choices("azure", "locations") is None
+
+
+def test_azure_workflow_validates_against_live_catalog(azure_api):
+    """create manager (azure) accepts a location only the live API knows
+    and rejects one neither the API nor the static list has — catalog:
+    live now validates azure prompts (round-3 verdict #7)."""
+    def run(location):
+        cfg = Config()
+        for k, v in {"manager_cloud_provider": "azure", "name": "m1",
+                     "azure_subscription_id": "sub-aaaa",
+                     "azure_client_id": "cid", "azure_client_secret": "cs",
+                     "azure_tenant_id": "tid",
+                     "azure_location": location,
+                     "azure_size": "Standard_Fake_v9"}.items():
+            cfg.set(k, v)
+        ctx = WorkflowContext(
+            backend=MemoryBackend(),
+            executor=LocalExecutor(log=lambda m: None),
+            resolver=InputResolver(cfg, None, True),
+            catalog=_live_azure(azure_api))
+        return new_manager(ctx)
+
+    # "Made Up West" exists only in the live API; Standard_Fake_v9 too.
+    assert run("Made Up West") == "m1"
+    with pytest.raises(ValidationError, match="not a valid choice"):
+        run("Atlantis North")
+
+
+def test_make_catalog_live_is_composite():
+    from triton_kubernetes_tpu.catalogs import CompositeCatalog
+
+    cfg = Config()
+    cfg.set("catalog", "live")
+    cat = make_catalog(cfg)
+    assert isinstance(cat, CompositeCatalog)
+    assert len(cat.catalogs) == 2

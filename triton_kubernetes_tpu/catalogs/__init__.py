@@ -39,12 +39,27 @@ class StaticCatalog(Catalog):
         return self.data.get(f"{provider}:{kind}")
 
 
+class CompositeCatalog(Catalog):
+    """First catalog with an opinion wins; each live catalog already
+    limits itself to its own cloud's providers."""
+
+    def __init__(self, catalogs: List[Catalog]):
+        self.catalogs = list(catalogs)
+
+    def choices(self, provider, kind, context=None):
+        for cat in self.catalogs:
+            got = cat.choices(provider, kind, context)
+            if got is not None:
+                return got
+        return None
+
+
 def make_catalog(config) -> Catalog:
     """Build the catalog the ``catalog:`` config key names.
 
     ``static`` (default) keeps the workflows' built-in lists; ``live``
-    returns SDK-backed catalogs where implemented (GCP today; other
-    providers fall back to static per-call).
+    returns SDK-backed catalogs where implemented (GCP + Azure today;
+    other providers fall back to static per-call).
     """
     from ..config import ValidationError
 
@@ -52,14 +67,25 @@ def make_catalog(config) -> Catalog:
     if kind == "static":
         return Catalog()
     if kind == "live":
+        from .azure import LiveAzureCatalog
         from .gcp import LiveGcpCatalog
 
-        return LiveGcpCatalog(
-            credentials_path=str(config.get("gcp_path_to_credentials") or ""),
-            project=str(config.get("gcp_project_id") or ""),
-        )
+        return CompositeCatalog([
+            LiveGcpCatalog(
+                credentials_path=str(
+                    config.get("gcp_path_to_credentials") or ""),
+                project=str(config.get("gcp_project_id") or ""),
+            ),
+            LiveAzureCatalog(
+                subscription_id=str(
+                    config.get("azure_subscription_id") or ""),
+                tenant_id=str(config.get("azure_tenant_id") or ""),
+                client_id=str(config.get("azure_client_id") or ""),
+                client_secret=str(config.get("azure_client_secret") or ""),
+            ),
+        ])
     raise ValidationError(
         f"catalog: {kind!r} is not a valid choice (valid: ['static', 'live'])")
 
 
-__all__ = ["Catalog", "StaticCatalog", "make_catalog"]
+__all__ = ["Catalog", "CompositeCatalog", "StaticCatalog", "make_catalog"]

@@ -1,0 +1,159 @@
+"""Live Azure catalog: ARM REST behind the Catalog seam.
+
+Reference analog: create/manager_azure.go:23-578 (subscriptions /
+locations / VM sizes via the Azure SDK) and create/cluster_aks.go:27-522
+(AKS orchestrator versions). Stdlib HTTP with the OAuth2 client-credentials
+grant — no cloud SDK import; the service principal fields are exactly the
+ones the workflows already collect (azure_subscription_id / client_id /
+client_secret / tenant_id). ``endpoint`` overrides route to a fake server
+in tests so every request/parse path executes for real.
+
+Lookups degrade gracefully: any HTTP/auth failure returns ``None`` (the
+workflow's static list takes over) rather than blocking an interactive
+session on a flaky API.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from . import Catalog
+
+MANAGEMENT = "https://management.azure.com"
+LOGIN = "https://login.microsoftonline.com"
+API_VERSION = "2022-12-01"
+COMPUTE_API_VERSION = "2024-07-01"
+AKS_API_VERSION = "2019-08-01"
+
+
+class LiveAzureCatalog(Catalog):
+    def __init__(self, subscription_id: str = "", tenant_id: str = "",
+                 client_id: str = "", client_secret: str = "",
+                 management_endpoint: str = "", login_endpoint: str = "",
+                 authenticated: Optional[bool] = None):
+        self.subscription_id = subscription_id
+        self.tenant_id = tenant_id
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.management = (management_endpoint or MANAGEMENT).rstrip("/")
+        self.login = (login_endpoint or LOGIN).rstrip("/")
+        # Fake servers in tests take no auth.
+        self.authenticated = (not management_endpoint
+                              if authenticated is None else authenticated)
+        self._token: Optional[str] = None
+        self._token_expiry = 0.0
+
+    # ------------------------------------------------------------- plumbing
+    def _access_token(self) -> Optional[str]:
+        if not self.authenticated:
+            return None
+        if self._token and time.time() < self._token_expiry - 60:
+            return self._token
+        body = urllib.parse.urlencode({
+            "grant_type": "client_credentials",
+            "client_id": self.client_id,
+            "client_secret": self.client_secret,
+            "scope": f"{MANAGEMENT}/.default",
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.login}/{self.tenant_id}/oauth2/v2.0/token", data=body,
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            tok = json.load(resp)
+        self._token = tok["access_token"]
+        self._token_expiry = time.time() + int(tok.get("expires_in", 3600))
+        return self._token
+
+    def _get(self, url: str) -> Dict[str, Any]:
+        headers = {}
+        token = self._access_token()
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.load(resp)
+
+    def _list_values(self, url: str) -> List[Dict[str, Any]]:
+        """ARM paginated list -> concatenated ``value`` items
+        (``nextLink`` pagination)."""
+        items: List[Dict[str, Any]] = []
+        while url:
+            body = self._get(url)
+            items += body.get("value", [])
+            url = body.get("nextLink") or ""
+        return items
+
+    @staticmethod
+    def _short_location(location: str) -> str:
+        """'West US 2' (display name, what the prompts collect) ->
+        'westus2' (the ARM URL segment)."""
+        return location.replace(" ", "").lower()
+
+    # -------------------------------------------------------------- lookups
+    def subscriptions(self) -> List[str]:
+        return [s["subscriptionId"] for s in self._list_values(
+            f"{self.management}/subscriptions?api-version={API_VERSION}")]
+
+    def locations(self) -> List[str]:
+        return [loc.get("displayName") or loc["name"]
+                for loc in self._list_values(
+                    f"{self.management}/subscriptions/"
+                    f"{self.subscription_id}/locations"
+                    f"?api-version={API_VERSION}")]
+
+    def vm_sizes(self, location: str) -> List[str]:
+        return [s["name"] for s in self._list_values(
+            f"{self.management}/subscriptions/{self.subscription_id}"
+            f"/providers/Microsoft.Compute/locations/"
+            f"{self._short_location(location)}/vmSizes"
+            f"?api-version={COMPUTE_API_VERSION}")]
+
+    def k8s_versions(self, location: str) -> List[str]:
+        """AKS orchestrator versions (cluster_aks.go analog)."""
+        body = self._get(
+            f"{self.management}/subscriptions/{self.subscription_id}"
+            f"/providers/Microsoft.ContainerService/locations/"
+            f"{self._short_location(location)}/orchestrators"
+            f"?api-version={AKS_API_VERSION}"
+            "&resource-type=managedClusters")
+        orchestrators = (body.get("properties") or {}).get(
+            "orchestrators", [])
+        return [o["orchestratorVersion"] for o in orchestrators
+                if o.get("orchestratorVersion")]
+
+    # ---------------------------------------------------------- Catalog API
+    def choices(self, provider, kind, context=None):
+        context = context or {}
+        if provider not in ("azure", "aks"):
+            return None
+        # Workflow-supplied service-principal fields (from the prompt flow)
+        # win over construction-time values.
+        for attr, key in (("subscription_id", "azure_subscription_id"),
+                          ("tenant_id", "azure_tenant_id"),
+                          ("client_id", "azure_client_id"),
+                          ("client_secret", "azure_client_secret")):
+            if context.get(key) and getattr(self, attr) != context[key]:
+                setattr(self, attr, context[key])
+                self._token = None
+        try:
+            if kind == "subscriptions":
+                return self.subscriptions() or None
+            if kind == "locations":
+                return self.locations() or None
+            # Location-scoped lookups need a real location: answering for
+            # a hardcoded region would validate prompts against the wrong
+            # market (node flows deliberately collect no location — it
+            # arrives via cluster-module interpolation — so they keep
+            # their static fallback).
+            if kind == "vm_sizes" and context.get("location"):
+                return self.vm_sizes(context["location"]) or None
+            if kind == "k8s_versions" and context.get("location"):
+                return self.k8s_versions(context["location"]) or None
+        except (urllib.error.URLError, OSError, ValueError, KeyError):
+            return None  # degrade to the static list
+        return None

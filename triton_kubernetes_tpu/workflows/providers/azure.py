@@ -26,10 +26,20 @@ def _creds(ctx: WorkflowContext, with_location: bool = True) -> dict:
         "azure_tenant_id": r.value("azure_tenant_id", "Azure Tenant ID"),
     }
     if with_location:
+        locations = ctx.choices("azure", "locations", LOCATIONS, cfg)
         cfg["azure_location"] = r.choose(
             "azure_location", "Azure Location",
-            [(x, x) for x in LOCATIONS], default=LOCATIONS[0])
+            [(x, x) for x in locations], default=locations[0])
     return cfg
+
+
+def _vm_sizes(ctx: WorkflowContext, creds: dict) -> list:
+    """Live VM sizes when `catalog: live` (create/manager_azure.go's
+    validated size prompt), static fallback otherwise."""
+    context = dict(creds)
+    if creds.get("azure_location"):
+        context["location"] = creds["azure_location"]
+    return ctx.choices("azure", "vm_sizes", VM_SIZES, context)
 
 
 def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> None:
@@ -47,8 +57,9 @@ def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> Non
     else:
         cfg = base_manager_config(ctx, "azure-manager", name)
         cfg.update(_creds(ctx))
+    sizes = _vm_sizes(ctx, cfg)
     cfg["azure_size"] = r.choose("azure_size", "Azure VM Size",
-                                 [(s, s) for s in VM_SIZES], default=VM_SIZES[0])
+                                 [(s, s) for s in sizes], default=sizes[0])
     cfg["azure_public_key_path"] = r.value(
         "azure_public_key_path", "Azure Public Key Path",
         default="~/.ssh/id_rsa.pub")
@@ -69,8 +80,9 @@ def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
     # (azure_location interpolation below) — prompting would discard the
     # answer.
     cfg.update(_creds(ctx, with_location=False))
+    sizes = _vm_sizes(ctx, cfg)
     cfg["azure_size"] = r.choose("azure_size", "Azure VM Size",
-                                 [(s, s) for s in VM_SIZES], default=VM_SIZES[0])
+                                 [(s, s) for s in sizes], default=sizes[0])
     cfg["azure_subnet_id"] = f"${{module.{cluster_key}.azure_subnet_id}}"
     # Real-path placement: hosts land in the cluster's resource group and
     # location (the azure-k8s HCL module exports both).
@@ -102,14 +114,26 @@ def aks_cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) ->
         "manager_access_key": "${module.cluster-manager.manager_access_key}",
         "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
         **_creds(ctx),
+    }
+    sizes = _vm_sizes(ctx, cfg)
+    versions = ctx.choices(
+        "aks", "k8s_versions", [],
+        {**cfg, "location": cfg.get("azure_location", "")})
+    cfg.update({
         "azure_size": r.choose("azure_size", "Azure VM Size",
-                               [(s, s) for s in VM_SIZES], default=VM_SIZES[0]),
+                               [(s, s) for s in sizes], default=sizes[0]),
         "azure_ssh_user": r.value("azure_ssh_user", "Azure SSH User",
                                   default="azureuser"),
         "azure_public_key_path": r.value("azure_public_key_path",
                                          "Azure Public Key Path",
                                          default="~/.ssh/id_rsa.pub"),
-        "k8s_version": r.value("k8s_version", "Kubernetes Version", default="1.31"),
+        # Validated against live AKS orchestrator versions when the
+        # catalog has them (cluster_aks.go analog), free-form otherwise.
+        "k8s_version": (r.choose("k8s_version", "Kubernetes Version",
+                                 [(v, v) for v in versions],
+                                 default=versions[0]) if versions
+                        else r.value("k8s_version", "Kubernetes Version",
+                                     default="1.31")),
         "node_count": int(r.value("node_count", "Node Count", default=3)),
-    }
+    })
     return state.add_cluster("aks", name, cfg)
