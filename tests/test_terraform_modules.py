@@ -28,7 +28,8 @@ HCL_MODULES = [
     "azure-manager", "azure-rke-manager", "azure-k8s", "azure-k8s-host",
     "gcp-k8s", "gcp-k8s-host", "gke-k8s", "aks-k8s",
     "vsphere-k8s", "vsphere-k8s-host",
-    "k8s-backup-gcs", "k8s-backup-s3",
+    "triton-manager", "triton-k8s", "triton-k8s-host",
+    "k8s-backup-gcs", "k8s-backup-s3", "k8s-backup-manta",
 ]
 
 

@@ -2,7 +2,9 @@
 
 Reference analog: modules/triton-rancher (triton_machine with CNS + role
 anti-affinity, main.tf:20-38), modules/triton-rancher-k8s (API only, 15 LoC),
-modules/triton-rancher-k8s-host.
+modules/triton-rancher-k8s-host. HCL twins exist for the real path
+(terraform/modules/triton-*, targeting the archived joyent/triton
+provider for private Triton installations).
 """
 
 from __future__ import annotations
