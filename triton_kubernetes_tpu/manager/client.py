@@ -40,7 +40,7 @@ def _insecure_context() -> ssl.SSLContext:
 class ManagerClient:
     def __init__(self, url: str, access_key: str = "", secret_key: str = "",
                  retries: int = 3, backoff: float = 0.2,
-                 sleep=time.sleep, ca_pem: str = ""):
+                 sleep=time.sleep, ca_pem: str = "", timeout: float = 30.0):
         self.url = url.rstrip("/")
         self.access_key = access_key
         self.secret_key = secret_key
@@ -48,6 +48,7 @@ class ManagerClient:
         self.backoff = backoff
         self._sleep = sleep
         self.ca_pem = ca_pem
+        self.timeout = timeout
         self._ctx_cache: Optional[ssl.SSLContext] = None
         self._ctx_pem = ""
 
@@ -98,7 +99,8 @@ class ManagerClient:
                 method=method)
             try:
                 with urllib.request.urlopen(
-                        req, timeout=30, context=self._context()) as resp:
+                        req, timeout=self.timeout,
+                        context=self._context()) as resp:
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as e:
                 detail = ""
