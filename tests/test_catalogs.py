@@ -282,4 +282,173 @@ def test_make_catalog_live_is_composite():
     cfg.set("catalog", "live")
     cat = make_catalog(cfg)
     assert isinstance(cat, CompositeCatalog)
-    assert len(cat.catalogs) == 2
+    assert len(cat.catalogs) == 3
+
+
+# ---------------------------------------------------------------------------
+# Triton: CloudAPI REST against a fake server (reference
+# create/manager_triton.go:352-396), including real http-signature auth.
+
+class FakeTritonApi(BaseHTTPRequestHandler):
+    networks = ["Joyent-SDC-Public", "Joyent-SDC-Private", "my-fabric"]
+    images = ["ubuntu-certified-16.04", "ubuntu-certified-22.04",
+              "made-up-linux"]
+    packages = ["k4-highcpu-kvm-1.75G", "g4-fake-64G"]
+    require_signature = False
+    public_key = None  # set by the auth test
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload, code=200):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.require_signature:
+            import base64 as b64
+
+            from cryptography.hazmat.primitives import hashes
+            from cryptography.hazmat.primitives.asymmetric import padding
+            auth = self.headers.get("Authorization", "")
+            date = self.headers.get("Date", "")
+            try:
+                sig = b64.b64decode(
+                    auth.split('signature="')[1].rstrip('"'))
+                self.public_key.verify(sig, f"date: {date}".encode(),
+                                       padding.PKCS1v15(), hashes.SHA256())
+            except Exception:
+                self._json({"code": "InvalidSignature"}, code=401)
+                return
+        path = urllib.parse.urlparse(self.path).path
+        if path.endswith("/networks"):
+            self._json([{"name": n} for n in self.networks])
+        elif path.endswith("/images"):
+            self._json([{"name": i, "state": "active"}
+                        for i in self.images])
+        elif path.endswith("/packages"):
+            self._json([{"name": p} for p in self.packages])
+        else:
+            self._json([], code=404)
+
+
+@pytest.fixture()
+def triton_api():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeTritonApi)
+    t = threading.Thread(
+        target=lambda: httpd.serve_forever(poll_interval=0.05), daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_triton_live_lookups(triton_api):
+    from triton_kubernetes_tpu.catalogs.triton import LiveTritonCatalog
+
+    cat = LiveTritonCatalog(account="acc", url=triton_api)
+    assert cat.networks() == FakeTritonApi.networks
+    assert cat.images() == sorted(set(FakeTritonApi.images))
+    assert cat.packages() == sorted(FakeTritonApi.packages)
+    assert cat.choices("triton", "packages") == sorted(
+        FakeTritonApi.packages)
+    assert cat.choices("gcp", "regions") is None
+    dead = LiveTritonCatalog(account="acc", url="http://127.0.0.1:9")
+    assert dead.choices("triton", "networks") is None
+
+
+def test_triton_http_signature_auth(triton_api, tmp_path, monkeypatch):
+    """The Date-header http-signature CloudAPI expects, verified by the
+    fake server against the real public key."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    from triton_kubernetes_tpu.catalogs.triton import LiveTritonCatalog
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    key_path = tmp_path / "id_rsa"
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    monkeypatch.setattr(FakeTritonApi, "require_signature", True)
+    monkeypatch.setattr(FakeTritonApi, "public_key", key.public_key())
+
+    cat = LiveTritonCatalog(account="acc", key_path=str(key_path),
+                            key_id="ab:cd", url=triton_api,
+                            authenticated=True)
+    assert cat.networks() == FakeTritonApi.networks
+    # A different key fails verification -> graceful degradation.
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    key_path.write_bytes(other.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    monkeypatch.setattr(FakeTritonApi, "public_key", key.public_key())
+    assert cat.choices("triton", "networks") is None
+
+
+def test_triton_workflow_validates_against_live_catalog(triton_api):
+    """create manager (triton) accepts a package only the live API knows
+    and rejects one neither the API nor the static list has."""
+    def run(package):
+        cfg = Config()
+        for k, v in {"manager_cloud_provider": "triton", "name": "m1",
+                     "triton_account": "acc", "triton_key_path": "/dev/null",
+                     "triton_key_id": "ab:cd", "triton_url": triton_api,
+                     "master_triton_machine_package": package}.items():
+            cfg.set(k, v)
+        from triton_kubernetes_tpu.catalogs.triton import LiveTritonCatalog
+
+        ctx = WorkflowContext(
+            backend=MemoryBackend(),
+            executor=LocalExecutor(log=lambda m: None),
+            resolver=InputResolver(cfg, None, True),
+            catalog=LiveTritonCatalog(authenticated=False))
+        return new_manager(ctx)
+
+    assert run("g4-fake-64G") == "m1"
+    with pytest.raises(ValidationError, match="not a valid choice"):
+        run("k999-nonexistent")
+
+
+def test_triton_signature_with_openssh_and_ed25519_keys(triton_api, tmp_path,
+                                                        monkeypatch):
+    """ssh-keygen's default key file format (OpenSSH) and non-RSA key
+    types must work — or at worst degrade gracefully, never crash."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519, rsa
+
+    from triton_kubernetes_tpu.catalogs.triton import (
+        LiveTritonCatalog, sign_date_header)
+
+    # RSA key in OpenSSH container format (BEGIN OPENSSH PRIVATE KEY).
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    key_path = tmp_path / "id_rsa_openssh"
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.OpenSSH,
+        serialization.NoEncryption()))
+    assert b"OPENSSH PRIVATE KEY" in key_path.read_bytes()
+    monkeypatch.setattr(FakeTritonApi, "require_signature", True)
+    monkeypatch.setattr(FakeTritonApi, "public_key", key.public_key())
+    cat = LiveTritonCatalog(account="acc", key_path=str(key_path),
+                            key_id="ab:cd", url=triton_api,
+                            authenticated=True)
+    assert cat.networks() == FakeTritonApi.networks
+
+    # Ed25519: signs with the ed25519 algorithm tag (no crash), and a
+    # server that can't verify it degrades to the static fallback.
+    ekey = ed25519.Ed25519PrivateKey.generate()
+    epath = tmp_path / "id_ed25519"
+    epath.write_bytes(ekey.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    hdr = sign_date_header(str(epath), "ab:cd", "acc",
+                           "Thu, 30 Jul 2026 00:00:00 GMT")
+    assert 'algorithm="ed25519"' in hdr
+    cat2 = LiveTritonCatalog(account="acc", key_path=str(epath),
+                             key_id="ab:cd", url=triton_api,
+                             authenticated=True)
+    assert cat2.choices("triton", "networks") is None  # 401 -> fallback

@@ -69,6 +69,7 @@ def make_catalog(config) -> Catalog:
     if kind == "live":
         from .azure import LiveAzureCatalog
         from .gcp import LiveGcpCatalog
+        from .triton import LiveTritonCatalog
 
         return CompositeCatalog([
             LiveGcpCatalog(
@@ -82,6 +83,12 @@ def make_catalog(config) -> Catalog:
                 tenant_id=str(config.get("azure_tenant_id") or ""),
                 client_id=str(config.get("azure_client_id") or ""),
                 client_secret=str(config.get("azure_client_secret") or ""),
+            ),
+            LiveTritonCatalog(
+                account=str(config.get("triton_account") or ""),
+                key_path=str(config.get("triton_key_path") or ""),
+                key_id=str(config.get("triton_key_id") or ""),
+                url=str(config.get("triton_url") or ""),
             ),
         ])
     raise ValidationError(

@@ -55,6 +55,12 @@ class ModelConfig:
                 f"moe_dispatch must be 'auto', 'dense', or 'sort', got "
                 f"{self.moe_dispatch!r}")
     scan_layers: bool = True  # lax.scan over the layer stack
+    # Fused cross-entropy head (ops/fused_ce.py): compute the loss in vocab
+    # chunks without materializing [B,S,V] f32 logits — at Llama vocab
+    # sizes those (plus their cotangent) are the step's largest activations.
+    # Single-stage training path only; the pipeline keeps the logits head.
+    fused_ce: bool = False
+    ce_chunk: int = 8192
 
     @property
     def activation_dtype(self) -> jnp.dtype:

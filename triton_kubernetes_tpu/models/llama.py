@@ -179,6 +179,22 @@ def forward(
     positions: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (logits [B, S, V] f32, moe aux loss scalar)."""
+    x, aux_total = forward_hidden(params, tokens, config, attention_fn,
+                                  positions)
+    return unembed(x, params, config), aux_total
+
+
+def forward_hidden(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32
+    config: ModelConfig,
+    attention_fn: Optional[AttentionFn] = None,
+    positions: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The decoder stack without the vocab projection: returns (hidden
+    states [B, S, D] before the final norm, moe aux loss scalar). The
+    fused-CE path (ops/fused_ce.py) consumes this so [B, S, V] logits are
+    never materialized."""
     attention_fn = attention_fn or _dense_attention
     b, s = tokens.shape
     ad = config.activation_dtype
@@ -209,14 +225,28 @@ def forward(
             x, aux = body(x, layer_i)
             aux_total = aux_total + aux
 
-    return unembed(x, params, config), aux_total
+    return x, aux_total
+
+
+def final_norm_hidden(x: jnp.ndarray, params: Params,
+                      config: ModelConfig) -> jnp.ndarray:
+    """The hidden states the vocab head consumes (final rms_norm applied).
+    Single source of truth for both heads: ``unembed`` (full logits) and
+    the fused-CE path (ops/fused_ce.py) — any head change lands in both."""
+    return rms_norm(x, params["final_norm"], config.norm_eps)
+
+
+def head_weights(params: Params, config: ModelConfig) -> jnp.ndarray:
+    """The lm head matrix in activation dtype — the exact operand
+    ``unembed`` contracts with."""
+    return params["lm_head"].astype(config.activation_dtype)
 
 
 def unembed(x: jnp.ndarray, params: Params, config: ModelConfig):
     """Final norm + lm_head: [B, S, D] -> f32 logits [B, S, V]."""
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = final_norm_hidden(x, params, config)
     return jnp.einsum(
-        "bsd,dv->bsv", x, params["lm_head"].astype(config.activation_dtype),
+        "bsd,dv->bsv", x, head_weights(params, config),
         preferred_element_type=jnp.float32)
 
 

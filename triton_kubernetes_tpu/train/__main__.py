@@ -52,6 +52,12 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="steps between saves (0 = only at the end)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--model-opt", action="append", default=[],
+                   metavar="K=V",
+                   help="ModelConfig override, repeatable (e.g. "
+                        "--model-opt fused_ce=true --model-opt "
+                        "remat_policy=dots); values coerce like YAML "
+                        "scalars")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--json-logs", action="store_true")
     p.add_argument("--distributed", choices=["auto", "on", "off"],
@@ -113,7 +119,15 @@ def main(argv=None) -> int:
     from .trainer import init_state, make_optimizer, make_train_step
     from .mfu import flops_per_token, mfu as compute_mfu
 
-    config = get_config(args.model)
+    from ..config.config import parse_scalar
+
+    overrides = {}
+    for item in args.model_opt:
+        key, sep, value = item.partition("=")
+        if not sep:
+            raise SystemExit(f"--model-opt expects K=V, got {item!r}")
+        overrides[key] = parse_scalar(value)
+    config = get_config(args.model, **overrides)
     seq_len = args.seq_len or config.max_seq_len
     mesh_cfg = MeshConfig(
         data=args.data, stage=args.stage, fsdp=args.fsdp, seq=args.seq,

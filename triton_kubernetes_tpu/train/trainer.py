@@ -216,10 +216,21 @@ def loss_fn(
         logits, aux = pipeline_forward(
             params, inputs, config, num_stages, microbatches,
             attention_fn=attention_fn, mesh=mesh)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    elif config.fused_ce:
+        from ..ops.fused_ce import fused_cross_entropy
+
+        hidden, aux = llama.forward_hidden(params, inputs, config,
+                                           attention_fn=attention_fn)
+        hidden = llama.final_norm_hidden(hidden, params, config)
+        b, s, d = hidden.shape
+        ce = fused_cross_entropy(
+            hidden.reshape(b * s, d), llama.head_weights(params, config),
+            targets.reshape(-1), config.ce_chunk)
     else:
         logits, aux = llama.forward(params, inputs, config,
                                     attention_fn=attention_fn)
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     ce = ce.mean()
     total = ce + config.aux_loss_weight * aux
     return total, {"loss": ce, "aux_loss": aux}

@@ -17,8 +17,9 @@ class SSHKeyError(ValueError):
     pass
 
 
-def public_key_fingerprint_from_private_key(
-        path: str, passphrase: Optional[bytes] = None) -> str:
+def load_private_key(path: str, passphrase: Optional[bytes] = None):
+    """Load an RSA/EC/Ed25519 private key in either OpenSSH (ssh-keygen's
+    default since 7.8) or PEM format."""
     from cryptography.hazmat.primitives import serialization
 
     path = os.path.expanduser(path)
@@ -28,19 +29,22 @@ def public_key_fingerprint_from_private_key(
     except OSError as e:
         raise SSHKeyError(f"cannot read private key {path}: {e}") from e
 
-    key = None
     for loader in (serialization.load_ssh_private_key,
                    serialization.load_pem_private_key):
         try:
-            key = loader(data, password=passphrase)
-            break
+            return loader(data, password=passphrase)
         except ValueError:
             continue
         except TypeError as e:  # encrypted key without passphrase
             raise SSHKeyError(f"private key {path} needs a passphrase") from e
-    if key is None:
-        raise SSHKeyError(f"unsupported private key format: {path}")
+    raise SSHKeyError(f"unsupported private key format: {path}")
 
+
+def public_key_fingerprint_from_private_key(
+        path: str, passphrase: Optional[bytes] = None) -> str:
+    from cryptography.hazmat.primitives import serialization
+
+    key = load_private_key(path, passphrase)
     pub = key.public_key().public_bytes(
         serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
     blob = base64.b64decode(pub.split()[1])

@@ -46,6 +46,17 @@ class WorkflowContext:
         return list(live) if live else fallback
 
 
+def preferred_default(options: List[str], curated: List[str]) -> str:
+    """Non-interactive default for a live-catalog choice: the first
+    curated (static-list) entry the live options actually offer, else the
+    first option. A silent install must not land on whatever cloud object
+    happens to sort first."""
+    for c in curated:
+        if c in options:
+            return c
+    return options[0]
+
+
 def module_source(ctx: WorkflowContext, name: str) -> str:
     """Module source string, honoring the local-dev redirect keys
     (``source_url``/``source_ref``; reference create/cluster.go:20-22,305-312)."""

@@ -9,7 +9,7 @@ create/cluster_aks.go:27-522, create/node_azure.go:25-325.
 from __future__ import annotations
 
 from ...state import StateDocument
-from ..common import WorkflowContext, module_source
+from ..common import WorkflowContext, module_source, preferred_default
 from .base import base_cluster_config, base_manager_config, base_node_config
 
 LOCATIONS = ["West US 2", "East US", "West Europe", "Southeast Asia"]
@@ -29,7 +29,8 @@ def _creds(ctx: WorkflowContext, with_location: bool = True) -> dict:
         locations = ctx.choices("azure", "locations", LOCATIONS, cfg)
         cfg["azure_location"] = r.choose(
             "azure_location", "Azure Location",
-            [(x, x) for x in locations], default=locations[0])
+            [(x, x) for x in locations],
+            default=preferred_default(locations, LOCATIONS))
     return cfg
 
 
@@ -59,7 +60,8 @@ def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> Non
         cfg.update(_creds(ctx))
     sizes = _vm_sizes(ctx, cfg)
     cfg["azure_size"] = r.choose("azure_size", "Azure VM Size",
-                                 [(s, s) for s in sizes], default=sizes[0])
+                                 [(s, s) for s in sizes],
+                                 default=preferred_default(sizes, VM_SIZES))
     cfg["azure_public_key_path"] = r.value(
         "azure_public_key_path", "Azure Public Key Path",
         default="~/.ssh/id_rsa.pub")
@@ -82,7 +84,8 @@ def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
     cfg.update(_creds(ctx, with_location=False))
     sizes = _vm_sizes(ctx, cfg)
     cfg["azure_size"] = r.choose("azure_size", "Azure VM Size",
-                                 [(s, s) for s in sizes], default=sizes[0])
+                                 [(s, s) for s in sizes],
+                                 default=preferred_default(sizes, VM_SIZES))
     cfg["azure_subnet_id"] = f"${{module.{cluster_key}.azure_subnet_id}}"
     # Real-path placement: hosts land in the cluster's resource group and
     # location (the azure-k8s HCL module exports both).
@@ -121,7 +124,8 @@ def aks_cluster_config(ctx: WorkflowContext, state: StateDocument, name: str) ->
         {**cfg, "location": cfg.get("azure_location", "")})
     cfg.update({
         "azure_size": r.choose("azure_size", "Azure VM Size",
-                               [(s, s) for s in sizes], default=sizes[0]),
+                               [(s, s) for s in sizes],
+                               default=preferred_default(sizes, VM_SIZES)),
         "azure_ssh_user": r.value("azure_ssh_user", "Azure SSH User",
                                   default="azureuser"),
         "azure_public_key_path": r.value("azure_public_key_path",

@@ -4,7 +4,7 @@ create/cluster_triton.go:16-140, create/node_triton.go:23-328 analogs)."""
 from __future__ import annotations
 
 from ...state import StateDocument
-from ..common import WorkflowContext, WorkflowError
+from ..common import WorkflowContext, WorkflowError, preferred_default
 from .base import base_cluster_config, base_manager_config, base_node_config
 
 TRITON_URLS = [
@@ -36,24 +36,39 @@ def _creds(ctx: WorkflowContext) -> dict:
         "triton_account": r.value("triton_account", "Triton Account Name"),
         "triton_key_path": key_path,
         "triton_key_id": key_id,
-        "triton_url": r.choose("triton_url", "Triton URL",
-                               [(u, u) for u in TRITON_URLS],
-                               default=TRITON_URLS[0]),
+        # Free-form (the reference offered a menu of Joyent public-cloud
+        # regions; those are gone — private installations are the norm, so
+        # any CloudAPI endpoint must be accepted).
+        "triton_url": r.value("triton_url", "Triton URL",
+                              default=TRITON_URLS[0]),
     }
+
+
+def _cat(ctx: WorkflowContext, kind: str, fallback: list,
+         creds: dict) -> list:
+    """Live CloudAPI choices when `catalog: live` (the reference's
+    validated prompts, create/manager_triton.go:352-396), static
+    fallback otherwise."""
+    return ctx.choices("triton", kind, fallback, creds)
 
 
 def manager_config(ctx: WorkflowContext, state: StateDocument, name: str) -> None:
     r = ctx.resolver
     cfg = base_manager_config(ctx, "triton-manager", name)
     cfg.update(_creds(ctx))
+    images = _cat(ctx, "images", IMAGES, cfg)
+    packages = _cat(ctx, "packages", PACKAGES, cfg)
     cfg["triton_image_name"] = r.choose(
-        "triton_image_name", "Triton Image", [(i, i) for i in IMAGES],
-        default=IMAGES[0])
+        "triton_image_name", "Triton Image", [(i, i) for i in images],
+        default=preferred_default(images, IMAGES))
     cfg["triton_machine_package"] = r.choose(
         "master_triton_machine_package", "Triton Machine Package",
-        [(p, p) for p in PACKAGES], default=PACKAGES[0])
+        [(p, p) for p in packages],
+        default=preferred_default(packages, PACKAGES))
+    networks = _cat(ctx, "networks", NETWORKS, cfg)
     cfg["triton_network_names"] = r.value(
-        "triton_network_names", "Triton Networks", default=[NETWORKS[0]])
+        "triton_network_names", "Triton Networks",
+        default=[preferred_default(networks, NETWORKS)])
     state.set_manager(cfg)
 
 
@@ -68,14 +83,19 @@ def node_config(ctx: WorkflowContext, state: StateDocument, cluster_key: str,
     r = ctx.resolver
     cfg = base_node_config(ctx, "triton-k8s-host", cluster_key, hostname, host_label)
     cfg.update(_creds(ctx))
+    images = _cat(ctx, "images", IMAGES, cfg)
+    packages = _cat(ctx, "packages", PACKAGES, cfg)
+    networks = _cat(ctx, "networks", NETWORKS, cfg)
     cfg["triton_image_name"] = r.choose(
-        "triton_image_name", "Triton Image", [(i, i) for i in IMAGES],
-        default=IMAGES[0])
+        "triton_image_name", "Triton Image", [(i, i) for i in images],
+        default=preferred_default(images, IMAGES))
     cfg["triton_ssh_user"] = r.value("triton_ssh_user", "Triton SSH User",
                                      default="ubuntu")
     cfg["triton_machine_package"] = r.choose(
         "triton_machine_package", "Triton Machine Package",
-        [(p, p) for p in PACKAGES], default=PACKAGES[0])
+        [(p, p) for p in packages],
+        default=preferred_default(packages, PACKAGES))
     cfg["triton_network_names"] = r.value(
-        "triton_network_names", "Triton Networks", default=[NETWORKS[0]])
+        "triton_network_names", "Triton Networks",
+        default=[preferred_default(networks, NETWORKS)])
     return state.add_node(cluster_key, hostname, cfg)
