@@ -230,3 +230,40 @@ def test_targeted_cluster_destroy_via_terraform(stub_tf, tmp_path):
     # The doc persisted after destroy no longer carries the cluster.
     doc = be.state("m3")
     assert not doc.clusters()
+
+
+def test_output_reads_reuse_an_initialized_workdir(stub_tf, tmp_path):
+    """Reads must not pay `terraform init` per call (the reference's
+    heavyweight-read wart, SURVEY.md §3.5): the first output for a doc
+    initializes one cached workdir per doc name; unchanged re-reads run
+    `output -json` alone, and any change to the doc re-initializes the
+    same directory in place (cache bounded by manager count)."""
+    import os
+
+    binary, cap = stub_tf
+    from triton_kubernetes_tpu.executor.terraform import TerraformExecutor
+    from triton_kubernetes_tpu.state import StateDocument
+
+    doc = StateDocument("m1", {"module": {
+        "cluster-manager": {
+            "source": "modules/gcp-manager", "name": "m1",
+            "gcp_path_to_credentials": "/c", "gcp_project_id": "p"},
+    }})
+    ex = TerraformExecutor(binary=binary, stream_output=False,
+                           cache_dir=str(tmp_path / "tfcache"))
+    ex.output(doc, "cluster-manager")
+    ex.output(doc, "cluster-manager")
+    ex.output(doc, "cluster-manager")
+    lines = _argv_lines(cap)
+    assert lines == ["init -force-copy", "output -json", "output -json",
+                     "output -json"]
+
+    # A changed doc re-initializes the same per-name workdir in place.
+    doc2 = doc.copy()
+    doc2.set("module.cluster-manager.gcp_zone", "us-east5-a")
+    ex.output(doc2, "cluster-manager")
+    assert _argv_lines(cap)[-2:] == ["init -force-copy", "output -json"]
+    # Exactly one cache entry for the manager, regardless of doc history.
+    entries = [d for d in os.listdir(tmp_path / "tfcache")
+               if not d.startswith(".")]
+    assert entries == ["m1"]
