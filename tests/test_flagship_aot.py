@@ -106,6 +106,13 @@ CASES = {
         config="mixtral-8x7b", n_devices=256,
         mesh_kwargs=dict(fsdp=32, expert=8), batch=32, generation="v5p",
         expect_all_to_all=True),
+    # Long-context: sequence parallelism via ring attention at mesh scale
+    # (the 8K training seq sharded 4-way; ring is exact and pure XLA, so
+    # the same program lowers for CPU and TPU).
+    "llama3-8b-seqparallel-v5p64": dict(
+        config="llama3-8b", n_devices=64,
+        mesh_kwargs=dict(fsdp=8, seq=4, tensor=2), batch=8,
+        generation="v5p", expect_all_to_all=False),
 }
 
 

@@ -263,7 +263,8 @@ def test_output_reads_reuse_an_initialized_workdir(stub_tf, tmp_path):
     doc2.set("module.cluster-manager.gcp_zone", "us-east5-a")
     ex.output(doc2, "cluster-manager")
     assert _argv_lines(cap)[-2:] == ["init -force-copy", "output -json"]
-    # Exactly one cache entry for the manager, regardless of doc history.
+    # Exactly one cache entry for the manager, regardless of doc history
+    # (name + hash-of-name, so distinct names can never collide).
     entries = [d for d in os.listdir(tmp_path / "tfcache")
                if not d.startswith(".")]
-    assert entries == ["m1"]
+    assert len(entries) == 1 and entries[0].startswith("m1-")

@@ -13,6 +13,7 @@ the in-process LocalExecutor is the default for everything else.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import shutil
@@ -214,27 +215,38 @@ class TerraformExecutor:
                     p = os.path.join(dirpath, f)
                     try:
                         st = os.stat(p)
-                        h.update(f"|{p}|{st.st_size}".encode())
+                        h.update(
+                            f"|{p}|{st.st_size}|{st.st_mtime_ns}".encode())
                     except OSError:
                         pass
         return h.hexdigest()
 
-    def _cached_workdir(self, doc: StateDocument) -> str:
+    @contextlib.contextmanager
+    def _cached_workdir(self, doc: StateDocument):
         """A persistent initialized workdir per document name:
         ``terraform init`` runs once per distinct (doc, binary, plugins)
         fingerprint and later reads reuse the directory — the reference
         re-initialized for every ``get`` (run_terraform.go:146), the
         heavyweight-read wart SURVEY.md §3.5 flags. One directory per doc
         name (re-initialized in place when the doc changes), so the cache
-        is bounded by the number of managers, not doc history. An flock
-        serializes concurrent initialization."""
+        is bounded by the number of managers, not doc history.
+
+        Context manager: the per-doc flock is held until the caller's read
+        finishes, so a concurrent re-initialization can never rmtree a
+        workdir mid-``terraform output``. The directory name is the
+        sanitized doc name plus a hash of the exact name — dots are
+        excluded (no '..' escape for the stale-dir rmtree) and distinct
+        names can never collide into cache-thrashing on one directory."""
         import fcntl
+        import hashlib
         import re
 
         body = self._prepare_body(doc)
         fingerprint = self._cache_fingerprint(body)
         root = self._cache_root()
-        safe = re.sub(r"[^A-Za-z0-9._-]", "_", doc.name) or "default"
+        tag = hashlib.sha256(doc.name.encode()).hexdigest()[:8]
+        base = re.sub(r"[^A-Za-z0-9_-]", "_", doc.name)[:40] or "doc"
+        safe = f"{base}-{tag}"
         cwd = os.path.join(root, safe)
         lock_path = os.path.join(root, f".{safe}.lock")
         with open(lock_path, "w") as lock:
@@ -257,7 +269,7 @@ class TerraformExecutor:
                 self._run(["init", "-force-copy"], cwd)
                 with open(marker, "w") as f:
                     f.write(fingerprint)
-        return cwd
+            yield cwd
 
     def output(self, doc: StateDocument, module_key: str) -> Dict[str, Any]:
         """Module outputs via root-level re-exports.
@@ -271,17 +283,17 @@ class TerraformExecutor:
         initialized workdir (`_cached_workdir`) — no init per read."""
         from .engine import ApplyError
 
-        cwd = self._cached_workdir(doc)
-        try:
-            res = subprocess.run(
-                [self._require_binary(), "output", "-json"],
-                cwd=cwd, check=True, capture_output=True,
-            )
-        except subprocess.CalledProcessError as e:
-            raise ApplyError(
-                f"terraform output failed with exit code {e.returncode}"
-                + (f": {e.stderr.decode(errors='replace').strip()}"
-                   if e.stderr else "")) from e
+        with self._cached_workdir(doc) as cwd:
+            try:
+                res = subprocess.run(
+                    [self._require_binary(), "output", "-json"],
+                    cwd=cwd, check=True, capture_output=True,
+                )
+            except subprocess.CalledProcessError as e:
+                raise ApplyError(
+                    f"terraform output failed with exit code {e.returncode}"
+                    + (f": {e.stderr.decode(errors='replace').strip()}"
+                       if e.stderr else "")) from e
         all_outputs = json.loads(res.stdout or b"{}")
         prefix = f"{module_key}__"
         return {

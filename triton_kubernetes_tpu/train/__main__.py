@@ -58,6 +58,11 @@ def _build_parser() -> argparse.ArgumentParser:
                         "--model-opt fused_ce=true --model-opt "
                         "remat_policy=dots); values coerce like YAML "
                         "scalars")
+    p.add_argument("--profile-dir", default="",
+                   help="capture a jax.profiler trace of steady-state "
+                        "steps into this directory (view with "
+                        "tensorboard/xprof; SURVEY.md §5 tracing "
+                        "obligation)")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--json-logs", action="store_true")
     p.add_argument("--distributed", choices=["auto", "on", "off"],
@@ -202,35 +207,52 @@ def main(argv=None) -> int:
     timed_from = start_step
     tokens_per_step = batch_size * seq_len
     last_loss = float("nan")
-    for i in range(start_step, args.steps):
-        # Both sources yield int32 numpy [B, S+1]; jit places it on the
-        # mesh directly, no eager host->device staging.
-        state, metrics = step_fn(state, {"tokens": next(gen)["tokens"]})
-        if i == start_step:
-            # Restart the throughput window after the compile step so the
-            # reported tokens/sec is steady-state, not compile-diluted.
-            float(metrics["loss"])
-            t0 = time.perf_counter()
-            timed_from = i + 1
-        if args.dry_run or (i + 1) % args.log_every == 0 \
-                or i + 1 == args.steps:
-            last_loss = float(metrics["loss"])  # device sync
-            dt = time.perf_counter() - t0
-            done = i + 1 - timed_from
-            tps = tokens_per_step * done / max(dt, 1e-9) if done else 0.0
-            fields = dict(step=i + 1, loss=round(last_loss, 4),
-                          tokens_per_sec=round(tps, 1),
-                          tflops=round(tps * fpt / 1e12, 2))
-            if peak:
-                fields["mfu"] = round(compute_mfu(
-                    tps, config, seq_len, peak), 4)
-            log.log("info", "train", **fields)
-        if ckpt and args.checkpoint_every \
-                and (i + 1) % args.checkpoint_every == 0:
-            ckpt.save(i + 1, state)
-            log.log("info", "checkpoint saved", step=i + 1)
-        if args.dry_run:
-            break
+    tracing = False
+    try:
+        for i in range(start_step, args.steps):
+            # Both sources yield int32 numpy [B, S+1]; jit places it on the
+            # mesh directly, no eager host->device staging.
+            state, metrics = step_fn(state, {"tokens": next(gen)["tokens"]})
+            if i == start_step:
+                # Restart the throughput window after the compile step so the
+                # reported tokens/sec is steady-state, not compile-diluted.
+                float(metrics["loss"])
+                t0 = time.perf_counter()
+                timed_from = i + 1
+                if args.profile_dir and not args.dry_run \
+                        and args.steps > start_step + 1:
+                    # Steady-state steps only: the compile step would dwarf
+                    # everything else in the trace.
+                    jax.profiler.start_trace(args.profile_dir)
+                    tracing = True
+                    log.log("info", "profiler tracing", dir=args.profile_dir)
+            if args.dry_run or (i + 1) % args.log_every == 0 \
+                    or i + 1 == args.steps:
+                last_loss = float(metrics["loss"])  # device sync
+                dt = time.perf_counter() - t0
+                done = i + 1 - timed_from
+                tps = tokens_per_step * done / max(dt, 1e-9) if done else 0.0
+                fields = dict(step=i + 1, loss=round(last_loss, 4),
+                              tokens_per_sec=round(tps, 1),
+                              tflops=round(tps * fpt / 1e12, 2))
+                if peak:
+                    fields["mfu"] = round(compute_mfu(
+                        tps, config, seq_len, peak), 4)
+                log.log("info", "train", **fields)
+            if ckpt and args.checkpoint_every \
+                    and (i + 1) % args.checkpoint_every == 0:
+                ckpt.save(i + 1, state)
+                log.log("info", "checkpoint saved", step=i + 1)
+            if args.dry_run:
+                break
+    finally:
+        if tracing:
+            # try/finally: the trace matters MOST when the run dies (OOM,
+            # interrupt) — sync so it holds completed device work, then
+            # flush it regardless of how the loop exited.
+            jax.block_until_ready(state.params)
+            jax.profiler.stop_trace()
+            log.log("info", "profiler trace written", dir=args.profile_dir)
     if ckpt:
         if ckpt.latest_step() != int(state.step):
             ckpt.save(int(state.step), state, wait=True)

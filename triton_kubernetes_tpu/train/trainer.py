@@ -82,6 +82,7 @@ def _resolve_attention(attention_fn, mesh: Mesh):
             # positions operand carries what the axis index would compute.
             return ring(q, k, v, positions if pp else None)
 
+        ring_attn.forfeits = []  # ring IS the kernel path; nothing forfeited
         return ring_attn
     flash = auto_attention(mesh.devices.flat[0].platform)
     if flash is None or mesh.size == 1:
