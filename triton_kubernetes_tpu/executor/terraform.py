@@ -247,6 +247,15 @@ class TerraformExecutor:
         tag = hashlib.sha256(doc.name.encode()).hexdigest()[:8]
         base = re.sub(r"[^A-Za-z0-9_-]", "_", doc.name)[:40] or "doc"
         safe = f"{base}-{tag}"
+        # One-time sweep of entries from older naming schemes: tfcache is
+        # exclusively ours, and anything not name-hash keyed would never
+        # be matched or reclaimed again (provider trees are large).
+        for entry in os.listdir(root):
+            if entry.startswith("."):
+                continue
+            if not re.fullmatch(r".+-[0-9a-f]{8}", entry):
+                shutil.rmtree(os.path.join(root, entry),
+                              ignore_errors=True)
         cwd = os.path.join(root, safe)
         lock_path = os.path.join(root, f".{safe}.lock")
         with open(lock_path, "w") as lock:
@@ -269,6 +278,10 @@ class TerraformExecutor:
                 self._run(["init", "-force-copy"], cwd)
                 with open(marker, "w") as f:
                     f.write(fingerprint)
+            # Downgrade to a shared lock for the read itself: concurrent
+            # readers proceed in parallel, while a re-initializer's
+            # LOCK_EX still cannot rmtree under any active reader.
+            fcntl.flock(lock, fcntl.LOCK_SH)
             yield cwd
 
     def output(self, doc: StateDocument, module_key: str) -> Dict[str, Any]:

@@ -249,8 +249,13 @@ def main(argv=None) -> int:
         if tracing:
             # try/finally: the trace matters MOST when the run dies (OOM,
             # interrupt) — sync so it holds completed device work, then
-            # flush it regardless of how the loop exited.
-            jax.block_until_ready(state.params)
+            # flush it regardless of how the loop exited. The sync itself
+            # re-raises on a failed computation; that must not cost the
+            # trace (or mask the original exception).
+            try:
+                jax.block_until_ready(state.params)
+            except Exception:
+                pass
             jax.profiler.stop_trace()
             log.log("info", "profiler trace written", dir=args.profile_dir)
     if ckpt:
