@@ -266,3 +266,44 @@ def test_fused_ce_matches_logits_path(cpu_mesh_devices):
     for x, y in zip(a, b):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    rtol=5e-4, atol=5e-6)
+
+
+def test_checkpoint_elastic_reshard_across_meshes(tmp_path, cpu_mesh_devices):
+    """Elastic recovery (SURVEY.md §5): a checkpoint written under one mesh
+    restores onto a DIFFERENT mesh shape — orbax lands each shard per the
+    target sharding, so a job can resume after losing or gaining hosts.
+    Training continues identically: one post-restore step on the new mesh
+    produces the same loss as the uninterrupted run."""
+    from triton_kubernetes_tpu.train.checkpoint import CheckpointManager
+
+    cfg = get_config("llama-test", dtype="float32")
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    batch = next(synthetic_batches(cfg.vocab_size, 8, 32))
+    tokens = jnp.asarray(batch["tokens"])
+
+    # Train two steps on the original 4-device mesh (half the machine),
+    # checkpoint after the first.
+    import jax as _jax
+    mesh_a = create_mesh(MeshConfig(fsdp=4), devices=_jax.devices()[:4])
+    state = init_state(cfg, mesh_a, opt)
+    step_a = make_train_step(cfg, mesh_a, opt)
+    state, _ = step_a(state, {"tokens": tokens})
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, state, wait=True)
+    state, metrics = step_a(state, {"tokens": tokens})
+    expected = float(metrics["loss"])
+
+    # "Cluster resize": restore onto a different 4-device layout, then
+    # onto all 8 devices (scale-up after node replacement).
+    for mesh_b in (create_mesh(MeshConfig(fsdp=2, tensor=2),
+                               devices=_jax.devices()[:4]),
+                   create_mesh(MeshConfig(fsdp=8))):
+        target = init_state(cfg, mesh_b, opt)
+        restored = mgr.restore(target)
+        emb = restored.params["embed"]
+        assert emb.sharding.mesh.shape == mesh_b.shape  # new layout, really
+        step_b = make_train_step(cfg, mesh_b, opt)
+        _, metrics_b = step_b(restored, {"tokens": tokens})
+        np.testing.assert_allclose(float(metrics_b["loss"]), expected,
+                                   rtol=1e-5)
+    mgr.close()

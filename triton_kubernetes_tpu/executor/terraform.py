@@ -247,41 +247,66 @@ class TerraformExecutor:
         tag = hashlib.sha256(doc.name.encode()).hexdigest()[:8]
         base = re.sub(r"[^A-Za-z0-9_-]", "_", doc.name)[:40] or "doc"
         safe = f"{base}-{tag}"
-        # One-time sweep of entries from older naming schemes: tfcache is
-        # exclusively ours, and anything not name-hash keyed would never
-        # be matched or reclaimed again (provider trees are large).
-        for entry in os.listdir(root):
-            if entry.startswith("."):
-                continue
-            if not re.fullmatch(r".+-[0-9a-f]{8}", entry):
-                shutil.rmtree(os.path.join(root, entry),
-                              ignore_errors=True)
+        # Sweep entries from older naming schemes exactly once
+        # (sentinel-guarded): tfcache is exclusively ours, and anything
+        # not name-hash keyed would never be matched or reclaimed again
+        # (provider trees are large). Old-scheme lock files go too.
+        sentinel = os.path.join(root, ".swept-v2")
+        if not os.path.exists(sentinel):
+            for entry in os.listdir(root):
+                path = os.path.join(root, entry)
+                if entry.startswith("."):
+                    if re.fullmatch(r"\..+-[0-9a-f]{8}\.lock", entry):
+                        continue
+                    if entry.endswith(".lock"):
+                        with contextlib.suppress(OSError):
+                            os.unlink(path)
+                    continue
+                if not re.fullmatch(r".+-[0-9a-f]{8}", entry):
+                    shutil.rmtree(path, ignore_errors=True)
+            with open(sentinel, "w"):
+                pass
         cwd = os.path.join(root, safe)
+        marker = os.path.join(cwd, ".tk8s-initialized")
         lock_path = os.path.join(root, f".{safe}.lock")
         with open(lock_path, "w") as lock:
-            fcntl.flock(lock, fcntl.LOCK_EX)
-            marker = os.path.join(cwd, ".tk8s-initialized")
-            try:
-                current = open(marker).read()
-            except OSError:
-                current = ""
-            if current != fingerprint:
-                # Anything stale (old doc, new binary, failed prior init)
-                # is rebuilt from scratch — a half-written .terraform tree
-                # must never be marked valid.
-                if os.path.isdir(cwd):
-                    shutil.rmtree(cwd)
-                os.makedirs(cwd, mode=0o700)
-                with open(os.path.join(cwd, "main.tf.json"), "wb") as f:
-                    f.write(body)
-                self._copy_plugins(cwd)
-                self._run(["init", "-force-copy"], cwd)
-                with open(marker, "w") as f:
-                    f.write(fingerprint)
-            # Downgrade to a shared lock for the read itself: concurrent
-            # readers proceed in parallel, while a re-initializer's
-            # LOCK_EX still cannot rmtree under any active reader.
-            fcntl.flock(lock, fcntl.LOCK_SH)
+            # flock downgrade (EX -> SH) is not atomic: a pending EX can
+            # be granted in the conversion window and rebuild the workdir
+            # for a different doc body. Re-validate under SH and retry if
+            # the marker moved.
+            for _ in range(8):
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                try:
+                    current = open(marker).read()
+                except OSError:
+                    current = ""
+                if current != fingerprint:
+                    # Anything stale (old doc, new binary, failed prior
+                    # init) is rebuilt from scratch — a half-written
+                    # .terraform tree must never be marked valid.
+                    if os.path.isdir(cwd):
+                        shutil.rmtree(cwd)
+                    os.makedirs(cwd, mode=0o700)
+                    with open(os.path.join(cwd, "main.tf.json"), "wb") as f:
+                        f.write(body)
+                    self._copy_plugins(cwd)
+                    self._run(["init", "-force-copy"], cwd)
+                    with open(marker, "w") as f:
+                        f.write(fingerprint)
+                # Shared lock for the read itself: concurrent readers
+                # proceed in parallel, while a re-initializer's LOCK_EX
+                # cannot rmtree under any active reader.
+                fcntl.flock(lock, fcntl.LOCK_SH)
+                try:
+                    still = open(marker).read()
+                except OSError:
+                    still = ""
+                if still == fingerprint:
+                    break
+            else:
+                raise RuntimeError(
+                    f"terraform read cache for {doc.name!r} kept churning "
+                    f"under concurrent re-initialization")
             yield cwd
 
     def output(self, doc: StateDocument, module_key: str) -> Dict[str, Any]:
