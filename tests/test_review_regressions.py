@@ -404,3 +404,41 @@ def test_terraform_workdir_exports_module_outputs(tmp_path):
     assert val == "${module.cluster-manager.manager_url}"
     # Original doc untouched.
     assert doc.get("output") is None
+
+
+def test_reregistration_preserves_foreign_node_fields():
+    """Round-4 advisor fix: agent heartbeats re-register the node and must
+    MERGE into the record — a wholesale replace silently wiped fields other
+    writers own (the simulator's 'health', the server's 'last_seen')."""
+    from triton_kubernetes_tpu.manager import protocol
+
+    clusters = {}
+    c = protocol.create_or_get_cluster(clusters, "m1", "dev")
+    token = c["registration_token"]
+    protocol.register_node(clusters, token, "n1", ["worker"])
+    c["nodes"]["n1"]["health"] = {"ready": False, "reason": "TpuUnhealthy"}
+    c["nodes"]["n1"]["last_seen"] = 123.0
+    # Heartbeat: same agent re-registers (possibly with updated labels).
+    node = protocol.register_node(clusters, token, "n1", ["worker"],
+                                  labels={"slice": "s0"})
+    assert node["health"] == {"ready": False, "reason": "TpuUnhealthy"}
+    assert node["last_seen"] == 123.0
+    assert node["labels"] == {"slice": "s0"}
+
+
+def test_tls_cacerts_tracks_served_body():
+    """Round-4 review fix: a manager whose served cacerts changes (plain
+    HTTP upgraded to TLS) must re-pin existing clusters' ca_checksum —
+    stale pins would lock every future agent out."""
+    import hashlib
+
+    from triton_kubernetes_tpu.manager import protocol
+
+    clusters = {}
+    c1 = protocol.create_or_get_cluster(clusters, "m1", "dev")
+    old = c1["ca_checksum"]
+    cert = "-----BEGIN CERTIFICATE-----\nreal\n-----END CERTIFICATE-----\n"
+    c2 = protocol.create_or_get_cluster(clusters, "m1", "dev", cacerts=cert)
+    assert c2 is c1
+    assert c2["ca_checksum"] == hashlib.sha256(cert.encode()).hexdigest()
+    assert c2["ca_checksum"] != old
