@@ -92,29 +92,12 @@ def _child() -> None:
         for _ in range(4)
     ]
 
-    # Sync via a host scalar read: on the tunneled axon backend,
-    # block_until_ready returns before the computation actually finishes,
-    # so only a device->host fetch is a reliable barrier.
-    def run(n):
-        nonlocal state
-        t0 = time.perf_counter()
-        for i in range(n):
-            state, metrics = step(state, batches[i % len(batches)])
-        loss = float(metrics["loss"])
-        return time.perf_counter() - t0, loss
+    from triton_kubernetes_tpu.train.measure import measure_tokens_per_sec
 
     log("warmup/compile")
-    run(warmup)
     log("timing")
-    # Two-point measurement cancels the (noisy, up to ~0.5 s) fixed
-    # dispatch+fetch overhead of the tunnel.
-    t_short, _ = run(n_short)
-    t_long, last_loss = run(n_long)
-    dt = max(t_long - t_short, 1e-9)
-    timed = n_long - n_short
-
-    tokens_per_step = batch_size * seq_len
-    tps = tokens_per_step * timed / dt
+    tps, last_loss, state = measure_tokens_per_sec(
+        step, state, batches, batch_size * seq_len, warmup, n_short, n_long)
     # CPU etc: MFU denominator is meaningless, report vs 1 TFLOP.
     peak = peak_bf16_tflops_for_kind(device.device_kind) or 1.0
     achieved_mfu = mfu(tps, config, seq_len, peak)

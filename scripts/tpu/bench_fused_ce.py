@@ -1,0 +1,93 @@
+"""Fused-CE / batch / remat sweep on the bench chip (round-3 verdict #10).
+
+Times full llama3-bench train steps across head variants — the standard
+logits head vs the fused cross-entropy head (ops/fused_ce.py) at several
+chunk sizes — and across batch sizes the fused head's ~3.2 GB HBM saving
+(2 x B*S*V f32 at B=6, S=2048, V=32768) might newly admit. Prints one
+line per configuration plus a final best-vs-baseline verdict; the winner
+(if >=2%) gets baked into bench.py like the round-3 block/batch sweeps.
+
+    python scripts/tpu/bench_fused_ce.py [--steps 16] [--warmup 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from triton_kubernetes_tpu.models import get_config
+from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh
+from triton_kubernetes_tpu.train import (
+    init_state, make_optimizer, make_train_step, mfu)
+from triton_kubernetes_tpu.train.data import synthetic_batches
+from triton_kubernetes_tpu.train.measure import measure_tokens_per_sec
+from triton_kubernetes_tpu.topology.slices import peak_bf16_tflops_for_kind
+
+
+def run_case(name: str, batch: int, steps: int, warmup: int,
+             **overrides) -> dict:
+    cfg = get_config("llama3-bench", **overrides)
+    seq = 2048
+    device = jax.devices()[0]
+    mesh = create_mesh(MeshConfig(fsdp=1), devices=[device])
+    opt = make_optimizer(warmup_steps=10, decay_steps=1000)
+    try:
+        state = init_state(cfg, mesh, opt)
+        step = make_train_step(cfg, mesh, opt)
+        gen = synthetic_batches(cfg.vocab_size, batch, seq)
+        batches = [{"tokens": jax.device_put(jnp.asarray(next(gen)["tokens"]))}
+                   for _ in range(4)]
+        # Same shared harness as bench.py, so sweep winners are measured
+        # exactly the way the headline number is.
+        tps, _, _ = measure_tokens_per_sec(
+            step, state, batches, batch * seq, warmup,
+            max(steps // 4, 1), steps)
+    except Exception as e:  # OOM at bigger batches is an expected outcome
+        print(f"{name:34s}  FAILED: {type(e).__name__}: {str(e)[:90]}",
+              flush=True)
+        return {"name": name, "tps": 0.0}
+    peak = peak_bf16_tflops_for_kind(device.device_kind) or 1.0
+    m = mfu(tps, cfg, seq, peak)
+    print(f"{name:34s}  {tps:9.1f} tok/s  mfu={m:.4f}", flush=True)
+    return {"name": name, "tps": tps, "mfu": m}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=16)
+    p.add_argument("--warmup", type=int, default=3)
+    args = p.parse_args()
+    if args.steps < 2:
+        p.error("--steps must be >= 2 (two-point timing)")
+
+    print(f"device: {jax.devices()[0].device_kind}", flush=True)
+    results = []
+    # Baseline first (current bench.py configuration).
+    results.append(run_case("baseline b6 logits", 6,
+                            args.steps, args.warmup))
+    for chunk in (4096, 8192, 16384):
+        results.append(run_case(f"fused b6 chunk={chunk}", 6,
+                                args.steps, args.warmup,
+                                fused_ce=True, ce_chunk=chunk))
+    # The freed HBM may admit bigger batches (the round-3 lever).
+    for batch in (8, 10):
+        results.append(run_case(f"fused b{batch} chunk=8192", batch,
+                                args.steps, args.warmup,
+                                fused_ce=True, ce_chunk=8192))
+        results.append(run_case(f"baseline b{batch} logits", batch,
+                                args.steps, args.warmup))
+
+    base = results[0]["tps"]
+    best = max(results, key=lambda r: r["tps"])
+    if base <= 0:
+        print("\nbaseline FAILED — no verdict (rerun when the chip is "
+              "healthy)", flush=True)
+        raise SystemExit(1)
+    print(f"\nbest: {best['name']}  ({best['tps']:.1f} tok/s, "
+          f"{(best['tps'] / base - 1) * 100:+.1f}% vs baseline)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

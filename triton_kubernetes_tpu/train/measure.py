@@ -1,0 +1,43 @@
+"""Shared step-timing harness for bench.py and the sweep scripts.
+
+One implementation of the measurement subtleties so every number that
+might get baked into bench.py is produced the same way:
+
+- host-scalar sync: on the tunneled axon backend ``block_until_ready``
+  can return before the computation finishes; only a device->host fetch
+  (``float(metrics["loss"])``) is a reliable barrier;
+- two-point timing: (t_long - t_short) cancels the fixed dispatch+fetch
+  overhead of the tunnel (up to ~0.5 s per window).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Tuple
+
+
+def measure_tokens_per_sec(step, state, batches: List[Dict[str, Any]],
+                           tokens_per_step: int, warmup: int,
+                           n_short: int, n_long: int
+                           ) -> Tuple[float, float, Any]:
+    """Returns (tokens/sec, last loss, final state). ``n_long`` must
+    exceed ``n_short`` (the timed window is their difference)."""
+    if n_long <= n_short:
+        raise ValueError(
+            f"n_long ({n_long}) must exceed n_short ({n_short})")
+
+    def run(n):
+        nonlocal state
+        t0 = time.perf_counter()
+        loss = float("nan")
+        for i in range(n):
+            state, metrics = step(state, batches[i % len(batches)])
+        if n:
+            loss = float(metrics["loss"])  # device->host sync barrier
+        return time.perf_counter() - t0, loss
+
+    run(warmup)
+    t_short, _ = run(n_short)
+    t_long, loss = run(n_long)
+    dt = max(t_long - t_short, 1e-9)
+    return tokens_per_step * (n_long - n_short) / dt, loss, state
