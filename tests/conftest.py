@@ -27,3 +27,34 @@ def cpu_mesh_devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs
+
+
+# A fake terraform binary shared by every test that drives the terraform
+# executor: records one argv line per invocation plus a numbered copy of
+# the workdir's main.tf.json into $TF_STUB_DIR.
+TERRAFORM_STUB = """#!/usr/bin/env bash
+set -eu
+log_dir="$TF_STUB_DIR"
+echo "$@" >> "$log_dir/argv.log"
+n=$(wc -l < "$log_dir/argv.log")
+if [ -f main.tf.json ]; then
+  cp main.tf.json "$log_dir/doc.$n.json"
+fi
+case "$1" in
+  output) echo '{}' ;;
+esac
+"""
+
+
+@pytest.fixture()
+def terraform_stub(tmp_path, monkeypatch):
+    """(binary_path, capture_dir) for a stub terraform on disk."""
+    import stat as _stat
+
+    cap = tmp_path / "tf-capture"
+    cap.mkdir()
+    binary = tmp_path / "terraform-stub"
+    binary.write_text(TERRAFORM_STUB)
+    binary.chmod(binary.stat().st_mode | _stat.S_IEXEC)
+    monkeypatch.setenv("TF_STUB_DIR", str(cap))
+    return str(binary), cap

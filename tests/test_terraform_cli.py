@@ -22,33 +22,10 @@ from triton_kubernetes_tpu.executor.engine import _MEMORY_STATES
 from triton_kubernetes_tpu.executor.terraform import TerraformExecutor
 from triton_kubernetes_tpu.utils import get_logger
 
-STUB = """#!/usr/bin/env bash
-# Records: one line per invocation "<verb and args>" plus a numbered copy of
-# the workdir's main.tf.json, so tests can assert the full init/apply/destroy
-# sequence and the exact document terraform saw.
-set -eu
-log_dir="$TF_STUB_DIR"
-echo "$@" >> "$log_dir/argv.log"
-n=$(wc -l < "$log_dir/argv.log")
-if [ -f main.tf.json ]; then
-  cp main.tf.json "$log_dir/doc.$n.json"
-fi
-case "$1" in
-  output) echo '{}' ;;
-esac
-"""
-
-
 @pytest.fixture()
-def stub_tf(tmp_path, monkeypatch):
-    """A fake terraform on disk; returns (binary_path, capture_dir)."""
-    cap = tmp_path / "tf-capture"
-    cap.mkdir()
-    binary = tmp_path / "terraform-stub"
-    binary.write_text(STUB)
-    binary.chmod(binary.stat().st_mode | stat.S_IEXEC)
-    monkeypatch.setenv("TF_STUB_DIR", str(cap))
-    yield str(binary), cap
+def stub_tf(terraform_stub):
+    """The shared stub (tests/conftest.py) + memory-executor cleanup."""
+    yield terraform_stub
     _MEMORY_STATES.clear()
 
 
