@@ -339,3 +339,23 @@ def test_terraform_executor_preflights_documents():
         ex.apply(doc)
     assert "preflight" in str(ei.value)
     assert "gcp_project_id" in str(ei.value)
+
+
+def test_validate_document_flags_interpolation_cycle():
+    doc = StateDocument("m1", {"module": {
+        "cluster-manager": {
+            "source": "modules/gcp-manager", "name": "m1",
+            "gcp_path_to_credentials": "/c", "gcp_project_id": "p"},
+        "cluster_gcp_a": {
+            "source": "modules/gcp-k8s", "name": "a",
+            "manager_url": "${module.cluster_gcp_b.cluster_id}",
+            "manager_access_key": "x", "manager_secret_key": "x",
+            "gcp_path_to_credentials": "/c", "gcp_project_id": "p"},
+        "cluster_gcp_b": {
+            "source": "modules/gcp-k8s", "name": "b",
+            "manager_url": "${module.cluster_gcp_a.cluster_id}",
+            "manager_access_key": "x", "manager_secret_key": "x",
+            "gcp_path_to_credentials": "/c", "gcp_project_id": "p"},
+    }})
+    errs = validate_document(doc, modules_root=ROOT)
+    assert any("cycle" in e for e in errs), errs

@@ -605,6 +605,19 @@ def validate_document(doc: Any, modules_root: Optional[str] = None,
             errors.append(f"module.{key}: unknown variable {unknown!r} "
                           f"(declared: none of {sorted(var_names)[:8]}...)")
 
+    # Interpolation cycles: the executor's topological sort would only
+    # discover these at apply time; a hand-edited doc should fail the
+    # validate verb first.
+    try:
+        from .interpolate import InterpolationError, topo_order
+
+        topo_order({k: v for k, v in modules.items()
+                    if isinstance(v, dict)})
+    except InterpolationError as e:
+        errors.append(str(e))
+    except Exception:
+        pass
+
     # ${module.k.out} references anywhere in the doc.
     for s in _walk_strings(data):
         for expr in interpolation_exprs(s):
