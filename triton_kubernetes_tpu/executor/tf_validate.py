@@ -614,9 +614,11 @@ def validate_document(doc: Any, modules_root: Optional[str] = None,
         topo_order({k: v for k, v in modules.items()
                     if isinstance(v, dict)})
     except InterpolationError as e:
-        errors.append(str(e))
-    except Exception:
-        pass
+        # KeyError subclass: str() would requote the message.
+        errors.append(str(e.args[0]) if e.args else str(e))
+    except RecursionError:
+        errors.append("module dependency graph too deep to order "
+                      "(suspect a pathological interpolation chain)")
 
     # ${module.k.out} references anywhere in the doc.
     for s in _walk_strings(data):
