@@ -40,7 +40,7 @@ def get_cluster(ctx: WorkflowContext) -> Dict[str, Any]:
             outputs["unhealthy_nodes"] = dead
             outputs["hint"] = (
                 "node(s) not ready — replace with: destroy node "
-                "(--set node=<hostname>) then create node; agent details: "
+                "(--set hostname=<name>) then create node; agent details: "
                 + "; ".join(f"{h}: {health[h].get('reason') or 'NotReady'}"
                             for h in dead))
     return outputs
