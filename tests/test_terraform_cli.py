@@ -245,3 +245,50 @@ def test_output_reads_reuse_an_initialized_workdir(stub_tf, tmp_path):
     entries = [d for d in os.listdir(tmp_path / "tfcache")
                if not d.startswith(".")]
     assert len(entries) == 1 and entries[0].startswith("m1-")
+
+
+def test_concurrent_output_reads_single_init(tmp_path):
+    """Two processes reading the same doc concurrently: the flock ensures
+    exactly one `terraform init` runs (the other waits, then reuses the
+    initialized workdir); both reads succeed. Pins the cache's concurrency
+    design (round-4 review)."""
+    import stat
+    import subprocess
+    import sys
+    import textwrap
+
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    binary = tmp_path / "terraform-slow"
+    # init sleeps, making the init/read race window wide enough to matter.
+    binary.write_text(
+        "#!/usr/bin/env bash\nset -eu\n"
+        f"echo \"$@\" >> {cap}/argv.log\n"
+        "case \"$1\" in\n"
+        "  init) sleep 1 ;;\n"
+        "  output) echo '{}' ;;\n"
+        "esac\n")
+    binary.chmod(binary.stat().st_mode | stat.S_IEXEC)
+
+    prog = textwrap.dedent(f"""
+        from triton_kubernetes_tpu.executor.terraform import TerraformExecutor
+        from triton_kubernetes_tpu.state import StateDocument
+        doc = StateDocument("m1", {{"module": {{
+            "cluster-manager": {{
+                "source": "modules/gcp-manager", "name": "m1",
+                "gcp_path_to_credentials": "/c", "gcp_project_id": "p"}},
+        }}}})
+        ex = TerraformExecutor(binary={str(binary)!r}, stream_output=False,
+                               cache_dir={str(tmp_path / 'tfcache')!r})
+        print(ex.output(doc, "cluster-manager"))
+    """)
+    procs = [subprocess.Popen([sys.executable, "-c", prog],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for _ in range(2)]
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-2000:]
+    lines = (cap / "argv.log").read_text().splitlines()
+    assert lines.count("init -force-copy") == 1, lines
+    assert lines.count("output -json") == 2, lines
