@@ -8,6 +8,13 @@ line per configuration plus a final best-vs-baseline verdict; the winner
 (if >=2%) gets baked into bench.py like the round-3 block/batch sweeps.
 
     python scripts/tpu/bench_fused_ce.py [--steps 16] [--warmup 3]
+
+Status: written and harness-verified (CPU) in round 4, but the axon TPU
+tunnel was unreachable for the entire remainder of that round, so the
+on-chip sweep has not run yet — run it first thing when the chip is
+healthy. The fused head is exactness-pinned against the standard head
+(tests/test_train.py::test_fused_ce_matches_logits_path) and stays off
+by default until measured.
 """
 
 from __future__ import annotations
