@@ -3,7 +3,13 @@
 manager (POST /v3/clusters/<id>?action=generateKubeconfig — the call the
 reference's backup path makes, k8s-backup-manta/main.tf:28-39). Reads
 {manager_url, access_key, secret_key, cluster_id} on stdin, emits
-{config: <kubeconfig>} on stdout. Stdlib-only, like register_cluster.py."""
+{config: <kubeconfig>} on stdout. Stdlib-only, like register_cluster.py.
+
+Trust model matches register_cluster.py: the public cacerts endpoint is
+fetched first over the un-pinned bootstrap context WITHOUT credentials,
+then every authed request runs on an SSL context anchored to exactly that
+PEM — the admin keys never cross an unverified channel.
+"""
 
 import base64
 import json
@@ -12,15 +18,37 @@ import sys
 import urllib.request
 
 
-def main():
-    q = json.load(sys.stdin)
-    url = (f"{q['manager_url'].rstrip('/')}/v3/clusters/"
-           f"{q['cluster_id']}?action=generateKubeconfig")
-    auth = base64.b64encode(
-        f"{q['access_key']}:{q['secret_key']}".encode()).decode()
+def _bootstrap_context():
+    # Un-pinned (the reference's curl -k): only ever carries the public,
+    # unauthenticated cacerts fetch.
     ctx = ssl.create_default_context()
     ctx.check_hostname = False
     ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+def _pinned_context(base):
+    """Fetch /v3/settings/cacerts (public, no auth header) and return an
+    SSL context trusting exactly that PEM; None for plain-http managers."""
+    if not base.startswith("https://"):
+        return None
+    req = urllib.request.Request(f"{base}/v3/settings/cacerts")
+    with urllib.request.urlopen(req, timeout=60,
+                                context=_bootstrap_context()) as resp:
+        cacerts = json.load(resp)["value"]
+    ctx = ssl.create_default_context(cadata=cacerts)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def main():
+    q = json.load(sys.stdin)
+    base = q["manager_url"].rstrip("/")
+    url = f"{base}/v3/clusters/{q['cluster_id']}?action=generateKubeconfig"
+    auth = base64.b64encode(
+        f"{q['access_key']}:{q['secret_key']}".encode()).decode()
+    ctx = _pinned_context(base)
     req = urllib.request.Request(url, data=b"{}", method="POST", headers={
         "Content-Type": "application/json",
         "Authorization": f"Basic {auth}",

@@ -37,13 +37,16 @@ def _context():
     return ctx
 
 
-def pin(base, auth):
+def pin(base):
     """Fetch the manager's cacerts, then anchor every later request's SSL
     context to exactly that PEM: a relay MITM cannot complete subsequent
     handshakes without the manager's private key, so the emitted
     ca_checksum really belongs to the server that answers the API calls.
-    Plain-http managers (dev mode) have nothing to pin."""
-    cacerts = request("GET", f"{base}/v3/settings/cacerts", auth)["value"]
+    The bootstrap fetch is unauthenticated (the endpoint is public, cf.
+    ManagerClient.cacerts authed=False) so the admin keys never cross the
+    un-verified channel. Plain-http managers (dev mode) have nothing to
+    pin."""
+    cacerts = request("GET", f"{base}/v3/settings/cacerts", None)["value"]
     if base.startswith("https://"):
         ctx = ssl.create_default_context(cadata=cacerts)
         ctx.check_hostname = False
@@ -54,11 +57,12 @@ def pin(base, auth):
 
 def request(method, url, auth, body=None):
     data = json.dumps(body).encode() if body is not None else None
-    req = urllib.request.Request(url, data=data, method=method, headers={
-        "Content-Type": "application/json",
-        "Authorization": "Basic "
-        + base64.b64encode(auth.encode()).decode(),
-    })
+    headers = {"Content-Type": "application/json"}
+    if auth is not None:
+        headers["Authorization"] = ("Basic "
+                                    + base64.b64encode(auth.encode()).decode())
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
     with urllib.request.urlopen(req, timeout=60, context=_context()) as resp:
         return json.load(resp)
 
@@ -70,7 +74,7 @@ def main():
 
     # Trust bootstrap first: all the calls below run TLS-verified against
     # the served cert, and its sha256 is the checksum this program emits.
-    cacerts = pin(base, auth)
+    cacerts = pin(base)
     checksum = hashlib.sha256(cacerts.encode()).hexdigest()
 
     # Create-or-get: look the cluster up by name first

@@ -224,6 +224,37 @@ def test_register_cluster_data_external_against_live_server(server):
         r1["ca_checksum"]
 
 
+def test_register_cluster_bootstrap_cacerts_is_unauthenticated():
+    """The first request the data.external program makes runs over the
+    un-pinned CERT_NONE context — the admin keys must NOT ride it (round-4
+    advisory). The cacerts endpoint is public (ManagerClient.cacerts uses
+    authed=False), so the bootstrap fetch sends no Authorization header;
+    every authed call happens only after pin() anchored the channel."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "register_cluster",
+        f"{default_modules_root()}/files/register_cluster.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    seen = []
+    real_request = mod.request
+
+    def spy(method, url, auth, body=None):
+        seen.append((url, auth))
+        return {"value": "PEM"}
+
+    mod.request = spy
+    try:
+        # http base: pin() fetches but has no TLS channel to anchor, so the
+        # spy PEM never meets ssl; the header contract is what's under test.
+        mod.pin("http://mgr.example")
+    finally:
+        mod.request = real_request
+    assert seen == [("http://mgr.example/v3/settings/cacerts", None)]
+
+
 def test_simulator_and_server_share_the_protocol():
     """CloudSimulator is a second implementation of manager/protocol.py: the
     ids, tokens, and checksums it hands to modules equal what a real server
@@ -395,6 +426,27 @@ def test_register_cluster_program_over_tls(tls_server):
     r = json.loads(out.stdout)
     assert r["ca_checksum"] == hashlib.sha256(
         tls_server.state.tls_cert.encode()).hexdigest()
+
+
+def test_generate_kubeconfig_program_over_tls(tls_server):
+    """The kubeconfig data.external program (k8s-backup-manta analog) runs
+    its authed call on a context pinned to the served cacerts — same trust
+    model as register_cluster.py (round-4 advisory follow-up)."""
+    script = f"{default_modules_root()}/files/generate_kubeconfig.py"
+    creds = ManagerClient(tls_server.url).init_token(url=tls_server.url)
+    c = ManagerClient(tls_server.url, creds["access_key"],
+                      creds["secret_key"])
+    cluster = c.create_or_get_cluster("bk")
+    query = json.dumps({
+        "manager_url": tls_server.url,
+        "access_key": creds["access_key"],
+        "secret_key": creds["secret_key"],
+        "cluster_id": cluster["id"],
+    })
+    out = subprocess.run([sys.executable, script], input=query,
+                         capture_output=True, text=True, check=True)
+    cfg = json.loads(json.loads(out.stdout)["config"])
+    assert cfg["clusters"][0]["cluster"]["server"]
 
 
 def test_tls_upgrade_repins_existing_clusters(tmp_path):

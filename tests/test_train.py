@@ -235,12 +235,21 @@ def test_flash_forfeit_is_loud(cpu_mesh_devices, monkeypatch):
     assert np.isfinite(float(metrics["loss"]))
 
 
-def test_fused_ce_matches_logits_path(cpu_mesh_devices):
+@pytest.mark.parametrize(
+    "dtype,loss_rtol,gn_rtol,p_rtol,p_atol",
+    [("float32", 1e-5, 1e-4, 5e-4, 5e-6),
+     ("bfloat16", 1e-4, 2e-2, 2e-2, 2e-3)])
+def test_fused_ce_matches_logits_path(cpu_mesh_devices, dtype, loss_rtol,
+                                      gn_rtol, p_rtol, p_atol):
     """config.fused_ce computes the identical loss and step without ever
     materializing [B,S,V] logits (ops/fused_ce.py); numerics pinned
-    against the standard head on the same mesh, params, and batch."""
-    cfg = get_config("llama-test", dtype="float32")
-    cfg_fused = get_config("llama-test", dtype="float32", fused_ce=True,
+    against the standard head on the same mesh, params, and batch. bf16
+    (the dtype the flag ships under, llama3-bench) holds within round-off
+    because the chunked backward keeps the f32 logit cotangent in the
+    dh/dW contractions (round-4 advisory); loss stays tight in both since
+    forward accumulation is f32 either way."""
+    cfg = get_config("llama-test", dtype=dtype)
+    cfg_fused = get_config("llama-test", dtype=dtype, fused_ce=True,
                            ce_chunk=64)
     mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
     opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
@@ -256,16 +265,16 @@ def test_fused_ce_matches_logits_path(cpu_mesh_devices):
     state2, metrics2 = step_f(state, {"tokens": tokens})
 
     np.testing.assert_allclose(float(metrics1["loss"]),
-                               float(metrics2["loss"]), rtol=1e-5)
+                               float(metrics2["loss"]), rtol=loss_rtol)
     np.testing.assert_allclose(float(metrics1["grad_norm"]),
-                               float(metrics2["grad_norm"]), rtol=1e-4)
+                               float(metrics2["grad_norm"]), rtol=gn_rtol)
     # And the updated params agree (gradients flowed identically through
     # the chunked backward).
-    a = jax.tree.leaves(state1.params)
-    b = jax.tree.leaves(state2.params)
-    for x, y in zip(a, b):
-        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
-                                   rtol=5e-4, atol=5e-6)
+    for x, y in zip(jax.tree.leaves(state1.params),
+                    jax.tree.leaves(state2.params)):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32),
+                                   rtol=p_rtol, atol=p_atol)
 
 
 def test_checkpoint_elastic_reshard_across_meshes(tmp_path, cpu_mesh_devices):

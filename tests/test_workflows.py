@@ -459,3 +459,48 @@ def test_get_cluster_consumes_notready_from_live_manager(monkeypatch):
     assert outputs["unhealthy_nodes"] == ["host-dead"]
     assert "destroy node" in outputs["hint"]
     assert "host-dead" in outputs["hint"]
+
+
+def test_get_cluster_warns_on_ca_checksum_mismatch(capsys):
+    """A CA pin mismatch during the live-health read is a possible
+    active-MITM indicator: it must surface as a warning, not be silently
+    indistinguishable from the manager being down (round-4 advisory).
+    Against a REAL TLS ManagerServer whose served cert cannot match the
+    bogus pinned checksum."""
+    from triton_kubernetes_tpu.manager import ManagerClient, ManagerServer
+
+    with ManagerServer("m1", tls=True) as srv:
+        client = ManagerClient(srv.url)
+        creds = client.init_token(url=srv.url)
+        cluster = client.create_or_get_cluster("dev")
+        client.register_node(cluster["registration_token"], "host-ok",
+                             ["worker"])
+
+        class StubExecutor:
+            def output(self, state, key):
+                if key == "cluster-manager":
+                    return {"manager_url": srv.url,
+                            "manager_access_key": creds["access_key"],
+                            "manager_secret_key": creds["secret_key"]}
+                return {"cluster_id": cluster["id"],
+                        "ca_checksum": "f" * 64}
+
+        be = MemoryBackend()
+        doc = be.state("m1")
+        doc.set_manager({"source": "modules/bare-metal-manager",
+                         "name": "m1", "host": "10.0.0.1"})
+        doc.add_cluster("gcp-tpu", "dev", {"source": "modules/gcp-tpu-k8s",
+                                           "name": "dev"})
+        be.persist(doc)
+
+        ctx = make_ctx(values={"cluster_manager": "m1",
+                               "cluster_name": "dev"},
+                       backend=be)
+        ctx = WorkflowContext(backend=be, executor=StubExecutor(),
+                              resolver=ctx.resolver)
+        outputs = get_cluster(ctx)
+
+    # The live read was refused (no node_health from a mismatched channel)...
+    assert "node_health" not in outputs
+    # ...and the operator was told why, by name.
+    assert "CA checksum mismatch" in capsys.readouterr().err

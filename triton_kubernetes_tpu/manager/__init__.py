@@ -16,9 +16,9 @@ rancher_cluster.sh:17-100). This package IS that control plane, rebuilt:
   (``serve``, ``init-token``) invoked by files/install_manager.sh.tpl.
 """
 
-from .client import ManagerClient, ManagerClientError
+from .client import CAPinMismatchError, ManagerClient, ManagerClientError
 from .protocol import ProtocolError
 from .server import ManagerServer
 
-__all__ = ["ManagerClient", "ManagerClientError", "ManagerServer",
-           "ProtocolError"]
+__all__ = ["CAPinMismatchError", "ManagerClient", "ManagerClientError",
+           "ManagerServer", "ProtocolError"]

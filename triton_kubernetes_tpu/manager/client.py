@@ -26,6 +26,13 @@ class ManagerClientError(RuntimeError):
     pass
 
 
+class CAPinMismatchError(ManagerClientError):
+    """The served cacerts hash does not equal the pinned checksum — a
+    possible active MITM (or a rotated manager cert). Typed so consumers
+    can distinguish this from the manager merely being unreachable without
+    string-matching the message."""
+
+
 def _insecure_context() -> ssl.SSLContext:
     # The un-pinned bootstrap context (the reference's curl -k): used only
     # to fetch /v3/settings/cacerts before a pin exists. It authenticates
@@ -73,7 +80,7 @@ class ManagerClient:
         served_pem = self.cacerts()
         served = hashlib.sha256(served_pem.encode()).hexdigest()
         if ca_checksum and served != ca_checksum:
-            raise ManagerClientError(
+            raise CAPinMismatchError(
                 f"CA checksum mismatch: pinned {ca_checksum[:12]}..., "
                 f"server {served[:12]}...")
         if self.url.startswith("https://"):

@@ -9,7 +9,9 @@ vocab projection in chunks with an online logsumexp, so peak memory is
 chunk (flash-attention-style) and accumulates dH and dW chunkwise.
 
 Exactness: same f32 accumulation as the reference path — pinned against
-``optax.softmax_cross_entropy_with_integer_labels`` in tests/test_ops.py.
+``optax.softmax_cross_entropy_with_integer_labels`` in
+tests/test_train.py::test_fused_ce_matches_logits_path (f32 exact,
+bf16 within tolerance).
 """
 
 from __future__ import annotations
@@ -92,10 +94,12 @@ def _backward(chunk, residuals, g):
         onehot = (jnp.arange(chunk)[None, :] == idx[:, None]) & \
             in_chunk[:, None]
         dlogits = (p - onehot.astype(jnp.float32)) * g[:, None]  # [T, C]
-        dl = dlogits.astype(dtype)
-        dh = dh + jnp.einsum("tc,dc->td", dl, w_chunk.astype(dtype),
+        # Keep the f32 cotangent in both contractions (cast only the
+        # w/h operands), matching the standard head's einsum VJP — a
+        # bf16 round-trip here would drift gradients off the logits path.
+        dh = dh + jnp.einsum("tc,dc->td", dlogits, w_chunk.astype(dtype),
                              preferred_element_type=jnp.float32)
-        dw_chunk = jnp.einsum("td,tc->dc", h, dl,
+        dw_chunk = jnp.einsum("td,tc->dc", h, dlogits,
                               preferred_element_type=jnp.float32)
         return dh, dw_chunk
 

@@ -94,14 +94,30 @@ def _live_manager_health(ctx: WorkflowContext, state,
     url = mgr.get("manager_url", "")
     if not url.startswith(("http://", "https://")):
         return None
-    try:
-        from ..manager.client import ManagerClient
+    from ..manager.client import CAPinMismatchError, ManagerClient
 
-        client = ManagerClient(url, mgr.get("manager_access_key", ""),
-                               mgr.get("manager_secret_key", ""),
-                               retries=0, timeout=3.0)
-        if url.startswith("https://") and ca_checksum:
+    client = ManagerClient(url, mgr.get("manager_access_key", ""),
+                           mgr.get("manager_secret_key", ""),
+                           retries=0, timeout=3.0)
+    try:
+        if url.startswith("https://"):
+            # Pin before ANY authed request. With no stored checksum the
+            # pin is trust-on-first-use (anchor to the served PEM): weaker
+            # than a checksum, but the admin keys never ride a CERT_NONE
+            # channel.
             client.pin_ca(ca_checksum)
+    except CAPinMismatchError as e:
+        # A possible active-MITM indicator — must not be silently
+        # indistinguishable from the manager being down.
+        from ..utils.logging import get_logger
+
+        get_logger().log(
+            "warn", "manager CA checksum mismatch — possible MITM or "
+            "rotated cert; skipping live health", detail=str(e))
+        return None
+    except Exception:
+        return None
+    try:
         nodes = client.nodes(cluster_id)
     except Exception:
         return None
