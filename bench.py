@@ -127,6 +127,14 @@ def _child() -> None:
     v5p_peak = TPU_GENERATIONS["v5p"].peak_bf16_tflops
     target_tps_8b = (0.40 * v5p_peak * 1e12
                      / flops_per_token(cfg_8b, cfg_8b.max_seq_len))
+    # Roofline transfer of the proxy MFU to the 8B/v5p gate (the argued
+    # bound, not a hope — train/mfu.py project_mfu + workloads.md): only
+    # the attention-share debit is applied; the dimension and ridge
+    # factors that favor 8B/v5p are clamped to 1.
+    from triton_kubernetes_tpu.train.mfu import project_mfu
+
+    projected_8b_v5p = project_mfu(
+        achieved_mfu, config, seq_len, cfg_8b, cfg_8b.max_seq_len)
 
     print(json.dumps({
         "metric": f"{config.name}_train_tokens_per_sec_per_chip",
@@ -148,6 +156,7 @@ def _child() -> None:
         "target_8b_this_chip_tokens_per_sec_per_chip": round(
             0.40 * peak * 1e12
             / flops_per_token(cfg_8b, cfg_8b.max_seq_len), 1),
+        "projected_8b_v5p_mfu": round(projected_8b_v5p, 4),
     }), flush=True)
 
 

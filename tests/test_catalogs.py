@@ -249,6 +249,58 @@ def test_azure_choices_seam_and_degradation(azure_api):
     assert dead.choices("azure", "locations") is None
 
 
+def test_auth_failure_warns_transient_stays_silent(capsys):
+    """Round-4 verdict #5: a credential rejection (401) emits one warning
+    naming the provider before the static fallback; a dead endpoint
+    (transient) stays silent. Both still return None (static takes over)."""
+    from triton_kubernetes_tpu.catalogs.azure import LiveAzureCatalog
+
+    class Unauthorized(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b'{"error": {"code": "InvalidAuthenticationToken"}}'
+            self.send_response(401)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Unauthorized)
+    t = threading.Thread(
+        target=lambda: httpd.serve_forever(poll_interval=0.05), daemon=True)
+    t.start()
+    try:
+        cat = LiveAzureCatalog(
+            subscription_id="s",
+            management_endpoint=f"http://127.0.0.1:{httpd.server_address[1]}")
+        assert cat.choices("azure", "locations") is None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    err = capsys.readouterr().err
+    assert "azure live catalog rejected the configured credentials" in err
+    assert "401" in err
+
+    # Transient: nothing listening — silent fallback, no warning line.
+    dead = LiveAzureCatalog(subscription_id="s",
+                            management_endpoint="http://127.0.0.1:9")
+    assert dead.choices("azure", "locations") is None
+    assert capsys.readouterr().err == ""
+
+
+def test_triton_bad_key_material_warns(triton_api, tmp_path, capsys):
+    """A missing/garbage signing key is operator config error, not a flaky
+    network: the triton catalog says so before degrading."""
+    from triton_kubernetes_tpu.catalogs.triton import LiveTritonCatalog
+
+    cat = LiveTritonCatalog(account="acct", url=triton_api,
+                            key_path=str(tmp_path / "nope.pem"),
+                            key_id="ab:cd", authenticated=True)
+    assert cat.choices("triton", "packages") is None
+    assert "cannot sign requests" in capsys.readouterr().err
+
+
 def test_azure_workflow_validates_against_live_catalog(azure_api):
     """create manager (azure) accepts a location only the live API knows
     and rejects one neither the API nor the static list has — catalog:

@@ -7,8 +7,10 @@ and compiled against 64- and 256-device virtual CPU meshes (the same
 SPMD program a v5p-64 / v5p-256 slice would run), asserting
 
 (i)   the step lowers + compiles at all (sharding rules compose at scale);
-(ii)  per-chip parameter + optimizer bytes fit the target generation's HBM
-      (topology/slices.py capacity tables) with headroom for activations;
+(ii)  TOTAL per-chip memory — donated state + XLA temp (activations,
+      collective buffers) + un-aliased outputs — fits the target
+      generation's HBM (topology/slices.py capacity tables) with margin;
+      a failing-by-design case proves the assertion bites;
 (iii) the compiled HLO carries the intended collectives (MoE all-to-all on
       the fsdp×expert mesh) and the attention wrapper selected the
       shard-mapped kernel path with zero dense-einsum forfeits.
@@ -23,6 +25,10 @@ import subprocess
 import sys
 
 import pytest
+
+# Every case is a subprocess AOT compile at 64-256 virtual devices —
+# minutes, not seconds; `make test-fast` deselects them.
+pytestmark = pytest.mark.slow
 
 _SCRIPT = r"""
 import json, sys
@@ -39,7 +45,7 @@ from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh
 from triton_kubernetes_tpu.train import make_optimizer, make_train_step
 from triton_kubernetes_tpu.train import trainer
 
-cfg = get_config("{config}")
+cfg = get_config("{config}", **{cfg_overrides})
 mesh = create_mesh(MeshConfig(**{mesh_kwargs}))
 opt = make_optimizer()
 
@@ -77,11 +83,39 @@ batch_s = {{"tokens": jax.ShapeDtypeStruct(
 
 step = make_train_step(cfg, mesh, opt, attention_fn=attn)
 compiled = step.lower(state_s, batch_s).compile()
-ma = compiled.memory_analysis()
 txt = compiled.as_text()
+
+# Memory contract on a memory-faithful program: interpret-mode pallas
+# inflates temps to full-score scale on CPU (an emulator artifact — the
+# real kernel streams blocks through VMEM), so the HBM numbers come from
+# a second compile with the pure-XLA blockwise flash twin
+# (ops/blockwise_attention.py, custom-VJP recompute backward). Seq-sharded
+# meshes already use ring attention — itself XLA and memory-faithful — so
+# the first compile's analysis is reused there.
+if mesh.shape["seq"] > 1:
+    ma = compiled.memory_analysis()
+else:
+    from triton_kubernetes_tpu.ops.blockwise_attention import (
+        blockwise_attention)
+
+    # shard_map like the flash wrapper (trainer._resolve_attention): left
+    # to GSPMD, the blockwise scan's reshaped KV stacks lose the batch
+    # sharding at large device counts and the whole attention replicates
+    # per chip — the exact failure the wrapper exists to prevent.
+    bw_spec = P((trainer.AXIS_DATA, trainer.AXIS_FSDP), None,
+                trainer.AXIS_TENSOR, None)
+    bw = jax.shard_map(
+        lambda q, k, v: blockwise_attention(q, k, v),
+        mesh=mesh, in_specs=(bw_spec, bw_spec, bw_spec),
+        out_specs=bw_spec, check_vma=False)
+    step_mem = make_train_step(
+        cfg, mesh, opt, attention_fn=lambda q, k, v, positions: bw(q, k, v))
+    ma = step_mem.lower(state_s, batch_s).compile().memory_analysis()
 json.dump({{
     "argument_bytes": ma.argument_size_in_bytes,
     "alias_bytes": ma.alias_size_in_bytes,
+    "temp_bytes": ma.temp_size_in_bytes,
+    "output_bytes": ma.output_size_in_bytes,
     "all_to_all": txt.count("all-to-all"),
     "all_gather": txt.count("all-gather"),
     "forfeits": list(getattr(attn, "forfeits", ["<wrapper missing>"])),
@@ -119,7 +153,8 @@ CASES = {
 def _run_case(case):
     script = _SCRIPT.format(
         config=case["config"], n_devices=case["n_devices"],
-        mesh_kwargs=repr(case["mesh_kwargs"]), batch=case["batch"])
+        mesh_kwargs=repr(case["mesh_kwargs"]), batch=case["batch"],
+        cfg_overrides=repr(case.get("cfg_overrides", {})))
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     res = subprocess.run([sys.executable, "-c", script],
@@ -127,6 +162,21 @@ def _run_case(case):
                          env=env)
     assert res.returncode == 0, res.stderr[-4000:]
     return json.loads(res.stdout)
+
+
+def _peak_bytes_per_chip(out):
+    """Peak HBM the compiled step needs: the donated state (argument
+    bytes, live for the whole step) + XLA temp (activations, remat
+    buffers, collective scratch) + any output NOT aliased onto an input
+    (donation makes output ≈ alias, so this term is normally 0)."""
+    return (out["argument_bytes"] + out["temp_bytes"]
+            + max(0, out["output_bytes"] - out["alias_bytes"]))
+
+
+def _hbm_bytes(generation):
+    from triton_kubernetes_tpu.topology.slices import TPU_GENERATIONS
+
+    return TPU_GENERATIONS[generation].hbm_gb_per_chip * 2**30
 
 
 @pytest.mark.parametrize("name", sorted(CASES))
@@ -141,15 +191,34 @@ def test_flagship_aot_compiles_and_fits(name):
         # The MoE router all-to-all must be in the compiled program.
         assert out["all_to_all"] > 0, out
 
-    # (ii) HBM fit: the donated state (master params + Adam moments =
-    # argument bytes, aliased in place) must leave >= 40% of the chip for
-    # bf16 working copies, activations, and XLA temp.
-    from triton_kubernetes_tpu.topology.slices import TPU_GENERATIONS
-
-    hbm = TPU_GENERATIONS[case["generation"]].hbm_gb_per_chip * 2**30
-    per_chip = out["argument_bytes"]  # memory_analysis reports per-device
-    assert per_chip <= 0.6 * hbm, (
-        f"{name}: state {per_chip/2**30:.1f} GiB/chip exceeds 60% of "
+    # (ii) HBM fit, TOTAL: donated state + XLA temp + un-aliased outputs
+    # (memory_analysis reports per-device bytes). Round-4 verdict #3: the
+    # old contract bounded only argument bytes, so an activation/temp
+    # blowup passed the test and OOMed on the slice. Margin 0.9 leaves
+    # room for runtime overheads memory_analysis cannot see (framework
+    # buffers, infeed). Calibrated: 8B/64dev peaks ~9.1 GiB/chip,
+    # 70B/64dev ~47.2 GiB/chip vs v5p 95 GiB.
+    hbm = _hbm_bytes(case["generation"])
+    margin = case.get("hbm_margin", 0.9)
+    peak = _peak_bytes_per_chip(out)
+    assert peak <= margin * hbm, (
+        f"{name}: peak {peak/2**30:.1f} GiB/chip (state "
+        f"{out['argument_bytes']/2**30:.1f} + temp "
+        f"{out['temp_bytes']/2**30:.1f}) exceeds {margin:.0%} of "
         f"{case['generation']} HBM ({hbm/2**30:.0f} GiB)")
     # Donation really aliases the state (no double-buffered params).
     assert out["alias_bytes"] >= 0.9 * out["argument_bytes"], out
+
+
+def test_aot_hbm_contract_bites():
+    """Failing-by-design: Llama-3-70B at global batch 64 on the same
+    v5p-64 mesh needs ~8x the batch-8 temp (~280 GiB/chip) — the total-
+    memory contract above must REJECT it. Guards against the contract
+    regressing into one a blowup can pass (the round-4 hole)."""
+    case = dict(CASES["llama3-70b-v5p64"], batch=64)
+    out = _run_case(case)
+    hbm = _hbm_bytes(case["generation"])
+    peak = _peak_bytes_per_chip(out)
+    assert peak > 0.9 * hbm, (
+        f"expected batch-64 70B to exceed 90% of v5p HBM, got "
+        f"{peak/2**30:.1f} GiB/chip — recalibrate the failing case")

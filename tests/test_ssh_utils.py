@@ -47,3 +47,63 @@ def test_garbage_key_errors(tmp_path):
     path.write_text("not a key")
     with pytest.raises(SSHKeyError, match="unsupported"):
         public_key_fingerprint_from_private_key(str(path))
+
+
+def _encrypted_key(tmp_path, passphrase=b"hunter2"):
+    key = ed25519.Ed25519PrivateKey.generate()
+    path = tmp_path / "enc_key"
+    path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.BestAvailableEncryption(passphrase)))
+    return key, path
+
+
+def test_encrypted_key_without_passphrase_says_so(tmp_path):
+    """The error must name the fix (a passphrase), not claim the format is
+    unsupported — it feeds the interactive prompt fallback."""
+    _, path = _encrypted_key(tmp_path)
+    with pytest.raises(SSHKeyError, match="needs a passphrase"):
+        public_key_fingerprint_from_private_key(str(path))
+
+
+def test_encrypted_key_with_passphrase_derives(tmp_path):
+    key, path = _encrypted_key(tmp_path)
+    fp = public_key_fingerprint_from_private_key(str(path), b"hunter2")
+    assert fp == _expected_fp(key)
+
+
+def test_encrypted_key_wrong_passphrase_errors(tmp_path):
+    _, path = _encrypted_key(tmp_path)
+    with pytest.raises(SSHKeyError, match="cannot decrypt"):
+        public_key_fingerprint_from_private_key(str(path), b"wrong")
+
+
+def test_triton_creds_prompt_passphrase_interactive(tmp_path):
+    """Reference parity (util/ssh_utils.go:22-28): an encrypted key in an
+    interactive session prompts (masked seam) for the passphrase and
+    derives the fingerprint; non-interactive keeps the clean error."""
+    from triton_kubernetes_tpu.config import (
+        Config, InputResolver, ScriptedPrompter)
+    from triton_kubernetes_tpu.workflows.common import (
+        WorkflowContext, WorkflowError)
+    from triton_kubernetes_tpu.workflows.providers.triton import _creds
+
+    key, path = _encrypted_key(tmp_path)
+
+    def make_ctx(non_interactive, answers=()):
+        cfg = Config()
+        cfg.set("triton_key_path", str(path))
+        cfg.set("triton_account", "acct")
+        cfg.set("triton_url", "https://cloudapi.example")
+        return WorkflowContext(
+            backend=None, executor=None,
+            resolver=InputResolver(cfg, ScriptedPrompter(list(answers)),
+                                   non_interactive))
+
+    # Interactive order: Triton Key ID prompt (blank -> derive from the
+    # key file) then the passphrase prompt.
+    creds = _creds(make_ctx(False, ["", "hunter2"]))
+    assert creds["triton_key_id"] == _expected_fp(key)
+
+    with pytest.raises(WorkflowError, match="passphrase"):
+        _creds(make_ctx(True))

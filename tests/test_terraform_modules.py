@@ -152,27 +152,39 @@ def test_workdir_emits_golden_main_tf_json(tmp_path):
 
 
 @pytest.mark.parametrize("name", HCL_MODULES)
-def test_terraform_validate(name, tmp_path):
+def test_terraform_validate(name):
     """Every module passes structural validation — root-block grammar,
     reference resolution (${var.x}/${local.x}/resource refs), required
     resource attributes, depends_on targets, file references, templatefile
-    variable contracts. Runs everywhere (no binary needed); when a real
-    `terraform` exists on PATH, `init -backend=false && validate` runs too
-    as the authoritative cross-check."""
+    variable contracts. Runs everywhere (no binary needed). The
+    authoritative real-binary cross-check is its OWN test below so its
+    absence is a visible SKIP, never silent green."""
     errors = validate_module_dir(os.path.join(ROOT, name))
     assert errors == []
 
-    if shutil.which("terraform"):
-        src = os.path.join(ROOT, name)
-        dst = tmp_path / name
-        shutil.copytree(src, dst)
-        subprocess.run(
-            ["terraform", "init", "-backend=false", "-input=false"],
-            cwd=dst, check=True, capture_output=True)
-        res = subprocess.run(
-            ["terraform", "validate", "-no-color"],
-            cwd=dst, check=False, capture_output=True, text=True)
-        assert res.returncode == 0, res.stdout + res.stderr
+
+@pytest.mark.parametrize("name", HCL_MODULES)
+@pytest.mark.skipif(
+    shutil.which("terraform") is None,
+    reason="terraform binary not on PATH — the authoritative "
+    "`terraform init -backend=false && validate` cross-check DID NOT RUN "
+    "(structural validation above still did). CI installs the binary and "
+    "publishes the transcript; see docs/ci-evidence/README.md")
+def test_terraform_binary_validate(name, tmp_path):
+    """The real `terraform` binary parses and validates every module —
+    the reference's bar, where the binary ran on every user invocation
+    (shell/run_terraform.go:95-104). scripts/ci/terraform_evidence.sh
+    produces the committed transcript from the same commands."""
+    src = os.path.join(ROOT, name)
+    dst = tmp_path / name
+    shutil.copytree(src, dst)
+    subprocess.run(
+        ["terraform", "init", "-backend=false", "-input=false"],
+        cwd=dst, check=True, capture_output=True)
+    res = subprocess.run(
+        ["terraform", "validate", "-no-color"],
+        cwd=dst, check=False, capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
 
 
 # ---------------------------------------------------------------------------
@@ -232,6 +244,62 @@ def test_validator_catches_missing_required_attr(tmp_path):
     _edit(dst, "main.tf.json", strip_ami)
     errs = validate_module_dir(str(dst))
     assert any("machine_type" in e for e in errs), errs
+
+
+def test_validator_catches_unknown_attribute(tmp_path):
+    """The round-4 hole: a typo'd attribute NAME (`subnet_idd = ...`)
+    passed the old required-attrs-only check. KNOWN_ATTRS now flags it."""
+    dst = _copy_module(tmp_path)
+    _edit(dst, "main.tf.json",
+          lambda d: d["resource"]["google_compute_instance"]["manager"]
+          .__setitem__("machine_typ", "n1-standard-4"))
+    errs = validate_module_dir(str(dst))
+    assert any("unknown attribute 'machine_typ'" in e for e in errs), errs
+
+
+def test_validator_catches_unknown_attr_in_azure_nic(tmp_path):
+    dst = _copy_module(tmp_path, "azure-manager")
+    _edit(dst, "main.tf.json",
+          lambda d: d["resource"]["azurerm_network_interface"]["manager"]
+          .__setitem__("subnet_idd", "x"))
+    errs = validate_module_dir(str(dst))
+    assert any("subnet_idd" in e for e in errs), errs
+
+
+def test_validator_catches_misshapen_nested_block(tmp_path):
+    """A nested-block key typo (ip_configuration.subnet_idd) and a
+    non-object block body are both provider-schema violations terraform
+    rejects; NESTED_BLOCK_ATTRS catches them without the binary."""
+    dst = _copy_module(tmp_path, "azure-manager")
+
+    def typo_key(d):
+        nic = d["resource"]["azurerm_network_interface"]["manager"]
+        ipc = nic["ip_configuration"]
+        ipc = ipc[0] if isinstance(ipc, list) else ipc
+        ipc["subnet_idd"] = ipc.pop("subnet_id")
+    _edit(dst, "main.tf.json", typo_key)
+    errs = validate_module_dir(str(dst))
+    assert any("unknown key 'subnet_idd' in block 'ip_configuration'" in e
+               for e in errs), errs
+
+    dst2 = _copy_module(tmp_path / "two", "azure-manager")
+    _edit(dst2, "main.tf.json",
+          lambda d: d["resource"]["azurerm_network_interface"]["manager"]
+          .__setitem__("ip_configuration", "oops"))
+    errs2 = validate_module_dir(str(dst2))
+    assert any("block 'ip_configuration' must be an object" in e
+               for e in errs2), errs2
+
+
+def test_validator_does_not_check_freeform_map_keys(tmp_path):
+    """tags/triggers/labels are free-form maps — arbitrary keys must stay
+    legal or the whole tree would false-positive."""
+    dst = _copy_module(tmp_path)
+    _edit(dst, "main.tf.json",
+          lambda d: d["resource"]["google_compute_instance"]["manager"]
+          .setdefault("labels", {}).__setitem__("anything_goes_here", "v"))
+    errs = validate_module_dir(str(dst))
+    assert errs == [], errs
 
 
 def test_validator_catches_dead_depends_on(tmp_path):

@@ -43,6 +43,28 @@ def test_mfu_roundtrip():
     assert abs(mfu(tps, cfg, 8192, 459 * 64) - 0.4) < 1e-9
 
 
+def test_project_mfu_8b_gate_math():
+    """The roofline transfer bench.py publishes (workloads.md derivation):
+    identical mixes are the identity, a larger attention share debits, and
+    the round-3 chip truth (0.542 on the proxy) projects above the 0.40
+    BASELINE gate with the upward factors withheld."""
+    from triton_kubernetes_tpu.train.mfu import (
+        attention_flops_fraction, project_mfu)
+
+    proxy = get_config("llama3-bench")
+    target = get_config("llama3-8b")
+    # Identity: projecting a config onto itself returns the measurement.
+    assert abs(project_mfu(0.5, proxy, 2048, proxy, 2048) - 0.5) < 1e-12
+    # 8B@8192 has the larger attention share -> a debit, but a bounded one.
+    assert attention_flops_fraction(target, 8192) > \
+        attention_flops_fraction(proxy, 2048)
+    projected = project_mfu(0.542, proxy, 2048, target, 8192)
+    assert 0.40 < projected < 0.542
+    # Clamp: an (impossible) measured 1.0 cannot project above the
+    # target's own mix ceiling.
+    assert project_mfu(1.0, proxy, 2048, target, 8192) <= 1.0
+
+
 def _mk(config_name="llama-test", mesh_cfg=None, **cfg_overrides):
     cfg = get_config(config_name, **cfg_overrides)
     mesh = create_mesh(mesh_cfg or MeshConfig(fsdp=4, tensor=2))

@@ -461,6 +461,96 @@ def test_get_cluster_consumes_notready_from_live_manager(monkeypatch):
     assert "host-dead" in outputs["hint"]
 
 
+def test_repair_node_replaces_unhealthy_and_comes_back_ready():
+    """The failure-detection loop closed end-to-end (round-4 verdict #9,
+    optional): a node goes NotReady (the same health sources that feed the
+    `get cluster` hint — stale agent heartbeat on the live manager, probe
+    failure on the driver view), `repair node` auto-targets it, destroys
+    and re-creates the SAME module config, and the replacement registers
+    Ready under the same hostname."""
+    from triton_kubernetes_tpu.backends import MemoryBackend
+    from triton_kubernetes_tpu.config import Config, InputResolver
+    from triton_kubernetes_tpu.executor import LocalExecutor
+    from triton_kubernetes_tpu.executor.engine import (
+        load_executor_state, save_executor_state)
+    from triton_kubernetes_tpu.workflows import (
+        WorkflowContext, get_cluster, new_cluster, new_manager, repair_node)
+
+    def ctx_for(values, be, ex):
+        cfg = Config()
+        for k, v in values.items():
+            cfg.set(k, v)
+        return WorkflowContext(backend=be, executor=ex,
+                               resolver=InputResolver(cfg, None, True))
+
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None)
+    new_manager(ctx_for({"manager_cloud_provider": "bare-metal",
+                         "name": "m1", "host": "10.0.0.1"}, be, ex))
+    new_cluster(ctx_for({
+        "cluster_manager": "m1", "name": "c1",
+        "cluster_cloud_provider": "bare-metal", "host": "10.0.0.2",
+        "nodes": [{"hostname": "n", "node_count": 2,
+                   "rancher_host_label": "worker"}]}, be, ex))
+
+    read_ctx = ctx_for({"cluster_manager": "m1", "cluster_name": "c1"},
+                       be, ex)
+    out = get_cluster(read_ctx)
+    assert out["node_health"]["n-2"]["ready"] is True
+
+    # The probe records n-2 dead (same write path the health tests use).
+    doc = be.state("m1")
+    view = ex.cloud_view(doc)
+    view.set_node_health(out["cluster_id"], "n-2", False, "TpuUnhealthy")
+    est = load_executor_state(doc)
+    est.cloud = view.to_dict()
+    save_executor_state(doc, est)
+    assert get_cluster(read_ctx)["unhealthy_nodes"] == ["n-2"]
+
+    # repair node, no hostname given: auto-targets the NotReady node
+    # (non-interactive auto-confirms, the silent-install contract).
+    repaired = repair_node(ctx_for({"cluster_manager": "m1",
+                                    "cluster_name": "c1"}, be, ex))
+    assert repaired.endswith("n-2")
+
+    out3 = get_cluster(read_ctx)
+    # Same hostname, registered again, Ready — and no ghost entries.
+    assert out3["node_health"]["n-2"] == {"ready": True, "reason": ""}
+    assert "unhealthy_nodes" not in out3
+    assert sorted(out3["node_health"]) == ["n-1", "n-2"]
+
+
+def test_repair_node_requires_an_unhealthy_node():
+    """With everything Ready, auto-targeting refuses (names the --set
+    hostname escape hatch) rather than destroying a healthy node."""
+    from triton_kubernetes_tpu.backends import MemoryBackend
+    from triton_kubernetes_tpu.config import Config, InputResolver
+    from triton_kubernetes_tpu.executor import LocalExecutor
+    from triton_kubernetes_tpu.workflows import (
+        WorkflowContext, WorkflowError, new_cluster, new_manager,
+        repair_node)
+
+    def ctx_for(values, be, ex):
+        cfg = Config()
+        for k, v in values.items():
+            cfg.set(k, v)
+        return WorkflowContext(backend=be, executor=ex,
+                               resolver=InputResolver(cfg, None, True))
+
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None)
+    new_manager(ctx_for({"manager_cloud_provider": "bare-metal",
+                         "name": "m1", "host": "10.0.0.1"}, be, ex))
+    new_cluster(ctx_for({
+        "cluster_manager": "m1", "name": "c1",
+        "cluster_cloud_provider": "bare-metal", "host": "10.0.0.2",
+        "nodes": [{"hostname": "n", "node_count": 1,
+                   "rancher_host_label": "worker"}]}, be, ex))
+    with pytest.raises(WorkflowError, match="No unhealthy nodes"):
+        repair_node(ctx_for({"cluster_manager": "m1",
+                             "cluster_name": "c1"}, be, ex))
+
+
 def test_get_cluster_warns_on_ca_checksum_mismatch(capsys):
     """A CA pin mismatch during the live-health read is a possible
     active-MITM indicator: it must surface as a warning, not be silently

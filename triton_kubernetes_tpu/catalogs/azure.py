@@ -22,7 +22,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-from . import Catalog
+from . import Catalog, warn_if_auth_failure
 
 MANAGEMENT = "https://management.azure.com"
 LOGIN = "https://login.microsoftonline.com"
@@ -154,6 +154,9 @@ class LiveAzureCatalog(Catalog):
                 return self.vm_sizes(context["location"]) or None
             if kind == "k8s_versions" and context.get("location"):
                 return self.k8s_versions(context["location"]) or None
+        except urllib.error.HTTPError as e:
+            warn_if_auth_failure("azure", e)  # loud on 400/401/403
+            return None
         except (urllib.error.URLError, OSError, ValueError, KeyError):
-            return None  # degrade to the static list
+            return None  # transient: degrade silently to the static list
         return None

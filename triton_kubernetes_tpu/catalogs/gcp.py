@@ -23,7 +23,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-from . import Catalog
+from . import Catalog, warn_if_auth_failure
 from ..backends.gcs import exchange_service_account_token
 
 COMPUTE = "https://compute.googleapis.com/compute/v1"
@@ -171,6 +171,9 @@ class LiveGcpCatalog(Catalog):
             if kind == "k8s_versions":
                 return self.k8s_versions(
                     context.get("zone", "us-central1-a")) or None
+        except urllib.error.HTTPError as e:
+            warn_if_auth_failure("gcp", e)  # loud on 400/401/403
+            return None
         except (urllib.error.URLError, OSError, ValueError, KeyError):
-            return None  # degrade to the static list
+            return None  # transient: degrade silently to the static list
         return None

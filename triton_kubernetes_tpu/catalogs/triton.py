@@ -20,7 +20,7 @@ import urllib.request
 from email.utils import formatdate
 from typing import Any, Dict, List, Optional
 
-from . import Catalog
+from . import Catalog, warn_if_auth_failure
 
 API_VERSION = "~8"
 
@@ -126,8 +126,21 @@ class LiveTritonCatalog(Catalog):
             return self._cache[cache_key]
         try:
             got = getattr(self, kind)() or None
+        except urllib.error.HTTPError as e:
+            warn_if_auth_failure("triton", e)  # loud on 400/401/403
+            return None
+        except (FileNotFoundError, ValueError) as e:
+            # Key material problems (missing key file, unsupported key
+            # type) are operator config errors, not flaky networks — same
+            # loudness as a 401.
+            from ..utils.logging import get_logger
+
+            get_logger().log(
+                "warn", "triton live catalog cannot sign requests "
+                f"({e}) — check triton_key_path/key_id; falling back to "
+                "static choices")
+            return None
         except Exception:
-            return None  # degrade to the static list (bad key, 401, dead
-            #              endpoint, unsupported key type — same answer)
+            return None  # transient (dead endpoint, timeout): silent
         self._cache[cache_key] = got
         return got

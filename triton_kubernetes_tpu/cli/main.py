@@ -51,6 +51,7 @@ from ..workflows import (
     new_cluster,
     new_manager,
     new_node,
+    repair_node,
     restore_backup,
 )
 
@@ -151,6 +152,12 @@ def build_parser() -> argparse.ArgumentParser:
     restore = sub.add_parser("restore", help="restore from a backup")
     restore.add_argument("kind", choices=["backup"])
 
+    repair = sub.add_parser(
+        "repair",
+        help="replace a dead node (destroy + re-create, same config); "
+             "auto-targets the NotReady node `get cluster` reports")
+    repair.add_argument("kind", choices=["node"])
+
     sub.add_parser(
         "validate",
         help="structurally validate the shipped terraform module tree and "
@@ -243,6 +250,10 @@ def main(argv: Optional[List[str]] = None,
             result = restore_backup(ctx)
             if result:
                 print(f"restored: {result}")
+        elif args.command == "repair":
+            result = repair_node(ctx)
+            if result:
+                print(f"repaired: {result}")
     except (WorkflowError, MissingInputError, ValidationError,
             ClusterKeyError, ApplyError, OutputError, ModuleError,
             StateLockedError, StateNotFoundError, TerraformNotFoundError,

@@ -35,6 +35,11 @@ class Prompter(abc.ABC):
     def confirm(self, label: str) -> bool:
         return self.select(label, [("Yes", True), ("No", False)])
 
+    def secret(self, label: str) -> str:
+        """Masked input (passphrases). Default: unmasked input — concrete
+        prompters override with real masking."""
+        return self.input(label)
+
 
 class InteractivePrompter(Prompter):
     """Plain-stdin prompter (numbered select), stdio like the reference."""
@@ -81,6 +86,21 @@ class InteractivePrompter(Prompter):
                 return value
             self._write(f"{err}\n")
 
+    def secret(self, label: str) -> str:
+        """Masked when reading the real terminal (getpass: no echo, like
+        the reference's promptui password mask, util/ssh_utils.go:22-28);
+        plain readline when stdin is redirected (tests, pipes — getpass
+        would grab the controlling tty and hang a scripted run)."""
+        if self.infile is sys.stdin and sys.stdin.isatty():
+            import getpass
+
+            return getpass.getpass(f"{label}: ")
+        self._write(f"{label}: ")
+        line = self.infile.readline()
+        if not line:
+            raise EOFError(f"stdin closed while prompting {label!r}")
+        return line.rstrip("\n")
+
 
 class ScriptedPrompter(Prompter):
     """Deterministic prompter fed a list of answers (test fixture)."""
@@ -116,3 +136,6 @@ class ScriptedPrompter(Prompter):
         if err is not None:
             raise ValidationError(f"{label}: {err}")
         return value
+
+    def secret(self, label: str) -> str:
+        return str(self._next(label))

@@ -66,6 +66,16 @@ class InputResolver:
             raise self._missing(key)
         return self.prompter.select(label, options)
 
+    def secret(self, key: str, label: str) -> Any:
+        """Masked free-form input (key passphrases). Config-set values are
+        honored like ``value``; non-interactive sessions never prompt
+        (missing error), matching the silent-install contract."""
+        if self.config.is_set(key):
+            return self.config.get(key)
+        if self.non_interactive:
+            raise self._missing(key)
+        return self.prompter.secret(label)
+
     def confirm(self, key: str, label: str) -> bool:
         """Yes/No (util/confirm_prompt.go analog). Non-interactive mode
         auto-confirms, matching the reference's silent installs."""

@@ -148,6 +148,14 @@ class CloudSimulator:
         except protocol.ProtocolError as e:
             raise CloudSimError(str(e)) from e
 
+    def deregister_node(self, hostname: str) -> None:
+        """Remove a host's registration (and its recorded health) from
+        whichever cluster holds it — the node-module destroy path.
+        Hostnames are unique per state doc (the create-node numbering
+        contract), so a plain scan is unambiguous."""
+        for c in self.clusters.values():
+            c["nodes"].pop(hostname, None)
+
     def cluster_by_id(self, cluster_id: str) -> Dict[str, Any]:
         if cluster_id not in self.clusters:
             raise CloudSimError(f"no such cluster {cluster_id!r}")

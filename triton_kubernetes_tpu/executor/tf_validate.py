@@ -8,6 +8,10 @@ syntax precisely so it can be machine-checked *without* the binary:
 
 * root-block grammar per file (``resource``/``data``/``variable``/``output``
   shapes, required attributes for the resource types the tree uses);
+* per-resource-type attribute schemas (KNOWN_ATTRS): an unknown attribute
+  name (``subnet_idd = ...``) or a typo'd/misshapen structural nested
+  block (NESTED_BLOCK_ATTRS, e.g. ``ip_configuration``) is flagged —
+  free-form maps (tags/triggers/labels/metadata) are exempt;
 * every ``${var.x}`` resolves to a declared variable, ``${local.x}`` to a
   ``locals`` entry, resource/data references to declared blocks;
 * ``depends_on`` entries resolve;
@@ -23,6 +27,15 @@ Used three ways: the test suite validates all shipped modules; the
 ``TerraformExecutor`` preflights every document before shelling out (so a
 bad doc fails in-process with a real message instead of mid-apply); and the
 CLI exposes ``validate`` for operators editing documents by hand.
+
+WHAT THIS CANNOT CATCH (vs real ``terraform validate``, which loads the
+live provider schemas): attribute VALUE types (``size = "big"``), deeper
+provider constraints (conflicting/exactly-one-of argument groups, enum
+values), provider-version-dependent schema drift, and expression TYPE
+errors inside interpolations. The authoritative cross-check is
+``tests/test_terraform_modules.py::test_terraform_binary_validate`` (runs
+wherever the binary exists; loud SKIP otherwise) — see
+``terraform/modules/README.md`` and ``docs/ci-evidence/README.md``.
 """
 
 from __future__ import annotations
@@ -122,6 +135,183 @@ REQUIRED_ATTRS: Dict[str, Tuple[str, ...]] = {
     "null_resource": (),
     "triton_machine": ("package", "image"),
     "kubernetes_deployment": ("metadata", "spec"),
+}
+
+# Known top-level attributes per resource type (used attributes across the
+# tree + the common optional arguments of each provider schema). An attr
+# not listed here and not a meta-argument is flagged — the drift class
+# `terraform validate` catches via provider schemas (`subnet_idd = ...`).
+# Free-form map attributes (tags, triggers, labels, metadata) are listed
+# but their KEYS are never checked; structural nested blocks get their own
+# schemas in NESTED_BLOCK_ATTRS below.
+KNOWN_ATTRS: Dict[str, Set[str]] = {
+    "aws_vpc": {"cidr_block", "enable_dns_hostnames", "enable_dns_support",
+                "instance_tenancy", "tags"},
+    "aws_subnet": {"vpc_id", "cidr_block", "availability_zone",
+                   "map_public_ip_on_launch", "tags"},
+    "aws_internet_gateway": {"vpc_id", "tags"},
+    "aws_route_table": {"vpc_id", "route", "tags"},
+    "aws_route": {"route_table_id", "destination_cidr_block", "gateway_id",
+                  "nat_gateway_id", "instance_id"},
+    "aws_route_table_association": {"subnet_id", "route_table_id"},
+    "aws_security_group": {"name", "name_prefix", "description", "vpc_id",
+                           "ingress", "egress", "tags"},
+    "aws_security_group_rule": {"type", "from_port", "to_port", "protocol",
+                                "security_group_id", "cidr_blocks",
+                                "ipv6_cidr_blocks", "self", "description",
+                                "source_security_group_id"},
+    "aws_key_pair": {"key_name", "key_name_prefix", "public_key", "tags"},
+    "aws_instance": {"ami", "instance_type", "key_name", "subnet_id",
+                     "vpc_security_group_ids", "user_data",
+                     "availability_zone", "iam_instance_profile",
+                     "associate_public_ip_address", "root_block_device",
+                     "ebs_block_device", "source_dest_check", "tags"},
+    "aws_ebs_volume": {"availability_zone", "size", "type", "iops",
+                       "throughput", "encrypted", "tags"},
+    "aws_volume_attachment": {"device_name", "volume_id", "instance_id",
+                              "force_detach", "skip_destroy"},
+    "google_compute_network": {"name", "auto_create_subnetworks",
+                               "description", "routing_mode", "mtu",
+                               "project"},
+    "google_compute_firewall": {"name", "network", "allow", "deny",
+                                "source_ranges", "source_tags",
+                                "target_tags", "direction", "priority",
+                                "description", "project"},
+    "google_compute_instance": {"name", "machine_type", "zone", "boot_disk",
+                                "network_interface", "tags", "labels",
+                                "metadata", "metadata_startup_script",
+                                "scheduling", "service_account",
+                                "allow_stopping_for_update",
+                                "can_ip_forward", "project",
+                                "deletion_protection"},
+    "google_compute_disk": {"name", "zone", "size", "type", "image",
+                            "labels", "project"},
+    "google_compute_attached_disk": {"disk", "instance", "device_name",
+                                     "mode", "zone", "project"},
+    "google_container_cluster": {"name", "location", "network", "subnetwork",
+                                 "initial_node_count",
+                                 "remove_default_node_pool",
+                                 "min_master_version", "node_version",
+                                 "node_config", "node_locations",
+                                 "release_channel", "deletion_protection",
+                                 "networking_mode", "ip_allocation_policy",
+                                 "project", "resource_labels"},
+    "google_container_node_pool": {"cluster", "name", "location",
+                                   "node_count", "node_config",
+                                   "node_locations", "autoscaling",
+                                   "management", "placement_policy",
+                                   "initial_node_count", "max_pods_per_node",
+                                   "version", "project"},
+    "azurerm_resource_group": {"name", "location", "tags"},
+    "azurerm_virtual_network": {"name", "location", "resource_group_name",
+                                "address_space", "dns_servers", "tags"},
+    "azurerm_subnet": {"name", "resource_group_name",
+                       "virtual_network_name", "address_prefixes",
+                       "service_endpoints"},
+    "azurerm_network_security_group": {"name", "location",
+                                       "resource_group_name",
+                                       "security_rule", "tags"},
+    "azurerm_network_security_rule": {"name", "priority", "direction",
+                                      "access", "protocol",
+                                      "source_port_range",
+                                      "destination_port_range",
+                                      "source_address_prefix",
+                                      "destination_address_prefix",
+                                      "resource_group_name",
+                                      "network_security_group_name",
+                                      "description"},
+    "azurerm_subnet_network_security_group_association": {
+        "subnet_id", "network_security_group_id"},
+    "azurerm_public_ip": {"name", "location", "resource_group_name",
+                          "allocation_method", "sku", "domain_name_label",
+                          "tags"},
+    "azurerm_network_interface": {"name", "location", "resource_group_name",
+                                  "ip_configuration", "dns_servers",
+                                  "tags"},
+    "azurerm_linux_virtual_machine": {"name", "location",
+                                      "resource_group_name", "size",
+                                      "admin_username", "admin_password",
+                                      "network_interface_ids", "os_disk",
+                                      "admin_ssh_key",
+                                      "source_image_reference",
+                                      "source_image_id", "custom_data",
+                                      "availability_set_id", "zone",
+                                      "disable_password_authentication",
+                                      "tags"},
+    "azurerm_managed_disk": {"name", "location", "resource_group_name",
+                             "storage_account_type", "create_option",
+                             "disk_size_gb", "zone", "tags"},
+    "azurerm_virtual_machine_data_disk_attachment": {
+        "managed_disk_id", "virtual_machine_id", "lun", "caching"},
+    "azurerm_kubernetes_cluster": {"name", "location",
+                                   "resource_group_name", "dns_prefix",
+                                   "kubernetes_version",
+                                   "default_node_pool", "identity",
+                                   "linux_profile", "network_profile",
+                                   "tags"},
+    "vsphere_virtual_machine": {"name", "resource_pool_id", "datastore_id",
+                                "num_cpus", "memory", "guest_id", "clone",
+                                "disk", "network_interface", "folder",
+                                "annotation"},
+    "local_sensitive_file": {"filename", "content", "content_base64",
+                             "file_permission", "directory_permission",
+                             "source"},
+    "null_resource": set(),
+    "triton_machine": {"package", "image", "name", "networks", "affinity",
+                       "cns", "user_script", "user_data", "firewall_enabled",
+                       "tags", "metadata"},
+    "kubernetes_deployment": {"metadata", "spec", "wait_for_rollout"},
+}
+
+# Schemas for STRUCTURAL nested blocks (key typos and misshapen bodies are
+# what `terraform validate` rejects). Free-form maps (tags, triggers,
+# labels, metadata, node_config.labels) are deliberately absent.
+NESTED_BLOCK_ATTRS: Dict[Tuple[str, str], Set[str]] = {
+    ("aws_instance", "root_block_device"): {
+        "volume_size", "volume_type", "iops", "encrypted",
+        "delete_on_termination"},
+    ("aws_security_group", "ingress"): {
+        "from_port", "to_port", "protocol", "cidr_blocks",
+        "ipv6_cidr_blocks", "security_groups", "prefix_list_ids", "self",
+        "description"},
+    ("aws_security_group", "egress"): {
+        "from_port", "to_port", "protocol", "cidr_blocks",
+        "ipv6_cidr_blocks", "security_groups", "prefix_list_ids", "self",
+        "description"},
+    ("google_compute_firewall", "allow"): {"protocol", "ports"},
+    ("google_compute_instance", "boot_disk"): {
+        "initialize_params", "source", "auto_delete", "device_name"},
+    ("google_compute_instance", "network_interface"): {
+        "network", "subnetwork", "access_config", "network_ip"},
+    ("google_container_cluster", "release_channel"): {"channel"},
+    ("google_container_node_pool", "management"): {
+        "auto_repair", "auto_upgrade"},
+    ("google_container_node_pool", "placement_policy"): {
+        "type", "tpu_topology", "policy_name"},
+    ("azurerm_network_interface", "ip_configuration"): {
+        "name", "subnet_id", "private_ip_address_allocation",
+        "private_ip_address", "public_ip_address_id", "primary"},
+    ("azurerm_linux_virtual_machine", "os_disk"): {
+        "caching", "storage_account_type", "disk_size_gb", "name"},
+    ("azurerm_linux_virtual_machine", "admin_ssh_key"): {
+        "username", "public_key"},
+    ("azurerm_linux_virtual_machine", "source_image_reference"): {
+        "publisher", "offer", "sku", "version"},
+    ("azurerm_kubernetes_cluster", "default_node_pool"): {
+        "name", "node_count", "vm_size", "vnet_subnet_id", "zones",
+        "enable_auto_scaling", "min_count", "max_count"},
+    ("azurerm_kubernetes_cluster", "identity"): {
+        "type", "identity_ids"},
+    ("azurerm_kubernetes_cluster", "linux_profile"): {
+        "admin_username", "ssh_key"},
+    ("vsphere_virtual_machine", "clone"): {
+        "template_uuid", "customize", "timeout"},
+    ("vsphere_virtual_machine", "disk"): {
+        "label", "size", "unit_number", "thin_provisioned",
+        "eagerly_scrub"},
+    ("vsphere_virtual_machine", "network_interface"): {
+        "network_id", "adapter_type"},
+    ("triton_machine", "cns"): {"services"},
 }
 
 _ROOT_KEYS = {"//", "terraform", "provider", "variable", "output", "locals",
@@ -328,10 +518,32 @@ def validate_module_dir(path: str) -> List[str]:
             err(f"resource {rtype!r}: provider {provider!r} not in "
                 f"required_providers {sorted(required_providers)}")
         required = REQUIRED_ATTRS.get(rtype)
+        known = KNOWN_ATTRS.get(rtype)
         for iname, body in insts.items():
             if not isinstance(body, dict):
                 err(f"resource {rtype}.{iname}: body must be an object")
                 continue
+            for attr, val in body.items():
+                if attr in _META_ARGS:
+                    continue
+                if known is not None and attr not in known:
+                    err(f"resource {rtype}.{iname}: unknown attribute "
+                        f"{attr!r} (not in the {rtype} schema)")
+                    continue
+                schema = NESTED_BLOCK_ATTRS.get((rtype, attr))
+                if schema is None:
+                    continue
+                items = val if isinstance(val, list) else [val]
+                for item in items:
+                    if not isinstance(item, dict):
+                        err(f"resource {rtype}.{iname}: block {attr!r} "
+                            f"must be an object, got "
+                            f"{type(item).__name__}")
+                        continue
+                    for k in item:
+                        if k != "//" and k not in schema:
+                            err(f"resource {rtype}.{iname}: unknown key "
+                                f"{k!r} in block {attr!r}")
             if required is None:
                 continue
             for attr in required:

@@ -158,3 +158,15 @@ class HostModule(Module):
             ca_checksum=config["rancher_cluster_ca_checksum"],
         )
         return {}, resources
+
+    def destroy(self, applied: Dict[str, Any], ctx: DriverContext) -> None:
+        super().destroy(applied, ctx)
+        # Destroying the host removes its cluster membership too — the
+        # reference leaves that to the operator (delete the node in the
+        # Rancher UI after the VM is gone); in-band removal keeps `get
+        # cluster` health listings free of ghost entries and makes
+        # `repair node` (destroy + re-create, same hostname) come back
+        # Ready instead of inheriting the dead node's NotReady record.
+        hostname = applied.get("config", {}).get("hostname")
+        if hostname:
+            ctx.cloud.deregister_node(hostname)

@@ -1,0 +1,188 @@
+"""Causal flash attention in pure XLA: the pallas kernel's memory-faithful
+twin for non-TPU backends and AOT memory contracts.
+
+The dense einsum path materializes [Sq, Sk] f32 logits (plus their
+cotangent in backward) — O(S²) HBM, exactly what ops/flash_attention.py
+exists to avoid on TPU. This op implements the same algorithm (online-
+softmax forward, recompute-from-logsumexp backward) with ``lax.scan``
+over KV blocks instead of a Mosaic grid, entirely in XLA HLO:
+
+* forward: scan over [block_k]-sized KV blocks carrying the running
+  (max, sumexp, unnormalized out) — peak temp O(Sq · block_k);
+* ``jax.custom_vjp`` saves only (q, k, v, out, lse) — WITHOUT it, scan AD
+  would stash every block's probabilities and re-create the O(S²) buffer
+  it is meant to avoid;
+* backward: one scan recomputing each block's p = exp(logits − lse),
+  accumulating dq in the carry and emitting per-block dk/dv.
+
+Uses: the CPU lowering for AOT memory contracts (tests/test_flagship_aot.py
+compiles the training step with this attention so ``memory_analysis``
+reflects the TPU flash program's streaming profile, not an interpret-mode
+artifact that inflates temps to full-score scale), and a long-context-safe
+fallback wherever the pallas kernel is unavailable. Exactness is pinned
+against the dense path in tests/test_ops.py (forward and grads, GQA
+included).
+
+Reference analog: the reference's CUDA flash/memory-efficient attention
+fallbacks; here the algorithm is expressed once in XLA and once in pallas
+(ops/flash_attention.py) with the pallas docstring's same two-pass
+backward. Positions follow the model contract ([B, S] int32 global,
+models/llama.py AttentionFn); like the auto ring path this assumes
+broadcast positions (identical across batch rows) — packed-sequence
+callers need the dense path or their own kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .attention import NEG_INF
+
+DEFAULT_BLOCK_K = 1024
+
+
+def _norm_positions(positions, s: int) -> jnp.ndarray:
+    if positions is None:
+        return jnp.arange(s, dtype=jnp.int32)
+    pos = jnp.asarray(positions)
+    if pos.ndim == 2:  # [B, S] broadcast contract — every row identical
+        pos = pos[0]
+    return pos.astype(jnp.int32)
+
+
+def _kv_blocks(k, v, k_pos, block_k: int):
+    """Pad Sk to a block multiple and reshape to leading-block stacks.
+    Padded keys get position INT32_MAX so the causal mask (q >= k) always
+    excludes them."""
+    b, sk, hkv, d = k.shape
+    pad = (-sk) % block_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        k_pos = jnp.concatenate(
+            [k_pos, jnp.full((pad,), jnp.iinfo(jnp.int32).max, jnp.int32)])
+    nb = (sk + pad) // block_k
+    kb = k.reshape(b, nb, block_k, hkv, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, nb, block_k, hkv, d).transpose(1, 0, 2, 3, 4)
+    return kb, vb, k_pos.reshape(nb, block_k)
+
+
+def _block_logits(q5, k_blk, q_pos, k_pos, scale):
+    """Masked f32 logits [B, Hkv, G, Sq, bk] for one KV block (the shared
+    forward/backward recompute step — flash's defining trade).
+
+    The causal mask is an ADDITIVE 2D [Sq, bk] term, not a broadcast
+    boolean: XLA (CPU especially) hoists loop-invariant per-block masks
+    out of the scan into a stacked buffer, and a pred broadcast over the
+    head dims stacks at [nb, B, H, G, Sq, bk] — 64 GiB at Mixtral shapes.
+    The 2D f32 adder stacks 16x smaller and fuses into the logits add.
+    NEG_INF is finite (-1e30), so downstream exp() of masked entries is
+    exactly 0.0 without a second mask application."""
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k_blk
+                        ).astype(jnp.float32) * scale
+    adder = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, NEG_INF)
+    return logits + adder[None, None, None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def blockwise_attention(q, k, v, positions=None,
+                        block_k: int = DEFAULT_BLOCK_K):
+    """[B, S, Hq, D] causal attention, GQA via Hq % Hkv == 0."""
+    out, _ = _forward(q, k, v, positions, block_k)
+    return out
+
+
+def _forward(q, k, v, positions, block_k):
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    scale = d ** -0.5
+    q_pos = _norm_positions(positions, sq)
+    k_pos = _norm_positions(positions, sk) if positions is not None \
+        else jnp.arange(sk, dtype=jnp.int32)
+    bk = min(block_k, sk)
+    q5 = q.reshape(b, sq, hkv, g, d)
+    kb, vb, kpb = _kv_blocks(k, v, k_pos, bk)
+
+    def step(carry, xs):
+        m, l, o = carry
+        k_blk, v_blk, kp = xs
+        logits = _block_logits(q5, k_blk, q_pos, kp, scale)
+        bm = logits.max(axis=-1)  # [B, Hkv, G, Sq]
+        # Masked entries: exp(NEG_INF - bm) == 0 for any finite bm. A row
+        # fully masked in THIS block gives bm = NEG_INF and p = 1s, but
+        # its beta = exp(NEG_INF - new_m) zeroes the contribution (block
+        # 0 always holds the self-key, so new_m is finite from step 0).
+        p = jnp.exp(logits - bm[..., None])
+        bs = p.sum(axis=-1)
+        bo = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_blk.astype(jnp.float32))
+        new_m = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - new_m)
+        beta = jnp.exp(bm - new_m)
+        l = l * alpha + bs * beta
+        o = o * jnp.moveaxis(alpha, 3, 1)[..., None] \
+            + bo * jnp.moveaxis(beta, 3, 1)[..., None]
+        return (new_m, l, o), None
+
+    m0 = jnp.full((b, hkv, g, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, sq), jnp.float32)
+    o0 = jnp.zeros((b, sq, hkv, g, d), jnp.float32)
+    (m, l, o), _ = lax.scan(step, (m0, l0, o0), (kb, vb, kpb))
+    out = (o / jnp.moveaxis(l, 3, 1)[..., None]).reshape(
+        b, sq, hq, d).astype(q.dtype)
+    lse = m + jnp.log(l)  # [B, Hkv, G, Sq]
+    return out, lse
+
+
+def _fwd(q, k, v, positions, block_k):
+    out, lse = _forward(q, k, v, positions, block_k)
+    return out, (q, k, v, positions, out, lse)
+
+
+def _bwd(block_k, res, dout):
+    q, k, v, positions, out, lse = res
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    scale = d ** -0.5
+    q_pos = _norm_positions(positions, sq)
+    k_pos = _norm_positions(positions, sk) if positions is not None \
+        else jnp.arange(sk, dtype=jnp.int32)
+    bk = min(block_k, sk)
+    q5 = q.reshape(b, sq, hkv, g, d)
+    do5 = dout.astype(jnp.float32).reshape(b, sq, hkv, g, d)
+    # D_i = <dout_i, out_i> — the softmax-jacobian diagonal term, computed
+    # once (flash2 backward preprocessing).
+    dsum = jnp.einsum("bqhgd,bqhgd->bhgq",
+                      do5, out.astype(jnp.float32).reshape(
+                          b, sq, hkv, g, d))
+    kb, vb, kpb = _kv_blocks(k, v, k_pos, bk)
+
+    def step(dq_acc, xs):
+        k_blk, v_blk, kp = xs
+        logits = _block_logits(q5, k_blk, q_pos, kp, scale)
+        # exp(NEG_INF - lse) == 0: masked and padded entries drop out.
+        p = jnp.exp(logits - lse[..., None])
+        dv_blk = jnp.einsum("bhgqk,bqhgd->bkhd", p, do5)
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", do5,
+                        v_blk.astype(jnp.float32))
+        ds = p * (dp - dsum[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhgqk,bkhd->bqhgd", ds,
+                                     k_blk.astype(jnp.float32))
+        dk_blk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, q5.astype(jnp.float32))
+        return dq_acc, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((b, sq, hkv, g, d), jnp.float32)
+    dq, (dkb, dvb) = lax.scan(step, dq0, (kb, vb, kpb))
+    dk = dkb.transpose(1, 0, 2, 3, 4).reshape(b, -1, hkv, d)[:, :sk]
+    dv = dvb.transpose(1, 0, 2, 3, 4).reshape(b, -1, hkv, d)[:, :sk]
+    return (dq.reshape(b, sq, hq, d).astype(q.dtype),
+            dk.astype(k.dtype), dv.astype(v.dtype), None)
+
+
+blockwise_attention.defvjp(_fwd, _bwd)

@@ -41,6 +41,38 @@ def mfu_on_slice(
     return mfu(tokens_per_sec, config, seq_len, spec.peak_bf16_tflops)
 
 
+def attention_flops_fraction(config: ModelConfig, seq_len: int) -> float:
+    """Share of training FLOPs in the attention score/value matmuls (the
+    part that runs in the flash kernel at sub-matmul efficiency)."""
+    total = flops_per_token(config, seq_len)
+    return (total - 6.0 * config.active_params()) / total
+
+
+def project_mfu(measured_mfu: float, proxy: ModelConfig, proxy_seq: int,
+                target: ModelConfig, target_seq: int,
+                kernel_rel_efficiency: float = 0.7) -> float:
+    """Conservative roofline transfer of a proxy-measured MFU to a target
+    (model, seq) — the argued bound tying the llama3-bench number to the
+    BASELINE 8B/v5p ≥0.40 gate (docs/guide/workloads.md derivation).
+
+    Every factor that differs proxy -> 8B/v5p except attention share moves
+    MFU UP and is clamped to 1.0 (no credit taken): matmul operand dims
+    grow 4x (embed 1024 -> 4096: better MXU tiling, higher per-matmul
+    arithmetic intensity), and the hardware ridge drops ~3x (v5e peak/BW
+    ~481 FLOPs/byte vs v5p ~166 — more bandwidth per FLOP). The one debit
+    kept is attention: its FLOPs share (attention_flops_fraction) runs at
+    ``kernel_rel_efficiency`` of the dense-matmul rate (0.7 is the flash
+    kernel's measured v5e ratio, scripts/tpu block sweeps), and the target
+    trains 4x longer sequences, so its share is larger. The matmul-only
+    efficiency is inferred from the proxy measurement and re-applied under
+    the target's mix."""
+    debit = 1.0 - kernel_rel_efficiency
+    proxy_mix = 1.0 - attention_flops_fraction(proxy, proxy_seq) * debit
+    target_mix = 1.0 - attention_flops_fraction(target, target_seq) * debit
+    matmul_mfu = min(1.0, measured_mfu / proxy_mix)
+    return matmul_mfu * target_mix
+
+
 def tokens_per_sec_for_mfu(
     target_mfu: float, config: ModelConfig, seq_len: int, peak_tflops_total: float,
 ) -> float:

@@ -33,10 +33,21 @@ def load_private_key(path: str, passphrase: Optional[bytes] = None):
                    serialization.load_pem_private_key):
         try:
             return loader(data, password=passphrase)
-        except ValueError:
-            continue
-        except TypeError as e:  # encrypted key without passphrase
+        except TypeError as e:  # encrypted PEM without passphrase
             raise SSHKeyError(f"private key {path} needs a passphrase") from e
+        except ValueError as e:
+            # Encrypted-key signals hide in ValueError too: encrypted
+            # OpenSSH without a password ("Key is password-protected"),
+            # wrong passphrase ("Incorrect password..."). Surface those
+            # instead of falling through to "unsupported format".
+            msg = str(e).lower()
+            if "password-protected" in msg:
+                raise SSHKeyError(
+                    f"private key {path} needs a passphrase") from e
+            if "password" in msg or "decrypt" in msg:
+                raise SSHKeyError(
+                    f"cannot decrypt private key {path}: {e}") from e
+            continue
     raise SSHKeyError(f"unsupported private key format: {path}")
 
 

@@ -16,6 +16,7 @@ from .backup import new_backup
 from .restore import restore_backup
 from .destroy import delete_cluster, delete_manager, delete_node
 from .get import get_cluster, get_manager
+from .repair import repair_node
 
 __all__ = [
     "WorkflowContext",
@@ -26,6 +27,7 @@ __all__ = [
     "get_cluster",
     "get_manager",
     "new_backup",
+    "repair_node",
     "restore_backup",
     "new_cluster",
     "new_manager",

@@ -30,8 +30,22 @@ def _creds(ctx: WorkflowContext) -> dict:
         try:
             key_id = public_key_fingerprint_from_private_key(str(key_path))
         except SSHKeyError as e:
-            raise WorkflowError(
-                f"triton_key_id not set and it could not be derived: {e}")
+            # Encrypted key: the reference prompts for the passphrase and
+            # retries (util/ssh_utils.go:22-28); interactive sessions get
+            # the same masked prompt here, non-interactive keeps the clean
+            # error (a silent install cannot answer).
+            if "passphrase" not in str(e) or r.non_interactive:
+                raise WorkflowError(
+                    f"triton_key_id not set and it could not be derived: {e}")
+            passphrase = r.secret("triton_key_passphrase",
+                                  "SSH Key Passphrase")
+            try:
+                key_id = public_key_fingerprint_from_private_key(
+                    str(key_path), str(passphrase).encode())
+            except SSHKeyError as e2:
+                raise WorkflowError(
+                    f"triton_key_id not set and it could not be derived: "
+                    f"{e2}")
     return {
         "triton_account": r.value("triton_account", "Triton Account Name"),
         "triton_key_path": key_path,

@@ -1,0 +1,87 @@
+"""``repair node`` workflow — failure detection's consumer, closed loop.
+
+The reference has no repair verb: its agents ride
+``--restart=unless-stopped`` + Rancher reconciliation, and a genuinely
+dead host is replaced by hand (destroy node, create node). ``get
+cluster`` here already *names* that cycle for NotReady nodes
+(workflows/get.py hint); this verb executes it: pick the dead node
+(``--set hostname=...`` or auto-target from the same health sources the
+hint reads), confirm, targeted destroy of its module, re-add the SAME
+module config (same hostname, same machine shape), apply. The replacement
+host runs the agent bootstrap again and re-registers with the manager,
+clearing the stale-heartbeat NotReady.
+"""
+
+from __future__ import annotations
+
+from .common import (
+    WorkflowContext,
+    WorkflowError,
+    select_cluster,
+    select_manager,
+)
+from .get import _node_health
+
+
+def repair_node(ctx: WorkflowContext) -> str:
+    r = ctx.resolver
+    manager = select_manager(ctx)
+    state = ctx.backend.state(manager)
+    _, cluster_key = select_cluster(ctx, state)
+    nodes = state.nodes(cluster_key)
+    if not nodes:
+        raise WorkflowError("No nodes.")
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+
+    if ctx.config.is_set("hostname"):
+        hostname = ctx.config.get("hostname")
+        if hostname not in nodes:
+            raise WorkflowError(f"A node named '{hostname}', does not exist.")
+    else:
+        hostname = _pick_unhealthy(ctx, state, cluster_key, nodes)
+
+    node_key = nodes[hostname]
+    if not r.confirm("confirm",
+                     f"Proceed? This will destroy and re-create node "
+                     f"'{hostname}'"):
+        return ""
+
+    # Same module config back in: identical hostname, machine shape, and
+    # registration wiring — a repair is a replacement, not a new node.
+    node_cfg = dict(state.get(f"module.{node_key}"))
+    ctx.executor.destroy(state, targets=[node_key])
+    state.delete(f"module.{node_key}")
+    # Persist the destroyed intermediate: if the re-create apply fails,
+    # the doc must not claim a node that no longer exists.
+    ctx.backend.persist(state)
+    state.set(f"module.{node_key}", node_cfg)
+    ctx.executor.apply(state)
+    ctx.backend.persist(state)
+    return node_key
+
+
+def _pick_unhealthy(ctx: WorkflowContext, state, cluster_key: str,
+                    nodes) -> str:
+    """Auto-target: the NotReady node, from the same health sources the
+    ``get cluster`` hint reads (live manager heartbeat, then driver/
+    simulator view)."""
+    try:
+        outputs = ctx.executor.output(state, cluster_key)
+    except Exception:
+        outputs = {}
+    health = _node_health(ctx, state, outputs.get("cluster_id"),
+                          outputs.get("ca_checksum", "")) or {}
+    dead = sorted(h for h, st in health.items()
+                  if not st.get("ready") and h in nodes)
+    if not dead:
+        raise WorkflowError(
+            "No unhealthy nodes detected — name the node to replace with "
+            "--set hostname=<name> if you want to repair one anyway.")
+    if len(dead) == 1:
+        return dead[0]
+    if ctx.non_interactive:
+        raise WorkflowError(
+            f"Multiple unhealthy nodes: {dead}. Repair one at a time with "
+            "--set hostname=<name>.")
+    return ctx.resolver.prompter.select(
+        "Unhealthy node to repair", [(h, h) for h in dead])
