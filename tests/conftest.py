@@ -12,12 +12,23 @@ import sys
 # Make the repo root importable regardless of pytest rootdir config.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The device-count knob must land before jax initializes a backend; older
+# jax releases only expose it through XLA_FLAGS, newer ones as a config
+# option. Set the flag first so either path yields 8 virtual CPU devices.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax
 
 # jax may already be imported (the axon sitecustomize registers a TPU plugin
 # at interpreter boot); config updates still work until a backend is chosen.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # pre-0.5 jax: XLA_FLAGS above already covers it
+    pass
 
 import pytest  # noqa: E402
 

@@ -127,6 +127,72 @@ def test_destroy_cluster_via_cli(capsys):
     assert doc.clusters() == {}
 
 
+def test_retry_flags_reach_the_executor_policy():
+    """--max-retries/--apply-deadline land in the RetryPolicy the CLI
+    builds for the in-process executor (and the env/YAML keys ride the
+    same config path)."""
+    from triton_kubernetes_tpu.cli.main import choose_executor
+    from triton_kubernetes_tpu.config import Config, InputResolver
+    from triton_kubernetes_tpu.utils import configure
+
+    logger = configure(json_mode=False, level="error")
+    cfg = Config(env={"TK8S_RETRY_BACKOFF": "0.25"})
+    cfg.set("max_retries", 7)
+    cfg.set("apply_deadline", 42.5)
+    ex = choose_executor(InputResolver(cfg, None, True), logger)
+    assert ex.retry.max_retries == 7
+    assert ex.retry.deadline == 42.5
+    assert ex.retry.backoff == 0.25
+
+
+def test_repair_slice_via_cli(capsys):
+    """`repair slice` end to end through main(): preempt the pool, repair,
+    and the CLI reports the replaced module key."""
+    from triton_kubernetes_tpu.executor.engine import (
+        load_executor_state, save_executor_state)
+
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None)
+    assert main([
+        "--non-interactive",
+        "--set", "manager_cloud_provider=bare-metal",
+        "--set", "name=m1", "--set", "host=10.0.0.5",
+        "create", "manager"], backend=be, executor=ex) == 0
+    assert main([
+        "--non-interactive", "--set", "cluster_manager=m1",
+        "--set", "cluster_cloud_provider=gcp-tpu", "--set", "name=ml",
+        "--set", "gcp_path_to_credentials=/tmp/creds.json",
+        "--set", "gcp_project_id=p1",
+        "create", "cluster"], backend=be, executor=ex) == 0
+    assert main([
+        "--non-interactive", "--set", "cluster_manager=m1",
+        "--set", "cluster_name=ml", "--set", "hostname=pool0",
+        "--set", "tpu_accelerator=v5e-8",
+        "--set", "gcp_path_to_credentials=/tmp/creds.json",
+        "--set", "gcp_project_id=p1",
+        "create", "node"], backend=be, executor=ex) == 0
+    capsys.readouterr()
+
+    # Nothing preempted yet: the typed refusal surfaces as a clean rc=1.
+    assert main(["--non-interactive", "--set", "cluster_manager=m1",
+                 "--set", "cluster_name=ml", "repair", "slice"],
+                backend=be, executor=ex) == 1
+    assert "No preempted" in capsys.readouterr().err
+
+    doc = be.state("m1")
+    view = ex.cloud_view(doc)
+    view.preempt_slice("ml-pool0")
+    est = load_executor_state(doc)
+    est.cloud = view.to_dict()
+    save_executor_state(doc, est)
+
+    assert main(["--non-interactive", "--set", "cluster_manager=m1",
+                 "--set", "cluster_name=ml", "repair", "slice"],
+                backend=be, executor=ex) == 0
+    assert "repaired: node_gcp-tpu_ml_pool0" in capsys.readouterr().out
+    assert ex.cloud_view(be.state("m1")).preempted_slices() == {}
+
+
 def test_validate_verb_clean_and_corrupted(capsys):
     """`validate` structurally checks the module tree plus every stored
     document: 0 on a workflow-written store, 1 (with diagnostics) after

@@ -65,6 +65,20 @@ def test_reapply_is_noop(doc):
     assert plan2.changes == 0
 
 
+def test_reapply_unchanged_doc_makes_zero_driver_mutations(doc):
+    """The scale-out no-op contract, enforced below the plan layer: a
+    second apply of an unchanged document must not touch the driver at all
+    (the simulator's mutation clock counts every state-changing call)."""
+    _add_cluster_and_node(doc)
+    ex = LocalExecutor()
+    ex.apply(doc)
+    ops_after_first = ex.cloud_view(doc).ops
+    assert ops_after_first > 0  # the first apply really did mutate
+    plan2 = ex.apply(doc)
+    assert plan2.changes == 0
+    assert ex.cloud_view(doc).ops == ops_after_first
+
+
 def test_scale_out_only_creates_new_module(doc):
     """create node path: whole-graph apply, existing modules no-op
     (create/node.go:161-168 semantics)."""

@@ -1,0 +1,508 @@
+"""Deterministic fault injection + self-healing, end to end.
+
+The robustness spine: a seeded ``FaultPlan`` makes the in-process cloud
+fail like a real TPU fleet does (boot flakes, 5xx control-plane calls,
+slice preemption, half-applied modules), and the layers above prove they
+survive it — the engine retries transient faults with capped backoff and
+journals partial applies, ``repair slice`` replaces preempted pools and
+restores ICI labels, and training resumes from the latest checkpoint with
+bitwise-identical loss continuation.
+
+Everything is deterministic: no wall clock (backoff uses an injected
+sleeper), no randomness (faults fire on exact op matches and the
+simulator's mutation clock).
+"""
+
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.backends import MemoryBackend
+from triton_kubernetes_tpu.config import Config, InputResolver
+from triton_kubernetes_tpu.executor import (
+    FatalApplyError,
+    LocalExecutor,
+    PlanAction,
+    RetryPolicy,
+    TransientApplyError,
+)
+from triton_kubernetes_tpu.executor.cloudsim import (
+    CloudSimulator,
+    FatalFaultError,
+    FaultPlan,
+    TransientFaultError,
+)
+from triton_kubernetes_tpu.executor.engine import (
+    _MEMORY_STATES,
+    load_executor_state,
+    save_executor_state,
+)
+from triton_kubernetes_tpu.state import StateDocument
+from triton_kubernetes_tpu.workflows import (
+    NoPreemptedSlicesError,
+    WorkflowContext,
+    new_cluster,
+    new_manager,
+    repair_slice,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_memory_executor_state():
+    yield
+    _MEMORY_STATES.clear()
+
+
+def _no_sleep(delay):  # tests must never wait on the wall clock
+    raise AssertionError(f"unexpected wall-clock sleep({delay})")
+
+
+def ctx_for(values, be, ex):
+    cfg = Config(env={})
+    for k, v in values.items():
+        cfg.set(k, v)
+    return WorkflowContext(backend=be, executor=ex,
+                           resolver=InputResolver(cfg, None, True))
+
+
+def _manager_doc(name="m1", fault_plan=None):
+    doc = StateDocument(name)
+    doc.set_backend_config({"memory": {"name": name}})
+    if fault_plan is not None:
+        doc.set("driver", {"name": "sim", "fault_plan": fault_plan})
+    doc.set_manager({"source": "modules/bare-metal-manager",
+                     "name": name, "host": "192.168.0.10"})
+    return doc
+
+
+def _add_cluster_and_node(doc):
+    ckey = doc.add_cluster("bare-metal", "c1", {
+        "source": "modules/bare-metal-k8s",
+        "name": "c1",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+    })
+    nkey = doc.add_node(ckey, "c1-w-1", {
+        "source": "modules/bare-metal-k8s-host",
+        "hostname": "c1-w-1", "host": "192.168.0.11",
+        "rancher_host_labels": {"worker": True},
+        "rancher_cluster_registration_token":
+            f"${{module.{ckey}.registration_token}}",
+        "rancher_cluster_ca_checksum": f"${{module.{ckey}.ca_checksum}}",
+    })
+    return ckey, nkey
+
+
+# ----------------------------------------------------------- fault plan unit
+
+def test_fault_plan_is_deterministic_and_serializes():
+    spec = {"faults": [{"op": "create_resource", "match": {"name": "x"},
+                        "times": 2, "error": "boot failed"}]}
+    sim = CloudSimulator(fault_plan=spec)
+    for _ in range(2):
+        with pytest.raises(TransientFaultError, match="boot failed"):
+            sim.create_resource("vm_instance", "x")
+    sim.create_resource("vm_instance", "x")  # exhausted: succeeds
+
+    # Remaining fire-counts round-trip through the state dict: a rebuilt
+    # simulator continues the sequence, it does not restart it.
+    sim2 = CloudSimulator(fault_plan=spec)
+    with pytest.raises(TransientFaultError):
+        sim2.create_resource("vm_instance", "x")
+    sim3 = CloudSimulator(sim2.to_dict())
+    with pytest.raises(TransientFaultError):
+        sim3.create_resource("vm_instance", "x")
+    sim3.create_resource("vm_instance", "x")
+
+
+def test_fault_plan_fatal_and_wildcard():
+    sim = CloudSimulator(fault_plan={"faults": [
+        {"op": "*", "kind": "fatal", "error": "quota exceeded"}]})
+    with pytest.raises(FatalFaultError, match="quota exceeded"):
+        sim.create_or_get_cluster("https://x", "c")
+
+
+def test_preempt_fires_on_mutation_clock():
+    sim = CloudSimulator()
+    sim.create_hosted_cluster("gke", "ml")
+    from triton_kubernetes_tpu.topology import (SliceSpec,
+                                                host_labels_for_slice)
+
+    spec = SliceSpec.from_accelerator("v5e-16")
+    sim.create_node_pool("gke", "ml", "pool0", spec.num_hosts,
+                         node_labels=host_labels_for_slice(spec, "ml-pool0"))
+    at = sim.ops + 1
+    armed = CloudSimulator(sim.to_dict(),)
+    armed.fault_plan = FaultPlan(
+        {"faults": [{"op": "preempt", "slice_id": "ml-pool0", "at_op": at}]})
+    assert armed.preempted_slices() == {}
+    armed.create_resource("gcp_compute_network", "unrelated")  # ticks clock
+    pre = armed.preempted_slices()
+    assert list(pre) == ["ml-pool0"]
+    assert pre["ml-pool0"]["pool"] == "pool0"
+    # Preempted hosts lost their ICI coordinate labels.
+    pool = armed.get_resource("gke_cluster", "ml")["node_pools"]["pool0"]
+    assert all(n["labels"] == {} and n["preempted"] for n in pool["nodes"])
+
+
+# ------------------------------------------------------------- engine retry
+
+def test_engine_retries_boot_fault_with_backoff():
+    """Boot fails twice, third attempt succeeds: the engine retries the
+    module with capped exponential backoff (injected sleeper) and the
+    journal records the recovery."""
+    doc = _manager_doc(fault_plan={"faults": [
+        {"op": "create_resource", "match": {"name": "m1-manager"},
+         "times": 2, "error": "instance boot failed"}]})
+    sleeps = []
+    ex = LocalExecutor(log=lambda m: None,
+                       retry=RetryPolicy(max_retries=3, backoff=0.5,
+                                         deadline=60.0),
+                       sleep=sleeps.append)
+    ex.apply(doc)
+    assert sleeps == [0.5, 1.0]  # exponential, no wall clock
+    assert ex.output(doc, "cluster-manager")["manager_url"].startswith("https")
+    journal = load_executor_state(doc).journal
+    assert journal["status"] == "ok"
+    assert journal["failed"] is None  # recovered — no stale failure record
+    assert journal["retries"] == {"cluster-manager": 2}
+    assert journal["backoff_total"] == pytest.approx(1.5)
+
+
+def test_engine_fatal_fault_fails_fast():
+    doc = _manager_doc(fault_plan={"faults": [
+        {"op": "bootstrap_manager", "kind": "fatal",
+         "error": "permanently rejected"}]})
+    ex = LocalExecutor(log=lambda m: None, sleep=_no_sleep)
+    with pytest.raises(FatalApplyError, match="permanently rejected"):
+        ex.apply(doc)
+    journal = load_executor_state(doc).journal
+    assert journal["status"] == "failed"
+    assert journal["failed"]["kind"] == "fatal"
+    assert journal["failed"]["attempts"] == 1  # no retries burned
+
+
+def test_engine_apply_deadline_caps_total_backoff():
+    doc = _manager_doc(fault_plan={"faults": [
+        {"op": "create_resource", "match": {"name": "m1-manager"},
+         "times": 99, "error": "503"}]})
+    sleeps = []
+    ex = LocalExecutor(log=lambda m: None,
+                       retry=RetryPolicy(max_retries=99, backoff=1.0,
+                                         backoff_cap=64.0, deadline=6.0),
+                       sleep=sleeps.append)
+    with pytest.raises(TransientApplyError, match="deadline exhausted"):
+        ex.apply(doc)
+    # 1 + 2 = 3 slept; the next wait (4) would cross the 6s budget.
+    assert sleeps == [1.0, 2.0]
+
+
+def test_journal_resumes_from_last_healthy_module():
+    """A transient fault that outlives retries journals the partial apply;
+    the re-run NOOPs every completed module and resumes at the failed one."""
+    doc = _manager_doc(fault_plan={"faults": [
+        {"op": "register_node", "times": 3,
+         "error": "503 service unavailable"}]})
+    ckey, nkey = _add_cluster_and_node(doc)
+    sleeps = []
+    ex = LocalExecutor(log=lambda m: None,
+                       retry=RetryPolicy(max_retries=1, backoff=0.5,
+                                         deadline=60.0),
+                       sleep=sleeps.append)
+    with pytest.raises(TransientApplyError, match="transient fault persisted"):
+        ex.apply(doc)
+
+    journal = load_executor_state(doc).journal
+    assert journal["status"] == "failed"
+    assert journal["failed"] == {"module": nkey,
+                                 "error": journal["failed"]["error"],
+                                 "kind": "transient", "attempts": 2}
+    # Manager and cluster completed and were journaled before the failure.
+    assert journal["completed"] == ["cluster-manager", ckey]
+    assert ex.output(doc, ckey)["cluster_id"].startswith("c-")
+
+    # Re-run: completed modules NOOP (resume from last healthy), the node
+    # retries its remaining fault (3rd fire) and heals.
+    plan = ex.apply(doc)
+    assert plan.actions["cluster-manager"] is PlanAction.NOOP
+    assert plan.actions[ckey] is PlanAction.NOOP
+    assert plan.actions[nkey] is PlanAction.CREATE
+    journal2 = load_executor_state(doc).journal
+    assert journal2["status"] == "ok"
+    assert journal2["completed"] == [nkey]
+    cloud = ex.cloud_view(doc)
+    cid = ex.output(doc, ckey)["cluster_id"]
+    assert "c1-w-1" in cloud.cluster_by_id(cid)["nodes"]
+
+
+def _tpu_doc(fault_plan=None):
+    """Manager + GKE-TPU cluster + one v5e-16 pool, as a raw state doc
+    (engine-level tests need the doc to survive a failed apply; the
+    workflow layer would roll it back, commit-after-success)."""
+    doc = _manager_doc(fault_plan=fault_plan)
+    ckey = doc.add_cluster("gcp-tpu", "ml", {
+        "source": "modules/gcp-tpu-k8s",
+        "name": "ml",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+        "gcp_path_to_credentials": "/tmp/creds.json",
+        "gcp_project_id": "p1",
+    })
+    doc.add_node(ckey, "pool0", {
+        "source": "modules/gcp-tpu-nodepool",
+        "pool_name": "pool0",
+        "gke_cluster_name": "ml",
+        "cluster_id": f"${{module.{ckey}.cluster_id}}",
+        "gcp_path_to_credentials": "/tmp/creds.json",
+        "gcp_project_id": "p1",
+        "tpu_accelerator": "v5e-16",
+    })
+    return doc, ckey
+
+
+def test_half_applied_module_heals_on_rerun():
+    """A module killed halfway (node pool created, DaemonSets not) must
+    come back whole on re-run — the idempotent create-or-get contract plus
+    the journal make a partial apply recoverable, not poisonous."""
+    doc, ckey = _tpu_doc(fault_plan={"faults": [
+        {"op": "apply_manifest", "match": {"name": "tpu-device-plugin"},
+         "kind": "fatal", "error": "apiserver lost quorum", "times": 1}]})
+    ex = LocalExecutor(log=lambda m: None, sleep=_no_sleep)
+    with pytest.raises(FatalApplyError, match="apiserver lost quorum"):
+        ex.apply(doc)
+
+    journal = load_executor_state(doc).journal
+    assert journal["status"] == "failed"
+    assert journal["failed"]["module"] == "node_gcp-tpu_ml_pool0"
+    assert journal["completed"] == ["cluster-manager", ckey]
+    # Half-applied: the pool exists in the cloud, but the module is not in
+    # applied state (so the re-run re-applies exactly this module).
+    view = ex.cloud_view(doc)
+    assert view.get_resource("gke_cluster", "ml")["node_pools"]["pool0"]
+
+    # Re-run: the fault is exhausted, the re-run NOOPs the healthy modules
+    # and completes the half-applied one — the missing DaemonSets land.
+    plan = ex.apply(doc)
+    assert plan.actions[ckey] is PlanAction.NOOP
+    view = ex.cloud_view(doc)
+    cid = ex.output(doc, ckey)["cluster_id"]
+    names = [m["metadata"]["name"]
+             for m in view.get_manifests(cid, "DaemonSet")]
+    assert any(n.startswith("tpu-device-plugin") for n in names)
+
+
+def test_no_fault_plan_means_no_behavior_change():
+    """The entire fault layer is inert without a plan: no sleeps, identical
+    plans/outputs, clean journal."""
+    doc = _manager_doc(fault_plan=None)
+    _add_cluster_and_node(doc)
+    ex = LocalExecutor(log=lambda m: None, sleep=_no_sleep)
+    plan = ex.apply(doc)
+    assert len(plan.by_action(PlanAction.CREATE)) == 3
+    journal = load_executor_state(doc).journal
+    assert journal["status"] == "ok"
+    assert journal["retries"] == {} and journal["failed"] is None
+    assert ex.apply(doc).changes == 0
+
+
+# ----------------------------------------------------------- slice repair
+
+TPU_SILENT = {
+    "cluster_manager": "m1",
+    "cluster_cloud_provider": "gcp-tpu",
+    "name": "ml",
+    "gcp_path_to_credentials": "/tmp/creds.json",
+    "gcp_project_id": "p1",
+    "nodes": [{"hostname": "pool0", "tpu_accelerator": "v5e-16"}],
+}
+
+
+def _tpu_cluster(be, ex):
+    new_manager(ctx_for({"manager_cloud_provider": "bare-metal",
+                         "name": "m1", "host": "10.0.0.1"}, be, ex))
+    new_cluster(ctx_for(TPU_SILENT, be, ex))
+
+
+def test_repair_slice_replaces_preempted_pool_and_restores_labels():
+    from triton_kubernetes_tpu.topology import SliceSpec, verify_slice_labels
+
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None, sleep=_no_sleep)
+    _tpu_cluster(be, ex)
+    doc = be.state("m1")
+
+    # Preempt the slice (the spot-reclaim event), persisted like any other
+    # cloud-state transition.
+    view = ex.cloud_view(doc)
+    assert view.preempt_slice("ml-pool0") == [
+        f"ml-pool0-{i}" for i in range(4)]
+    est = load_executor_state(doc)
+    est.cloud = view.to_dict()
+    save_executor_state(doc, est)
+
+    repaired = repair_slice(ctx_for({"cluster_manager": "m1",
+                                     "cluster_name": "ml"}, be, ex))
+    assert repaired == "node_gcp-tpu_ml_pool0"
+
+    # The replacement pool is whole again: not preempted, and every host
+    # carries the exact ICI mesh coordinate labels.
+    view2 = ex.cloud_view(doc)
+    assert view2.preempted_slices() == {}
+    pool = view2.get_resource("gke_cluster", "ml")["node_pools"]["pool0"]
+    spec = SliceSpec.from_accelerator("v5e-16")
+    labels = [n["labels"] for n in pool["nodes"]]
+    assert verify_slice_labels(labels, spec, "ml-pool0") == []
+    # Cordon happened before teardown and is visible in the journal's
+    # cloud history only through the replaced pool — the new nodes are
+    # schedulable.
+    assert not any(n.get("cordoned") for n in pool["nodes"])
+
+
+def test_repair_slice_ignores_sibling_cluster_preemptions():
+    """Sibling clusters reuse default pool names ('pool0'): a preemption in
+    cluster beta must not auto-target (and churn) cluster alpha's healthy
+    same-named pool."""
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None, sleep=_no_sleep)
+    new_manager(ctx_for({"manager_cloud_provider": "bare-metal",
+                         "name": "m1", "host": "10.0.0.1"}, be, ex))
+    for cname in ("alpha", "beta"):
+        new_cluster(ctx_for({**TPU_SILENT, "name": cname}, be, ex))
+    doc = be.state("m1")
+    view = ex.cloud_view(doc)
+    view.preempt_slice("beta-pool0")
+    est = load_executor_state(doc)
+    est.cloud = view.to_dict()
+    save_executor_state(doc, est)
+
+    # alpha sees nothing to repair; beta auto-targets its own pool.
+    with pytest.raises(NoPreemptedSlicesError):
+        repair_slice(ctx_for({"cluster_manager": "m1",
+                              "cluster_name": "alpha"}, be, ex))
+    assert repair_slice(ctx_for({"cluster_manager": "m1",
+                                 "cluster_name": "beta"}, be, ex)) \
+        == "node_gcp-tpu_beta_pool0"
+    view2 = ex.cloud_view(doc)
+    assert view2.preempted_slices() == {}
+    # alpha's pool was never touched (same node objects, labels intact).
+    alpha = view2.get_resource("gke_cluster", "alpha")["node_pools"]["pool0"]
+    assert all(not n.get("preempted") and n["labels"] for n in alpha["nodes"])
+
+
+def test_repair_slice_requires_a_preempted_slice():
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None, sleep=_no_sleep)
+    _tpu_cluster(be, ex)
+    with pytest.raises(NoPreemptedSlicesError, match="No preempted"):
+        repair_slice(ctx_for({"cluster_manager": "m1",
+                              "cluster_name": "ml"}, be, ex))
+
+
+# ------------------------------------------------- the full loop, end to end
+
+def test_preemption_repair_resume_end_to_end(tmp_path, cpu_mesh_devices):
+    """The acceptance loop, deterministically: a fault plan 5xxes the pool
+    creation (engine retries with injected-sleeper backoff and journals),
+    then preempts the slice mid-apply at a fixed mutation-clock tick; the
+    repair workflow replaces the pool and restores ICI labels; the trainer
+    resumes from ``CheckpointManager.latest_step()`` and the post-resume
+    losses are bitwise identical to the uninterrupted run."""
+    import jax
+    import jax.numpy as jnp
+
+    from triton_kubernetes_tpu.models import get_config
+    from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh
+    from triton_kubernetes_tpu.train import (init_state, make_optimizer,
+                                             make_train_step)
+    from triton_kubernetes_tpu.train.checkpoint import CheckpointManager
+    from triton_kubernetes_tpu.train.data import synthetic_batches
+
+    # --- infrastructure up, through two transient 503s on the pool create.
+    be = MemoryBackend()
+    sleeps = []
+    ex = LocalExecutor(log=lambda m: None,
+                       retry=RetryPolicy(max_retries=3, backoff=0.5,
+                                         deadline=60.0),
+                       sleep=sleeps.append)
+    new_manager(ctx_for({"manager_cloud_provider": "bare-metal",
+                         "name": "m1", "host": "10.0.0.1",
+                         "driver": {"name": "sim", "fault_plan": {"faults": [
+                             {"op": "create_node_pool",
+                              "match": {"pool": "pool0"}, "times": 2,
+                              "error": "503 service unavailable"}]}}},
+                        be, ex))
+    new_cluster(ctx_for(TPU_SILENT, be, ex))
+    assert sleeps == [0.5, 1.0]  # the 503s were retried through, no clock
+    doc = be.state("m1")
+    journal = load_executor_state(doc).journal
+    assert journal["status"] == "ok"
+    assert journal["retries"] == {"node_gcp-tpu_ml_pool0": 2}
+
+    # --- training with periodic checkpoints (the workload the slice runs).
+    cfg = get_config("llama-test", dtype="float32")
+    mesh = create_mesh(MeshConfig(fsdp=4), devices=jax.devices()[:4])
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    batch = next(synthetic_batches(cfg.vocab_size, 8, 32))
+    tokens = jnp.asarray(batch["tokens"])
+
+    # Uninterrupted reference run: 4 steps.
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    expected = []
+    for _ in range(4):
+        state, metrics = step(state, {"tokens": tokens})
+        expected.append(float(metrics["loss"]))
+
+    # Interrupted run: checkpoint at step 2, then the slice is preempted.
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    losses = []
+    for i in range(2):
+        state, metrics = step(state, {"tokens": tokens})
+        losses.append(float(metrics["loss"]))
+    mgr.save(2, state, wait=True)
+    mgr.close()
+    assert losses == expected[:2]
+
+    # --- preemption fires MID-APPLY at a fixed mutation-clock tick, while
+    # the jobset for this training run is being deployed.
+    view = ex.cloud_view(doc)
+    doc.set("driver", {"name": "sim", "fault_plan": {"faults": [
+        {"op": "preempt", "slice_id": "ml-pool0",
+         "at_op": view.ops + 1}]}})
+    doc.set("module.job_train", {
+        "source": "modules/tpu-jobset",
+        "job_name": "train",
+        "cluster_id": "${module.cluster_gcp-tpu_ml.cluster_id}",
+        "tpu_accelerator": "v5e-16",
+        "slice_id": "${module.node_gcp-tpu_ml_pool0.slice_id}",
+    })
+    be.persist(doc)
+    ex.apply(doc)
+
+    preempted = ex.cloud_view(doc).preempted_slices()
+    assert list(preempted) == ["ml-pool0"]  # training "dies" here
+
+    # --- self-healing: replace the slice, verify ICI labels come back.
+    repaired = repair_slice(ctx_for({"cluster_manager": "m1",
+                                     "cluster_name": "ml"}, be, ex))
+    assert repaired == "node_gcp-tpu_ml_pool0"
+    assert ex.cloud_view(doc).preempted_slices() == {}
+
+    # --- resume from the latest checkpoint on the restored slice: loss
+    # continuation is bitwise identical to the uninterrupted run.
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr2.latest_step() == 2
+    target = init_state(cfg, mesh, opt)
+    restored = mgr2.restore(target)
+    assert int(restored.step) == 2
+    step2 = make_train_step(cfg, mesh, opt)
+    resumed = []
+    for _ in range(2):
+        restored, metrics = step2(restored, {"tokens": tokens})
+        resumed.append(float(metrics["loss"]))
+    mgr2.close()
+    np.testing.assert_array_equal(np.asarray(resumed),
+                                  np.asarray(expected[2:]))

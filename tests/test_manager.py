@@ -161,6 +161,92 @@ def test_client_retries_when_unreachable():
     assert sleeps == [0.01, 0.02]  # exponential backoff, injected sleep
 
 
+def _http_stub(monkeypatch, responses):
+    """Stub urllib.request.urlopen with a scripted response sequence:
+    ("err", code, retry_after) raises that HTTPError, ("ok", body, None)
+    succeeds. Returns the call log."""
+    import email.message
+    import io
+    import urllib.error
+    import urllib.request
+
+    calls = []
+
+    def fake_urlopen(req, timeout=None, context=None):
+        calls.append(req.full_url)
+        kind, payload, retry_after = responses[min(len(calls) - 1,
+                                                   len(responses) - 1)]
+        if kind == "err":
+            hdrs = email.message.Message()
+            if retry_after is not None:
+                hdrs["Retry-After"] = str(retry_after)
+            raise urllib.error.HTTPError(req.full_url, payload, "err",
+                                         hdrs, io.BytesIO(b"{}"))
+
+        class _Resp:
+            def read(self):
+                return json.dumps(payload).encode()
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        return _Resp()
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    return calls
+
+
+def test_client_honors_retry_after_on_429_and_503(monkeypatch):
+    """Overload responses are transient: the client retries, sleeping the
+    server-advertised Retry-After (not its own backoff) when present."""
+    calls = _http_stub(monkeypatch, [
+        ("err", 429, 7),       # Retry-After overrides backoff
+        ("err", 503, None),    # no header: exponential backoff for attempt 1
+        ("ok", {"ok": True}, None),
+    ])
+    sleeps = []
+    c = ManagerClient("http://mgr.test", retries=3, backoff=0.2,
+                      sleep=sleeps.append)
+    assert c.ping() == {"ok": True}
+    assert len(calls) == 3
+    assert sleeps == [7.0, 0.4]  # advertised wait, then 0.2 * 2**1
+
+
+def test_client_retry_sleep_is_capped_by_deadline(monkeypatch):
+    """Retries are budgeted by total sleep, not just by count: a server
+    advertising huge Retry-After values fails the call instead of parking
+    the workflow."""
+    _http_stub(monkeypatch, [("err", 503, 8)])
+    sleeps = []
+    c = ManagerClient("http://mgr.test", retries=10, backoff=0.2,
+                      retry_deadline=10.0, sleep=sleeps.append)
+    with pytest.raises(ManagerClientError, match="retry budget exhausted"):
+        c.ping()
+    assert sleeps == [8.0]  # the second 8s wait would cross the 10s budget
+
+
+def test_client_non_retryable_http_error_still_fails_fast(monkeypatch):
+    calls = _http_stub(monkeypatch, [("err", 404, None)])
+    c = ManagerClient("http://mgr.test", retries=5, backoff=0.2,
+                      sleep=lambda s: pytest.fail("must not sleep on 4xx"))
+    with pytest.raises(ManagerClientError, match="404"):
+        c.ping()
+    assert len(calls) == 1
+
+
+def test_client_429_exhaustion_reports_overload(monkeypatch):
+    calls = _http_stub(monkeypatch, [("err", 429, 1)])
+    sleeps = []
+    c = ManagerClient("http://mgr.test", retries=2, backoff=0.2,
+                      sleep=sleeps.append)
+    with pytest.raises(ManagerClientError, match="overloaded .429. after 3"):
+        c.ping()
+    assert len(calls) == 3 and sleeps == [1.0, 1.0]
+
+
 def test_admin_cli_init_token(server, capsys):
     rc = admin_main(["init-token", "--server", server.url,
                      "--url", "https://pub.example.com", "--json"])

@@ -551,6 +551,61 @@ def test_repair_node_requires_an_unhealthy_node():
                              "cluster_name": "c1"}, be, ex))
 
 
+def test_repair_auto_target_errors_are_typed():
+    """Round-trip of the two distinguishable auto-target outcomes: all
+    nodes Ready raises NoUnhealthyNodesError; no answering health source
+    raises HealthLookupError — callers must never confuse "healthy" with
+    "blind" (a blind repair would conclude there is nothing to fix during
+    an outage, exactly when there is)."""
+    from triton_kubernetes_tpu.backends import MemoryBackend
+    from triton_kubernetes_tpu.config import Config, InputResolver
+    from triton_kubernetes_tpu.executor import LocalExecutor
+    from triton_kubernetes_tpu.workflows import (
+        HealthLookupError, NoUnhealthyNodesError, WorkflowContext,
+        new_cluster, new_manager, repair_node)
+
+    def ctx_for(values, be, ex):
+        cfg = Config(env={})
+        for k, v in values.items():
+            cfg.set(k, v)
+        return WorkflowContext(backend=be, executor=ex,
+                               resolver=InputResolver(cfg, None, True))
+
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None)
+    new_manager(ctx_for({"manager_cloud_provider": "bare-metal",
+                         "name": "m1", "host": "10.0.0.1"}, be, ex))
+    new_cluster(ctx_for({
+        "cluster_manager": "m1", "name": "c1",
+        "cluster_cloud_provider": "bare-metal", "host": "10.0.0.2",
+        "nodes": [{"hostname": "n", "node_count": 1,
+                   "rancher_host_label": "worker"}]}, be, ex))
+
+    # Everything Ready: the typed "genuinely nothing to repair" error
+    # (a WorkflowError subclass, so the CLI contract is unchanged).
+    with pytest.raises(NoUnhealthyNodesError, match="No unhealthy nodes"):
+        repair_node(ctx_for({"cluster_manager": "m1",
+                             "cluster_name": "c1"}, be, ex))
+
+    # No health source can answer (no applied outputs to read a cluster_id
+    # from): the typed "lookup failed" error instead — NOT "healthy".
+    doc = be.state("m1")
+
+    class BlindExecutor(LocalExecutor):
+        def output(self, state, key):
+            raise KeyError(key)
+
+        def cloud_view(self, state):
+            raise AssertionError("unreachable without a cluster_id")
+
+    bex = BlindExecutor(log=lambda m: None)
+    with pytest.raises(HealthLookupError,
+                       match="could not be determined"):
+        repair_node(ctx_for({"cluster_manager": "m1",
+                             "cluster_name": "c1"}, be, bex))
+    assert doc.nodes("cluster_bare-metal_c1")  # nothing was destroyed
+
+
 def test_get_cluster_warns_on_ca_checksum_mismatch(capsys):
     """A CA pin mismatch during the live-health read is a possible
     active-MITM indicator: it must surface as a warning, not be silently

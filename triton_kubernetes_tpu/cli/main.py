@@ -52,6 +52,7 @@ from ..workflows import (
     new_manager,
     new_node,
     repair_node,
+    repair_slice,
     restore_backup,
 )
 
@@ -105,10 +106,22 @@ def choose_executor(resolver: InputResolver, logger):
     cfg = resolver.config
     kind = cfg.get("executor") if cfg.is_set("executor") else "local"
     if kind == "local":
-        return LocalExecutor(log=logger.info, logger=logger)
+        from ..executor.engine import RetryPolicy
+
+        return LocalExecutor(log=logger.info, logger=logger,
+                             retry=RetryPolicy.from_config(cfg))
     if kind == "terraform":
         from ..executor.terraform import TerraformExecutor
 
+        # The retry/backoff knobs belong to the in-process engine; a real
+        # terraform run manages its own retries. Explicitly-set knobs must
+        # not be silently inert.
+        for knob in ("max_retries", "apply_deadline", "retry_backoff"):
+            if cfg.is_set(knob):
+                logger.log("warn",
+                           f"{knob} has no effect with executor: terraform "
+                           "(transient-fault retry is a local-executor "
+                           "feature)")
         kwargs = {}
         if cfg.is_set("terraform_binary"):
             kwargs["binary"] = str(cfg.get("terraform_binary"))
@@ -137,6 +150,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="structured JSON-lines log output")
     p.add_argument("--log-level", choices=["debug", "info", "warn", "error"],
                    default="info", help="log verbosity (default: info)")
+    p.add_argument("--max-retries", type=int, metavar="N",
+                   help="per-module retries for transient apply faults "
+                        "(default: 3; config key max_retries)")
+    p.add_argument("--apply-deadline", type=float, metavar="SECONDS",
+                   help="cap on total retry backoff per apply "
+                        "(default: 120; config key apply_deadline)")
 
     sub = p.add_subparsers(dest="command")
 
@@ -154,9 +173,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     repair = sub.add_parser(
         "repair",
-        help="replace a dead node (destroy + re-create, same config); "
-             "auto-targets the NotReady node `get cluster` reports")
-    repair.add_argument("kind", choices=["node"])
+        help="replace a dead node or preempted TPU slice (destroy + "
+             "re-create, same config); auto-targets the NotReady node / "
+             "preempted pool the state reports")
+    repair.add_argument("kind", choices=["node", "slice"])
 
     sub.add_parser(
         "validate",
@@ -192,6 +212,12 @@ def main(argv: Optional[List[str]] = None,
         # Same scalar coercion as YAML/env values, so --set confirm=false
         # really is False (a raw "false" string would be truthy).
         config.set(key, parse_scalar(value))
+    # Dedicated flags outrank --set only by being later: both land in the
+    # overrides layer, so the usual precedence story holds.
+    if args.max_retries is not None:
+        config.set("max_retries", args.max_retries)
+    if args.apply_deadline is not None:
+        config.set("apply_deadline", args.apply_deadline)
 
     if prompter is None:
         prompter = InteractivePrompter()
@@ -251,7 +277,7 @@ def main(argv: Optional[List[str]] = None,
             if result:
                 print(f"restored: {result}")
         elif args.command == "repair":
-            result = repair_node(ctx)
+            result = {"node": repair_node, "slice": repair_slice}[args.kind](ctx)
             if result:
                 print(f"repaired: {result}")
     except (WorkflowError, MissingInputError, ValidationError,

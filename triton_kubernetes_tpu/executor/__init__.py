@@ -18,21 +18,31 @@ from .interpolate import (
     resolve,
 )
 from .plan import Plan, PlanAction, diff_states
+from .cloudsim import FatalFaultError, FaultPlan, TransientFaultError
 from .drivers import driver_names, make_driver, register_driver
 from .engine import (
     ApplyError,
     ExecutorState,
+    FatalApplyError,
     LocalExecutor,
     OutputError,
+    RetryPolicy,
+    TransientApplyError,
 )
 from .terraform import TerraformExecutor
 
 __all__ = [
     "ApplyError",
     "ExecutorState",
+    "FatalApplyError",
+    "FatalFaultError",
+    "FaultPlan",
     "InterpolationError",
     "LocalExecutor",
     "OutputError",
+    "RetryPolicy",
+    "TransientApplyError",
+    "TransientFaultError",
     "Plan",
     "PlanAction",
     "TerraformExecutor",

@@ -37,24 +37,142 @@ class CloudSimError(RuntimeError):
     pass
 
 
+class TransientFaultError(CloudSimError):
+    """An injected fault a real fleet would retry through: a flaked
+    control-plane call (429/503), a boot that fails and succeeds on the
+    next attempt. The engine's retry/backoff loop consumes this type."""
+
+
+class FatalFaultError(CloudSimError):
+    """An injected fault retries cannot fix: quota exhausted, a config the
+    provider permanently rejects. The engine fails fast on this type."""
+
+
+class FaultPlan:
+    """Deterministic fault injection for the simulator.
+
+    No wall clock, no randomness: faults fire on exact operation matches
+    and a monotonic mutation counter (``ops``), so a seeded plan produces
+    the identical failure sequence on every run — and, because remaining
+    fire-counts serialize with the cloud state, across executor
+    invocations too (a re-run after a failed apply sees the plan exactly
+    where the failed run left it).
+
+    Spec format (JSON-able; see docs/guide/fault-tolerance.md)::
+
+        {"faults": [
+          # Fail an operation N times, then let it succeed (boot flake):
+          {"op": "create_resource", "match": {"name": "c1-worker-1"},
+           "times": 2, "kind": "transient", "error": "instance boot failed"},
+          # Drop/5xx any control-plane call once:
+          {"op": "register_node", "times": 1, "kind": "transient",
+           "error": "503 service unavailable"},
+          # Hard-fail (no retry can help):
+          {"op": "create_node_pool", "match": {"pool": "huge"},
+           "kind": "fatal", "error": "quota exceeded"},
+          # Preempt a named TPU slice when the mutation clock reaches 7:
+          {"op": "preempt", "slice_id": "ml-pool0", "at_op": 7},
+        ]}
+
+    ``match`` values substring-match the operation's info fields (type,
+    name, cluster, pool, hostname, ...); an absent ``match`` matches every
+    call of that op; ``op: "*"`` matches every mutating operation.
+    """
+
+    def __init__(self, spec: Optional[Dict[str, Any]] = None):
+        self.rules: List[Dict[str, Any]] = []
+        for rule in (spec or {}).get("faults", []):
+            r = dict(rule)
+            r.setdefault("times", 1)
+            r.setdefault("kind", "transient")
+            r.setdefault("fired", 0)
+            self.rules.append(r)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"faults": [dict(r) for r in self.rules]}
+
+    @staticmethod
+    def _matches(rule: Dict[str, Any], op: str, info: Dict[str, Any]) -> bool:
+        if rule.get("op") not in ("*", op):
+            return False
+        for key, want in (rule.get("match") or {}).items():
+            if str(want) not in str(info.get(key, "")):
+                return False
+        return True
+
+    def check(self, sim: "CloudSimulator", op: str,
+              info: Dict[str, Any]) -> None:
+        """Called by the simulator before each mutating operation (the
+        mutation clock has already ticked). Fires due preemptions, then
+        raises if an armed fault rule matches this call."""
+        for rule in self.rules:
+            if (rule.get("op") == "preempt" and not rule["fired"]
+                    and sim.ops >= int(rule.get("at_op", 0))):
+                rule["fired"] = 1
+                sim.preempt_slice(rule["slice_id"])
+        for rule in self.rules:
+            if rule.get("op") == "preempt" or rule["fired"] >= rule["times"]:
+                continue
+            if self._matches(rule, op, info):
+                rule["fired"] += 1
+                msg = rule.get("error") or f"injected fault on {op}"
+                exc = (FatalFaultError if rule["kind"] == "fatal"
+                       else TransientFaultError)
+                raise exc(f"{msg} (op={op}, "
+                          f"attempt {rule['fired']}/{rule['times']})")
+
+
 class CloudSimulator:
-    def __init__(self, state: Optional[Dict[str, Any]] = None):
+    def __init__(self, state: Optional[Dict[str, Any]] = None,
+                 fault_plan: Optional[Dict[str, Any]] = None):
         s = state or {}
         self.resources: Dict[str, Dict[str, Any]] = s.get("resources", {})
         self.managers: Dict[str, Dict[str, Any]] = s.get("managers", {})
         self.clusters: Dict[str, Dict[str, Any]] = s.get("clusters", {})
         self.manifests: Dict[str, List[Dict[str, Any]]] = s.get("manifests", {})
         self.serial: int = s.get("serial", 0)
+        # Mutation clock: every state-changing call ticks it exactly once.
+        # It anchors at_op preemptions and lets tests assert the zero-
+        # mutation no-op contract without wrapping the driver.
+        self.ops: int = s.get("ops", 0)
+        # Persisted plan state (with decremented fire-counts) wins over the
+        # UNCHANGED spec it came from, so fault sequences stay deterministic
+        # across the save/load round-trip of the executor state — but a
+        # *different* spec in the driver config re-arms fresh (the operator
+        # swapped injection scenarios on a live doc).
+        self._fault_spec: Optional[Dict[str, Any]] = s.get("fault_plan_spec")
+        if fault_plan and fault_plan != self._fault_spec:
+            self.fault_plan: Optional[FaultPlan] = FaultPlan(fault_plan)
+            self._fault_spec = fault_plan
+        elif "fault_plan" in s:
+            self.fault_plan = FaultPlan(s["fault_plan"])
+        else:
+            self.fault_plan = None
+
+    def _mutate(self, op: str, **info: Any) -> None:
+        """Tick the mutation clock and give the fault plan its shot. Every
+        mutating operation calls this first, before touching state, so an
+        injected failure always leaves the op not-yet-applied (the module
+        retries it via its own idempotent create-or-get)."""
+        self.ops += 1
+        if self.fault_plan is not None:
+            self.fault_plan.check(self, op, info)
 
     # ------------------------------------------------------------- serialization
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "resources": self.resources,
             "managers": self.managers,
             "clusters": self.clusters,
             "manifests": self.manifests,
             "serial": self.serial,
+            "ops": self.ops,
         }
+        if self.fault_plan is not None:
+            out["fault_plan"] = self.fault_plan.to_dict()
+            if self._fault_spec is not None:
+                out["fault_plan_spec"] = self._fault_spec
+        return out
 
     # ---------------------------------------------------------------- resources
     def _rkey(self, rtype: str, name: str) -> str:
@@ -62,6 +180,13 @@ class CloudSimulator:
 
     def create_resource(self, rtype: str, name: str, **attrs: Any) -> Dict[str, Any]:
         """Idempotent create-or-get of a generic cloud resource."""
+        self._mutate("create_resource", type=rtype, name=name)
+        return self._create_resource_record(rtype, name, **attrs)
+
+    def _create_resource_record(self, rtype: str, name: str,
+                                **attrs: Any) -> Dict[str, Any]:
+        """The create-or-get body, clock-free — for compound ops that have
+        already ticked the mutation clock once for the whole call."""
         key = self._rkey(rtype, name)
         if key not in self.resources:
             self.serial += 1
@@ -77,6 +202,7 @@ class CloudSimulator:
         return self.resources.get(self._rkey(rtype, name))
 
     def delete_resource(self, rtype: str, name: str) -> None:
+        self._mutate("delete_resource", type=rtype, name=name)
         self.resources.pop(self._rkey(rtype, name), None)
         if rtype == "manager":
             self.managers.pop(name, None)
@@ -97,6 +223,7 @@ class CloudSimulator:
         bash that logs into a fresh Rancher, mints a token and stores it in
         ``~/rancher_api_key``.
         """
+        self._mutate("bootstrap_manager", name=name, url=url)
         if name not in self.managers:
             self.managers[name] = {
                 "name": name,
@@ -123,6 +250,8 @@ class CloudSimulator:
         if absent, then mint a clusterregistrationtoken and read the CA
         checksum from /v3/settings/cacerts.
         """
+        self._mutate("create_or_get_cluster", name=cluster_name,
+                     url=manager_url)
         mgr = self._find_manager(manager_url)
         # Shared semantic core with the real control plane: same idempotency,
         # same id/token/CA-checksum derivation (manager/protocol.py).
@@ -141,6 +270,7 @@ class CloudSimulator:
         rancher/rancher-agent --server ... --token ... --ca-checksum ...
         --worker|--etcd|--controlplane``). Token+checksum pinning enforced.
         """
+        self._mutate("register_node", hostname=hostname)
         try:
             return protocol.register_node(
                 self.clusters, registration_token, hostname, roles,
@@ -153,6 +283,7 @@ class CloudSimulator:
         whichever cluster holds it — the node-module destroy path.
         Hostnames are unique per state doc (the create-node numbering
         contract), so a plain scan is unambiguous."""
+        self._mutate("deregister_node", hostname=hostname)
         for c in self.clusters.values():
             c["nodes"].pop(hostname, None)
 
@@ -166,6 +297,8 @@ class CloudSimulator:
                         reason: str = "") -> None:
         """Record a health transition (what the slice-health probe's
         readiness flip or a failed agent heartbeat reports)."""
+        self._mutate("set_node_health", cluster=cluster_id,
+                     hostname=hostname)
         c = self.cluster_by_id(cluster_id)
         if hostname not in c["nodes"]:
             raise CloudSimError(f"no node {hostname!r} in {cluster_id!r}")
@@ -185,11 +318,13 @@ class CloudSimulator:
         """Hosted control plane (GKE/AKS analog): no agent registration —
         nodes come from provider-managed node pools. Re-creates update attrs
         in place (k8s_version bumps etc.), preserving node pools."""
+        self._mutate("create_hosted_cluster", type=kind, name=name)
         key = self._rkey(f"{kind}_cluster", name)
         if key not in self.resources:
-            self.create_resource(f"{kind}_cluster", name,
-                                 endpoint=f"https://{name}.{kind}.local",
-                                 node_pools={}, **attrs)
+            # Clock-free inner create: this compound op already ticked once.
+            self._create_resource_record(f"{kind}_cluster", name,
+                                         endpoint=f"https://{name}.{kind}.local",
+                                         node_pools={}, **attrs)
         else:
             self.resources[key].update(attrs)
         return self.resources[key]
@@ -199,6 +334,8 @@ class CloudSimulator:
                          **attrs: Any) -> Dict[str, Any]:
         """Node pool on a hosted cluster; each node gets the provided labels
         (this is where TPU slice/ICI-coordinate labels land)."""
+        self._mutate("create_node_pool", type=kind, cluster=cluster_name,
+                     pool=pool_name)
         cluster = self.get_resource(f"{kind}_cluster", cluster_name)
         if cluster is None:
             raise CloudSimError(f"no {kind} cluster {cluster_name!r}")
@@ -217,6 +354,9 @@ class CloudSimulator:
         Schema-validates first (topology/validate.py) so the simulator
         rejects what a real API server would — renders are exercised like
         ``kubectl apply --dry-run=server``, in every workflow test."""
+        self._mutate("apply_manifest", cluster=cluster_id,
+                     kind=manifest.get("kind", ""),
+                     name=manifest.get("metadata", {}).get("name", ""))
         from ..topology.validate import validate_manifest
 
         validate_manifest(manifest)
@@ -230,6 +370,8 @@ class CloudSimulator:
 
     def delete_manifest(self, cluster_id: str, kind: str, name: str) -> bool:
         """kubectl-delete analog; returns True if the object existed."""
+        self._mutate("delete_manifest", cluster=cluster_id, kind=kind,
+                     name=name)
         objs = self.manifests.get(cluster_id, [])
         for i, m in enumerate(objs):
             if (m.get("kind"), m.get("metadata", {}).get("name")) == (kind, name):
@@ -242,3 +384,68 @@ class CloudSimulator:
         if kind is None:
             return objs
         return [o for o in objs if o.get("kind") == kind]
+
+    # --------------------------------------------------------- TPU preemption
+    def _slice_pools(self, slice_id: str):
+        """(cluster_resource, pool) pairs for a slice: matched by the
+        slice-id node label, or — for already-preempted pools whose labels
+        are gone — by the "<cluster>-<pool>" slice naming contract
+        (modules/gcp_tpu.py)."""
+        from ..topology.labels import LABEL_PREFIX
+
+        label = f"{LABEL_PREFIX}/slice-id"
+        for rec in self.resources.values():
+            for pool_name, pool in (rec.get("node_pools") or {}).items():
+                if (any(n.get("labels", {}).get(label) == slice_id
+                        for n in pool.get("nodes", []))
+                        or f"{rec.get('name')}-{pool_name}" == slice_id):
+                    yield rec, pool
+
+    def preempt_slice(self, slice_id: str) -> List[str]:
+        """Preempt a TPU slice: every host VM in its node pool is
+        reclaimed (the v5e/v5p spot/defragmentation event). The pool stays
+        on record but its nodes lose their ICI coordinate labels — exactly
+        what a real reclaim leaves behind: capacity gone, stale pool
+        object, scheduler labels meaningless. Mutates state directly (it
+        IS the fault), so it never ticks the mutation clock or re-enters
+        the fault plan."""
+        hit: List[str] = []
+        for _, pool in self._slice_pools(slice_id):
+            pool["preempted"] = True
+            for node in pool.get("nodes", []):
+                node["preempted"] = True
+                node["labels"] = {}
+                hit.append(node["name"])
+        if not hit:
+            raise CloudSimError(f"no node pool carries slice {slice_id!r}")
+        return hit
+
+    def cordon_slice(self, slice_id: str) -> List[str]:
+        """Mark a slice's surviving node objects unschedulable before
+        replacement (kubectl cordon analog) — repair must stop new pods
+        landing on a half-dead slice before it tears the pool down."""
+        hit: List[str] = []
+        for _, pool in self._slice_pools(slice_id):
+            for node in pool.get("nodes", []):
+                node["cordoned"] = True
+                hit.append(node["name"])
+        return hit
+
+    def preempted_slices(self) -> Dict[str, Dict[str, Any]]:
+        """{slice_id: {cluster, pool, nodes}} for every pool currently
+        marked preempted — what the slice-aware repair loop scans."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for rec in self.resources.values():
+            for pool_name, pool in (rec.get("node_pools") or {}).items():
+                if not pool.get("preempted"):
+                    continue
+                # The label is gone post-preemption; reconstruct the slice
+                # id from the naming contract (modules/gcp_tpu.py):
+                # slice_id = "<cluster>-<pool>".
+                slice_id = f"{rec['name']}-{pool_name}"
+                out[slice_id] = {
+                    "cluster": rec["name"],
+                    "pool": pool_name,
+                    "nodes": [n["name"] for n in pool.get("nodes", [])],
+                }
+        return out

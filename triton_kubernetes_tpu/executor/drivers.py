@@ -32,7 +32,11 @@ def register_driver(name: str, factory: DriverFactory) -> None:
 
 
 def _make_sim(cfg: Dict[str, Any], state: Dict[str, Any]) -> CloudSimulator:
-    return CloudSimulator(state)
+    # An optional ``fault_plan`` block in the driver config arms
+    # deterministic fault injection; once armed, the plan's live state
+    # (remaining fire-counts) rides the persisted cloud dict and wins over
+    # the config spec, so fault sequences survive state round-trips.
+    return CloudSimulator(state, fault_plan=cfg.get("fault_plan"))
 
 
 def _make_local_k8s(cfg: Dict[str, Any], state: Dict[str, Any]):

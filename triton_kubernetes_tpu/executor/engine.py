@@ -21,13 +21,14 @@ import copy
 import json
 import os
 import tempfile
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..state import StateDocument
 from ..modules import get_module
 from ..modules.base import DriverContext
-from .cloudsim import CloudSimulator
+from .cloudsim import CloudSimulator, FatalFaultError, TransientFaultError
 from .drivers import make_driver
 from .interpolate import module_dependencies, resolve, topo_order
 from .plan import Plan, PlanAction, diff_states
@@ -37,8 +38,70 @@ class ApplyError(RuntimeError):
     pass
 
 
+class TransientApplyError(ApplyError):
+    """A module apply kept failing on retryable faults (flaked control-plane
+    calls, boot failures) until retries/deadline ran out. The partial state
+    is journaled; a re-run resumes from the last healthy module."""
+
+
+class FatalApplyError(ApplyError):
+    """A module apply hit a fault retries cannot fix (permanent provider
+    rejection, quota). Fail fast — backoff would only delay the operator."""
+
+
 class OutputError(KeyError):
     pass
+
+
+@dataclass
+class RetryPolicy:
+    """Per-module retry/backoff knobs for transient apply faults.
+
+    Backoff is capped exponential: ``backoff * 2**attempt`` up to
+    ``backoff_cap`` per wait, and the *total* slept per apply is bounded by
+    ``deadline`` seconds — a fleet-wide outage must surface as an error,
+    not an apply that hangs for hours. With no faults no sleep ever
+    happens, so the policy is behavior-neutral on the happy path.
+    """
+
+    max_retries: int = 3
+    backoff: float = 0.5
+    backoff_cap: float = 8.0
+    deadline: float = 120.0
+
+    @staticmethod
+    def from_config(cfg) -> "RetryPolicy":
+        """Build from the config layer (``--max-retries``/
+        ``--apply-deadline`` CLI flags, ``TK8S_MAX_RETRIES``/
+        ``TK8S_APPLY_DEADLINE`` env, or YAML keys)."""
+        p = RetryPolicy()
+        if cfg.is_set("max_retries"):
+            p.max_retries = int(cfg.get("max_retries"))
+        if cfg.is_set("apply_deadline"):
+            p.deadline = float(cfg.get("apply_deadline"))
+        if cfg.is_set("retry_backoff"):
+            p.backoff = float(cfg.get("retry_backoff"))
+        return p
+
+    def delay(self, attempt: int) -> float:
+        return min(self.backoff * (2 ** attempt), self.backoff_cap)
+
+
+def classify_fault(exc: BaseException) -> str:
+    """``"transient"`` for faults worth retrying, ``"fatal"`` otherwise.
+
+    Typed simulator faults carry their own classification; real-driver
+    network/timeout errors are transient by nature; everything else
+    (validation, interpolation, contract violations) is fatal — retrying a
+    deterministic error just burns the deadline.
+    """
+    if isinstance(exc, TransientFaultError):
+        return "transient"
+    if isinstance(exc, FatalFaultError):
+        return "fatal"
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return "transient"
+    return "fatal"
 
 
 # In-process stores for the "memory" executor backend (tests).
@@ -52,9 +115,15 @@ class ExecutorState:
     modules: Dict[str, Any] = field(default_factory=dict)
     cloud: Dict[str, Any] = field(default_factory=dict)
     serial: int = 0
+    # Journal of the most recent apply: which modules completed, which
+    # failed with what classification, retries and backoff spent. Persisted
+    # with the state so a re-run (or an operator) can see exactly where a
+    # partial apply stopped.
+    journal: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"serial": self.serial, "modules": self.modules, "cloud": self.cloud}
+        return {"serial": self.serial, "modules": self.modules,
+                "cloud": self.cloud, "journal": self.journal}
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "ExecutorState":
@@ -62,6 +131,7 @@ class ExecutorState:
             modules=d.get("modules", {}),
             cloud=d.get("cloud", {}),
             serial=d.get("serial", 0),
+            journal=d.get("journal", {}),
         )
 
 
@@ -141,11 +211,15 @@ class LocalExecutor:
     """Drives modules in-process. The default executor everywhere."""
 
     def __init__(self, log: Optional[Callable[[str], None]] = None,
-                 logger=None):
+                 logger=None, retry: Optional[RetryPolicy] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
         from ..utils import get_logger
 
         self.logger = logger if logger is not None else get_logger()
         self.log = log or (lambda msg: self.logger.info(msg))
+        self.retry = retry if retry is not None else RetryPolicy()
+        # Injected sleeper: tests drive backoff without wall-clock waits.
+        self._sleep = sleep if sleep is not None else time.sleep
 
     # ------------------------------------------------------------------- plan
     def plan(self, doc: StateDocument, targets: Optional[List[str]] = None) -> Plan:
@@ -191,16 +265,32 @@ class LocalExecutor:
             name: rec.get("outputs", {}) for name, rec in est.modules.items()
         }
 
+        run_order = [n for n in order
+                     if plan.actions.get(n, PlanAction.NOOP)
+                     in (PlanAction.CREATE, PlanAction.UPDATE)]
+        est.journal = {
+            "doc": doc.name,
+            "order": run_order,
+            "completed": [],
+            "retries": {},
+            "backoff_total": 0.0,
+            "failed": None,
+            "status": "in-progress",
+        }
+        journal = est.journal
+
         # State is saved even on a mid-apply failure, so resources provisioned
         # before the error stay on record (terraform persists errored applies;
         # dropping the record would orphan real resources behind a real driver).
+        # It is also saved after EVERY completed module (not just at the end),
+        # so even a hard process kill resumes from the last healthy module.
+        current = ""  # in-flight module, for journal attribution
         try:
             with self.logger.span("apply", doc=doc.name), \
                     tempfile.TemporaryDirectory(prefix="tk-tpu-apply-") as workdir:
-                for name in order:
-                    action = plan.actions.get(name, PlanAction.NOOP)
-                    if action not in (PlanAction.CREATE, PlanAction.UPDATE):
-                        continue
+                for name in run_order:
+                    current = name
+                    action = plan.actions[name]
                     raw_cfg = desired[name]
                     module = get_module(raw_cfg.get("source", ""))
                     cfg = module.validate(raw_cfg)
@@ -211,10 +301,11 @@ class LocalExecutor:
                     ctx = DriverContext(cloud=cloud, workdir=workdir, module_key=name)
                     with self.logger.span(f"module.{name}", action=action.value,
                                           source=module.SOURCE):
-                        mod_outputs, resources = module.apply(resolved, ctx)
+                        mod_outputs, resources = self._apply_one_with_retry(
+                            name, module, resolved, ctx, journal)
                     missing = [o for o in module.OUTPUTS if o not in mod_outputs]
                     if missing:
-                        raise ApplyError(
+                        raise FatalApplyError(
                             f"module {name!r} did not produce outputs {missing}")
                     outputs[name] = mod_outputs
                     est.modules[name] = {
@@ -224,6 +315,10 @@ class LocalExecutor:
                         "outputs": mod_outputs,
                         "resources": [r.to_dict() for r in resources],
                     }
+                    journal["completed"].append(name)
+                    current = ""
+                    est.cloud = cloud.to_dict()
+                    save_executor_state(doc, est)
 
                 # Modules present in applied state but gone from the doc:
                 # prune dependents-first (same ordering contract as destroy()).
@@ -231,11 +326,63 @@ class LocalExecutor:
                 cfgs = {n: est.modules[n].get("config", {}) for n in est.modules}
                 prune_order = [n for n in topo_order(cfgs) if n in delete_names]
                 for name in reversed(prune_order):
+                    current = f"{name} (prune)"
                     self._destroy_one(name, est, cloud, workdir)
+            journal["status"] = "ok"
+        except BaseException as e:
+            if journal["failed"] is None:
+                journal["failed"] = {"module": current, "error": str(e),
+                                     "kind": classify_fault(e), "attempts": 1}
+            journal["status"] = "failed"
+            raise
         finally:
             est.cloud = cloud.to_dict()
             save_executor_state(doc, est)
         return plan
+
+    def _apply_one_with_retry(self, name: str, module, resolved, ctx,
+                              journal: Dict[str, Any]):
+        """Run one module's apply under the retry policy.
+
+        Transient faults retry with capped exponential backoff until
+        ``max_retries`` or the apply-wide ``deadline`` (total backoff
+        budget) runs out; fatal faults raise immediately. Retrying a
+        half-applied module is safe by contract: module applies are
+        idempotent create-or-get (modules/base.py), so completed ops no-op
+        and the module resumes at the op that failed.
+        """
+        policy = self.retry
+        attempt = 0
+        while True:
+            try:
+                result = module.apply(resolved, ctx)
+                journal["failed"] = None  # recovered: the record is history
+                return result
+            except Exception as e:
+                kind = classify_fault(e)
+                journal["failed"] = {"module": name, "error": str(e),
+                                     "kind": kind, "attempts": attempt + 1}
+                if kind == "fatal":
+                    if isinstance(e, ApplyError):
+                        raise
+                    raise FatalApplyError(f"module {name!r}: {e}") from e
+                if attempt >= policy.max_retries:
+                    raise TransientApplyError(
+                        f"module {name!r}: transient fault persisted after "
+                        f"{attempt + 1} attempts: {e}") from e
+                delay = policy.delay(attempt)
+                if journal["backoff_total"] + delay > policy.deadline:
+                    raise TransientApplyError(
+                        f"module {name!r}: apply deadline exhausted "
+                        f"({policy.deadline}s backoff budget) after "
+                        f"{attempt + 1} attempts: {e}") from e
+                attempt += 1
+                journal["retries"][name] = attempt
+                journal["backoff_total"] += delay
+                self.log(f"module.{name}: transient fault "
+                         f"(attempt {attempt}/{policy.max_retries}, "
+                         f"retry in {delay:g}s): {e}")
+                self._sleep(delay)
 
     # ---------------------------------------------------------------- destroy
     def destroy(self, doc: StateDocument, targets: Optional[List[str]] = None) -> None:

@@ -47,12 +47,17 @@ def _insecure_context() -> ssl.SSLContext:
 class ManagerClient:
     def __init__(self, url: str, access_key: str = "", secret_key: str = "",
                  retries: int = 3, backoff: float = 0.2,
-                 sleep=time.sleep, ca_pem: str = "", timeout: float = 30.0):
+                 sleep=time.sleep, ca_pem: str = "", timeout: float = 30.0,
+                 retry_deadline: float = 30.0):
         self.url = url.rstrip("/")
         self.access_key = access_key
         self.secret_key = secret_key
         self.retries = retries
         self.backoff = backoff
+        # Cap on the TOTAL sleep across one request's retries: a server
+        # advertising a huge Retry-After (or many small ones) must fail the
+        # call, not park the workflow indefinitely.
+        self.retry_deadline = retry_deadline
         self._sleep = sleep
         self.ca_pem = ca_pem
         self.timeout = timeout
@@ -100,29 +105,60 @@ class ManagerClient:
                 f"{self.access_key}:{self.secret_key}".encode()).decode()
             headers["Authorization"] = f"Basic {tok}"
         last: Optional[Exception] = None
+        slept = 0.0
         for attempt in range(self.retries + 1):
             req = urllib.request.Request(
                 f"{self.url}{path}", data=data, headers=headers,
                 method=method)
+            delay = self.backoff * (2 ** attempt)
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.timeout,
                         context=self._context()) as resp:
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as e:
+                if e.code in (429, 503):
+                    # Overload/unavailable is transient; the server's
+                    # Retry-After (delta-seconds) overrides our backoff.
+                    last = e
+                    retry_after = (e.headers or {}).get("Retry-After")
+                    if retry_after is not None:
+                        try:
+                            delay = max(0.0, float(retry_after))
+                        except ValueError:
+                            pass  # HTTP-date form: keep computed backoff
+                    if attempt < self.retries:
+                        if slept + delay > self.retry_deadline:
+                            raise ManagerClientError(
+                                f"{method} {path} -> {e.code}: retry "
+                                f"budget exhausted ({slept:.1f}s slept, "
+                                f"deadline {self.retry_deadline:g}s)") from e
+                        slept += delay
+                        self._sleep(delay)
+                    continue
                 detail = ""
                 try:
                     detail = json.loads(e.read() or b"{}").get("message", "")
                 except ValueError:
                     pass
-                # 4xx is a contract error — retrying cannot help.
+                # Other 4xx/5xx is a contract error — retrying cannot help.
                 raise ManagerClientError(
                     f"{method} {path} -> {e.code}"
                     + (f": {detail}" if detail else "")) from e
             except (urllib.error.URLError, OSError, TimeoutError) as e:
                 last = e
                 if attempt < self.retries:
-                    self._sleep(self.backoff * (2 ** attempt))
+                    if slept + delay > self.retry_deadline:
+                        raise ManagerClientError(
+                            f"{method} {path}: retry budget exhausted "
+                            f"({slept:.1f}s slept, deadline "
+                            f"{self.retry_deadline:g}s): {e}") from e
+                    slept += delay
+                    self._sleep(delay)
+        if isinstance(last, urllib.error.HTTPError):
+            raise ManagerClientError(
+                f"{method} {path}: manager overloaded ({last.code}) after "
+                f"{self.retries + 1} attempts") from last
         raise ManagerClientError(
             f"{method} {path}: manager unreachable after "
             f"{self.retries + 1} attempts: {last}") from last

@@ -23,6 +23,7 @@ from .labels import (
     LABEL_PREFIX,
     host_labels_for_slice,
     selector_for_slice,
+    verify_slice_labels,
 )
 from .jobset import render_headless_service, render_jobset
 
@@ -39,4 +40,5 @@ __all__ = [
     "render_headless_service",
     "render_jobset",
     "selector_for_slice",
+    "verify_slice_labels",
 ]

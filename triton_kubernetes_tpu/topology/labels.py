@@ -48,6 +48,30 @@ def host_labels_for_slice(spec: SliceSpec, slice_id: str) -> List[Dict[str, str]
     return out
 
 
+def verify_slice_labels(node_labels: List[Dict[str, str]],
+                        spec: SliceSpec, slice_id: str) -> List[str]:
+    """Check a pool's per-host labels form the complete, correctly-ordered
+    ICI coordinate set for ``spec`` — the post-repair invariant: a replaced
+    slice whose coordinates are missing or shuffled would let a "slice-
+    contiguous" placement silently straddle physical hosts. Returns a list
+    of human-readable problems; empty means the labels are exactly what
+    ``host_labels_for_slice`` would emit."""
+    expected = host_labels_for_slice(spec, slice_id)
+    problems: List[str] = []
+    if len(node_labels) != len(expected):
+        problems.append(
+            f"slice {slice_id}: {len(node_labels)} labeled hosts, "
+            f"expected {len(expected)}")
+        return problems
+    for worker_id, (got, want) in enumerate(zip(node_labels, expected)):
+        for key, value in want.items():
+            if got.get(key) != value:
+                problems.append(
+                    f"slice {slice_id} worker {worker_id}: label {key}="
+                    f"{got.get(key)!r}, expected {value!r}")
+    return problems
+
+
 def selector_for_slice(spec: SliceSpec, slice_id: str) -> Dict[str, str]:
     """nodeSelector that pins a workload to one slice — the guarantee that a
     64-chip job never straddles slices (SURVEY.md §7 "hard parts")."""

@@ -16,11 +16,21 @@ from .backup import new_backup
 from .restore import restore_backup
 from .destroy import delete_cluster, delete_manager, delete_node
 from .get import get_cluster, get_manager
-from .repair import repair_node
+from .repair import (
+    HealthLookupError,
+    NoPreemptedSlicesError,
+    NoUnhealthyNodesError,
+    repair_node,
+    repair_slice,
+)
 
 __all__ = [
+    "HealthLookupError",
+    "NoPreemptedSlicesError",
+    "NoUnhealthyNodesError",
     "WorkflowContext",
     "WorkflowError",
+    "repair_slice",
     "delete_cluster",
     "delete_manager",
     "delete_node",

@@ -1,19 +1,33 @@
-"""``repair node`` workflow — failure detection's consumer, closed loop.
+"""``repair {node,slice}`` workflows — failure detection's consumer, closed loop.
 
 The reference has no repair verb: its agents ride
 ``--restart=unless-stopped`` + Rancher reconciliation, and a genuinely
 dead host is replaced by hand (destroy node, create node). ``get
 cluster`` here already *names* that cycle for NotReady nodes
-(workflows/get.py hint); this verb executes it: pick the dead node
+(workflows/get.py hint); ``repair node`` executes it: pick the dead node
 (``--set hostname=...`` or auto-target from the same health sources the
 hint reads), confirm, targeted destroy of its module, re-add the SAME
 module config (same hostname, same machine shape), apply. The replacement
 host runs the agent bootstrap again and re-registers with the manager,
 clearing the stale-heartbeat NotReady.
+
+``repair slice`` is the TPU-native variant: on real v5e/v5p fleets the
+dominant fault is a *preempted slice* (spot reclaim, defragmentation) —
+all hosts of a pool vanish together and replacement is per-slice, not
+per-host. The loop: detect preempted pools from the driver's cloud state,
+cordon the surviving node objects, destroy + re-apply the pool's module
+with its identical config, then verify the replacement carries the exact
+ICI mesh coordinate labels (topology/labels.py) — a slice that comes back
+with shuffled coordinates would break slice-contiguous scheduling
+silently.
 """
 
 from __future__ import annotations
 
+from typing import Dict
+
+from ..state import parse_cluster_key
+from ..topology import SliceSpec, verify_slice_labels
 from .common import (
     WorkflowContext,
     WorkflowError,
@@ -21,6 +35,21 @@ from .common import (
     select_manager,
 )
 from .get import _node_health
+
+
+class HealthLookupError(WorkflowError):
+    """No health source (live manager, driver view) could answer — which is
+    NOT the same as "everything is healthy". Auto-targeting must refuse
+    loudly rather than conclude there is nothing to repair."""
+
+
+class NoUnhealthyNodesError(WorkflowError):
+    """Health lookup succeeded and every node reports Ready — there is
+    genuinely nothing to repair."""
+
+
+class NoPreemptedSlicesError(WorkflowError):
+    """The driver's cloud state records no preempted TPU slice pools."""
 
 
 def repair_node(ctx: WorkflowContext) -> str:
@@ -64,17 +93,24 @@ def _pick_unhealthy(ctx: WorkflowContext, state, cluster_key: str,
                     nodes) -> str:
     """Auto-target: the NotReady node, from the same health sources the
     ``get cluster`` hint reads (live manager heartbeat, then driver/
-    simulator view)."""
+    simulator view). Raises :class:`HealthLookupError` when no source
+    answered and :class:`NoUnhealthyNodesError` when all nodes are Ready —
+    callers (and operators) must be able to tell "healthy" from "blind"."""
     try:
         outputs = ctx.executor.output(state, cluster_key)
     except Exception:
         outputs = {}
     health = _node_health(ctx, state, outputs.get("cluster_id"),
-                          outputs.get("ca_checksum", "")) or {}
+                          outputs.get("ca_checksum", ""))
+    if health is None:
+        raise HealthLookupError(
+            "Node health could not be determined (no reachable manager or "
+            "driver view) — name the node to replace with --set "
+            "hostname=<name> if you know which one is dead.")
     dead = sorted(h for h, st in health.items()
                   if not st.get("ready") and h in nodes)
     if not dead:
-        raise WorkflowError(
+        raise NoUnhealthyNodesError(
             "No unhealthy nodes detected — name the node to replace with "
             "--set hostname=<name> if you want to repair one anyway.")
     if len(dead) == 1:
@@ -85,3 +121,113 @@ def _pick_unhealthy(ctx: WorkflowContext, state, cluster_key: str,
             "--set hostname=<name>.")
     return ctx.resolver.prompter.select(
         "Unhealthy node to repair", [(h, h) for h in dead])
+
+
+# --------------------------------------------------------------- slice repair
+
+def repair_slice(ctx: WorkflowContext) -> str:
+    """Replace a preempted TPU slice pool and restore its ICI labels.
+
+    Detect → cordon → replace → re-label → verify, all against the
+    driver's persisted cloud state. The replacement re-applies the pool
+    module's IDENTICAL config, so the new pool lands with the same slice
+    id, topology, and per-host coordinate labels the scheduler was
+    promised (modules/gcp_tpu.py re-derives them via
+    topology/labels.host_labels_for_slice).
+    """
+    r = ctx.resolver
+    manager = select_manager(ctx)
+    state = ctx.backend.state(manager)
+    _, cluster_key = select_cluster(ctx, state)
+    state.set_backend_config(ctx.backend.executor_backend_config(manager))
+    if not hasattr(ctx.executor, "cloud_view"):
+        raise WorkflowError(
+            "repair slice needs the in-process executor's cloud view "
+            "(executor: terraform cannot introspect pool preemption).")
+
+    nodes = state.nodes(cluster_key)  # pool name -> module key (gcp-tpu)
+    _, cluster_name = parse_cluster_key(cluster_key)
+    view = ctx.executor.cloud_view(state)
+    # Filter on cluster AND pool: sibling clusters reuse default pool
+    # names ("pool0"), and a preemption over there must never churn this
+    # cluster's healthy pool.
+    preempted = {
+        sid: info for sid, info in view.preempted_slices().items()
+        if info["cluster"] == cluster_name and info["pool"] in nodes
+    }
+    slice_id = _pick_preempted(ctx, preempted)
+    if slice_id in preempted:
+        pool_name = preempted[slice_id]["pool"]
+    else:
+        # Explicit --set slice_id override for a pool the state does not
+        # record as preempted (operator knows better than the record).
+        pool_name = next((p for p in nodes
+                          if f"{cluster_name}-{p}" == slice_id), None)
+        if pool_name is None:
+            raise WorkflowError(
+                f"Slice '{slice_id}' does not match any pool of cluster "
+                f"'{cluster_name}'.")
+    pool_key = nodes[pool_name]
+
+    if not r.confirm("confirm",
+                     f"Proceed? This will cordon and replace the preempted "
+                     f"slice '{slice_id}' (pool '{pool_name}')"):
+        return ""
+
+    # Cordon the stale node objects before teardown: nothing new may
+    # schedule onto a half-dead slice while it is being replaced.
+    from ..executor.engine import load_executor_state, save_executor_state
+
+    est = load_executor_state(state)
+    from ..executor.cloudsim import CloudSimulator
+
+    sim = CloudSimulator(est.cloud)
+    sim.cordon_slice(slice_id)
+    est.cloud = sim.to_dict()
+    save_executor_state(state, est)
+
+    # Replace: same module config, so the pool comes back with the same
+    # accelerator, topology, and slice id (a repair is a replacement).
+    pool_cfg = dict(state.get(f"module.{pool_key}"))
+    ctx.executor.destroy(state, targets=[pool_key])
+    state.delete(f"module.{pool_key}")
+    ctx.backend.persist(state)
+    state.set(f"module.{pool_key}", pool_cfg)
+    ctx.executor.apply(state)
+    ctx.backend.persist(state)
+
+    # Verify the restored ICI coordinate labels — the whole point of the
+    # slice-aware path. The pool module's outputs name the cluster/pool;
+    # read the replacement's per-node labels back from the cloud state.
+    spec = SliceSpec.from_accelerator(
+        pool_cfg["tpu_accelerator"], pool_cfg.get("tpu_topology") or None)
+    view2 = ctx.executor.cloud_view(state)
+    gke = view2.get_resource("gke_cluster", cluster_name)
+    pool = (gke or {}).get("node_pools", {}).get(pool_name, {})
+    labels = [n.get("labels", {}) for n in pool.get("nodes", [])]
+    problems = verify_slice_labels(labels, spec, slice_id)
+    if problems:
+        raise WorkflowError(
+            "slice replacement came back with wrong ICI labels: "
+            + "; ".join(problems))
+    return pool_key
+
+
+def _pick_preempted(ctx: WorkflowContext,
+                    preempted: Dict[str, Dict]) -> str:
+    """Auto-target the preempted slice (or honor ``--set slice_id=...``,
+    which may name a pool the state does not record as preempted)."""
+    if ctx.config.is_set("slice_id"):
+        return str(ctx.config.get("slice_id"))
+    if not preempted:
+        raise NoPreemptedSlicesError(
+            "No preempted TPU slices detected — name one with --set "
+            "slice_id=<cluster>-<pool> if you want to replace it anyway.")
+    if len(preempted) == 1:
+        return next(iter(preempted))
+    if ctx.non_interactive:
+        raise WorkflowError(
+            f"Multiple preempted slices: {sorted(preempted)}. Repair one "
+            "at a time with --set slice_id=<id>.")
+    return ctx.resolver.prompter.select(
+        "Preempted slice to replace", [(s, s) for s in sorted(preempted)])
