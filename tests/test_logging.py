@@ -39,6 +39,40 @@ def test_json_mode_records():
     assert isinstance(rec["ts"], float)
 
 
+def test_text_mode_prefixes_full_span_chain():
+    """Text mode shows the same parent/child chain JSON mode puts in the
+    `span` field (it used to truncate to the innermost span)."""
+    buf = io.StringIO()
+    log = Logger(stream=buf, level="info")
+    with log.span("apply"):
+        with log.span("module.cluster-manager"):
+            log.info("working")
+    assert "[apply/module.cluster-manager] working" in _lines(buf)
+
+
+def test_unknown_level_raises_value_error():
+    log = Logger(stream=io.StringIO())
+    with pytest.raises(ValueError, match=r"unknown log level 'verbose'.*"
+                                         r"debug.*info.*warn.*error"):
+        log.log("verbose", "msg")
+    with pytest.raises(ValueError, match="unknown log level"):
+        Logger(stream=io.StringIO(), level="trace")
+    with pytest.raises(ValueError, match="unknown log level"):
+        configure(level="loud")
+    configure()  # restore a sane default for other tests
+
+
+def test_cli_rejects_bad_log_level(capsys):
+    """--log-level is validated at parse time (argparse choices), before
+    any logger exists to misconfigure."""
+    from triton_kubernetes_tpu.cli.main import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--log-level", "verbose", "version"])
+    assert exc.value.code == 2
+    assert "invalid choice" in capsys.readouterr().err
+
+
 def test_span_timing_and_nesting():
     buf = io.StringIO()
     log = Logger(stream=buf, json_mode=True, level="debug")
@@ -67,6 +101,35 @@ def test_span_failure_logs_error_and_reraises():
     # Stack unwound: a fresh record carries no span.
     log.info("after")
     assert "span" not in json.loads(_lines(buf)[-1])
+
+
+def test_spans_export_chrome_trace_events():
+    """A TraceCollector attached to the logger receives one complete
+    ("ph": "X") event per finished span, nesting path included, failed
+    spans tagged with the error."""
+    from triton_kubernetes_tpu.utils.trace import TraceCollector
+
+    tr = TraceCollector()
+    log = Logger(stream=io.StringIO(), trace=tr)
+    with log.span("apply", doc="dev"):
+        with log.span("module.m1"):
+            pass
+    with pytest.raises(ValueError):
+        with log.span("destroy"):
+            raise ValueError("kaboom")
+    events = {e["name"]: e for e in tr.events()}
+    assert set(events) == {"apply", "module.m1", "destroy"}
+    assert events["module.m1"]["args"]["path"] == "apply/module.m1"
+    assert events["apply"]["args"]["doc"] == "dev"
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events.values())
+    assert events["apply"]["dur"] >= events["module.m1"]["dur"]
+    assert events["destroy"]["args"]["error"] == "kaboom"
+    assert "error" not in events["apply"]["args"]
+    # Serialized form is the Trace Event Format JSON object shape.
+    d = tr.to_dict()
+    assert set(d) == {"traceEvents", "displayTimeUnit"}
+    assert [e["ts"] for e in d["traceEvents"]] == sorted(
+        e["ts"] for e in d["traceEvents"])
 
 
 def test_configure_swaps_default_logger():

@@ -1,0 +1,124 @@
+"""train/measure.py + train/mfu.py: the numbers bench.py publishes.
+
+These were only exercised indirectly (bench.py, sweeps); here the
+arithmetic is pinned directly — two-point timing against a fake clock,
+the window contract, and the tokens/sec -> MFU chain on a real (tiny)
+CPU-mesh train step.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.models import get_config
+from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh
+from triton_kubernetes_tpu.topology.slices import SliceSpec
+from triton_kubernetes_tpu.train import measure
+from triton_kubernetes_tpu.train.measure import measure_tokens_per_sec
+from triton_kubernetes_tpu.train.mfu import (
+    attention_flops_fraction,
+    flops_per_token,
+    mfu,
+    mfu_on_slice,
+    tokens_per_sec_for_mfu,
+)
+
+
+def _fake_clock_step(monkeypatch, seconds_per_step):
+    """A step fn that advances a fake perf_counter by a fixed amount, so
+    the two-point timing arithmetic is exact."""
+    clock = {"t": 0.0}
+    monkeypatch.setattr(measure.time, "perf_counter", lambda: clock["t"])
+
+    def step(state, batch):
+        clock["t"] += seconds_per_step
+        return state + 1, {"loss": 2.5}
+
+    return step
+
+
+def test_measure_two_point_arithmetic(monkeypatch):
+    step = _fake_clock_step(monkeypatch, seconds_per_step=0.25)
+    tps, loss, state = measure_tokens_per_sec(
+        step, 0, [{"tokens": None}], tokens_per_step=1024,
+        warmup=1, n_short=2, n_long=6)
+    # dt = (6 - 2) * 0.25 = 1.0s for (6 - 2) * 1024 tokens: the fixed
+    # dispatch overhead cancels and only the marginal step cost remains.
+    assert tps == pytest.approx(4 * 1024 / 1.0)
+    assert loss == 2.5
+    assert state == 1 + 2 + 6  # warmup + short + long windows all ran
+
+
+def test_measure_requires_long_window_to_exceed_short(monkeypatch):
+    step = _fake_clock_step(monkeypatch, 0.1)
+    with pytest.raises(ValueError, match="must exceed"):
+        measure_tokens_per_sec(step, 0, [{}], 1, warmup=0,
+                               n_short=3, n_long=3)
+
+
+def test_measure_on_tiny_cpu_mesh_step(cpu_mesh_devices):
+    """End to end on a real sharded step: tokens/sec is positive and the
+    measured loss is the device-synced training loss."""
+    import jax.numpy as jnp
+
+    from triton_kubernetes_tpu.train import (
+        init_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from triton_kubernetes_tpu.train.data import synthetic_batches
+
+    cfg = get_config("llama-test")
+    mesh = create_mesh(MeshConfig(fsdp=4, tensor=2))
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    state = init_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    batch_size, seq_len = 4, 32
+    batch = {"tokens": jnp.asarray(next(synthetic_batches(
+        cfg.vocab_size, batch_size, seq_len))["tokens"])}
+
+    tps, loss, state = measure_tokens_per_sec(
+        step, state, [batch], tokens_per_step=batch_size * seq_len,
+        warmup=1, n_short=1, n_long=3)
+    assert tps > 0 and np.isfinite(loss)
+    assert int(state.step) == 1 + 1 + 3
+    # The measured throughput feeds the MFU chain coherently.
+    got = mfu(tps, cfg, seq_len, peak_tflops_total=197.0)
+    assert got == pytest.approx(
+        tps * flops_per_token(cfg, seq_len) / (197.0 * 1e12))
+    assert 0 < got < 1  # a tiny CPU step is nowhere near a TPU peak
+
+
+def test_mfu_arithmetic_and_inverse():
+    cfg = get_config("llama3-8b")
+    # mfu is linear in tokens/sec and inverse in peak.
+    assert mfu(2000, cfg, 8192, 459.0) == pytest.approx(
+        2 * mfu(1000, cfg, 8192, 459.0))
+    assert mfu(1000, cfg, 8192, 2 * 459.0) == pytest.approx(
+        mfu(1000, cfg, 8192, 459.0) / 2)
+    # tokens_per_sec_for_mfu is the exact inverse of mfu.
+    for target in (0.1, 0.4, 0.6):
+        tps = tokens_per_sec_for_mfu(target, cfg, 8192, 459.0 * 64)
+        assert mfu(tps, cfg, 8192, 459.0 * 64) == pytest.approx(target)
+
+
+def test_mfu_on_slice_uses_generation_peak():
+    cfg = get_config("llama3-8b")
+    spec = SliceSpec.from_accelerator("v5p-8")
+    direct = mfu(5000, cfg, 8192, spec.peak_bf16_tflops)
+    assert mfu_on_slice(5000, cfg, 8192, spec) == pytest.approx(direct)
+
+
+def test_attention_flops_fraction_grows_with_seq():
+    cfg = get_config("llama3-8b")
+    f_short = attention_flops_fraction(cfg, 2048)
+    f_long = attention_flops_fraction(cfg, 8192)
+    assert 0 < f_short < f_long < 1
+    # Definition check: fraction * total == the non-6N attention part.
+    total = flops_per_token(cfg, 8192)
+    assert f_long * total == pytest.approx(
+        total - 6.0 * cfg.active_params())
+    assert math.isclose(
+        flops_per_token(cfg, 8192, causal=False) - total,
+        0.5 * 12.0 * cfg.num_layers * cfg.num_heads * cfg.head_dim * 8192)

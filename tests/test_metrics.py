@@ -1,0 +1,451 @@
+"""Metrics registry + Prometheus/trace exposition, unit and end to end.
+
+Pins the observability contract: registry semantics (counter/gauge/
+histogram, labels, concurrency), Prometheus text rendering, the
+instrumented hot paths (a faulted cloudsim apply moves the retry/fault
+counters and the module-duration histogram), the manager's ``GET
+/metrics``/``GET /healthz``, the manager-client request metrics, and
+``--trace-out`` producing Chrome trace events that agree with the apply
+journal to the microsecond.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from triton_kubernetes_tpu.backends import LocalBackend
+from triton_kubernetes_tpu.executor import (
+    LocalExecutor,
+    RetryPolicy,
+    TransientApplyError,
+)
+from triton_kubernetes_tpu.executor.engine import (
+    _MEMORY_STATES,
+    load_executor_state,
+)
+from triton_kubernetes_tpu.manager import ManagerClient, ManagerServer
+from triton_kubernetes_tpu.state import StateDocument
+from triton_kubernetes_tpu.utils import metrics
+from triton_kubernetes_tpu.utils.metrics import (
+    CATALOG,
+    MetricsRegistry,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Each test gets its own process-default registry (call sites resolve
+    the default dynamically, so swapping is enough)."""
+    reg = metrics.configure()
+    yield reg
+    metrics.configure()
+    _MEMORY_STATES.clear()
+
+
+# ----------------------------------------------------------- registry units
+
+def test_counter_labels_and_monotonicity():
+    c = metrics.counter("t_total", "help", ("module",))
+    assert c.value(module="a") == 0.0
+    c.inc(module="a")
+    c.inc(2.5, module="a")
+    c.inc(module="b")
+    assert c.value(module="a") == 3.5
+    assert c.value(module="b") == 1.0
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1, module="a")
+    with pytest.raises(ValueError, match="takes labels"):
+        c.inc(wrong="a")
+    with pytest.raises(ValueError, match="takes labels"):
+        c.inc()  # labeled family: bare inc is a schema violation
+
+
+def test_gauge_set_inc_dec():
+    g = metrics.gauge("t_inflight", "help")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4.0
+
+
+def test_histogram_buckets_sum_count():
+    h = metrics.histogram("t_seconds", "help", ("op",),
+                          buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v, op="x")
+    assert h.count(op="x") == 4
+    assert h.sum(op="x") == pytest.approx(55.55)
+    (s,) = h.samples()
+    # Cumulative per Prometheus semantics; +Inf covers everything.
+    assert s["buckets"] == {"0.1": 1, "1": 2, "10": 3, "+Inf": 4}
+
+
+def test_create_or_get_is_idempotent_but_typed():
+    a = metrics.counter("t_x_total", "help", ())
+    assert metrics.counter("t_x_total") is a
+    with pytest.raises(ValueError, match="already registered as counter"):
+        metrics.gauge("t_x_total")
+    with pytest.raises(ValueError, match="already registered with labels"):
+        metrics.counter("t_x_total", labelnames=("k",))
+
+
+def test_catalog_supplies_help_and_labels():
+    """Instrumented call sites pass only the name; help/labels come from
+    the one CATALOG that docs and `tk8s metrics` share."""
+    c = metrics.counter("tk8s_apply_retries_total")
+    assert c.labelnames == ("module",)
+    assert "transient" in c.help
+    h = metrics.histogram("tk8s_module_apply_duration_seconds")
+    assert h.buckets == metrics.DEFAULT_BUCKETS
+
+
+def test_concurrent_increments_do_not_drop():
+    c = metrics.counter("t_conc_total", "help", ("worker",))
+    h = metrics.histogram("t_conc_seconds", "help", (), buckets=(1.0,))
+
+    def work(i):
+        for _ in range(1000):
+            c.inc(worker=str(i % 2))
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(worker="0") + c.value(worker="1") == 8000
+    assert h.count() == 8000
+
+
+def test_registry_isolation_and_reset():
+    reg = MetricsRegistry()
+    reg.counter("t_only_here_total", "h", ()).inc()
+    assert "t_only_here_total" not in metrics.get_registry().snapshot()
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+# ----------------------------------------------------- prometheus rendering
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'  # value may escape " \ n
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                      # metric name
+    rf'(\{{{_LABEL}(,{_LABEL})*\}})? '                # optional label set
+    r'(-?\d+(\.\d+)?([eE][-+]?\d+)?|\+Inf|-Inf|NaN)$')  # value
+
+
+def assert_valid_prometheus(text):
+    """Every non-comment line must be a well-formed sample line."""
+    lines = [ln for ln in text.splitlines() if ln]
+    for ln in lines:
+        if ln.startswith("# HELP ") or ln.startswith("# TYPE "):
+            continue
+        assert _SAMPLE_RE.match(ln), f"bad exposition line: {ln!r}"
+    return lines
+
+
+def _parse_samples(text):
+    out = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name_labels, _, value = ln.rpartition(" ")
+        out[name_labels] = float(value.replace("+Inf", "inf"))
+    return out
+
+
+def test_prometheus_rendering_round_trip():
+    reg = metrics.get_registry()
+    reg.counter("t_reqs_total", "requests", ("code",)).inc(3, code="200")
+    reg.gauge("t_depth", "queue depth").set(2)
+    h = reg.histogram("t_lat_seconds", "latency", (), buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = reg.render_prometheus()
+    assert_valid_prometheus(text)
+    assert "# TYPE t_reqs_total counter" in text
+    assert "# TYPE t_depth gauge" in text
+    assert "# TYPE t_lat_seconds histogram" in text
+    samples = _parse_samples(text)
+    assert samples['t_reqs_total{code="200"}'] == 3
+    assert samples["t_depth"] == 2
+    assert samples['t_lat_seconds_bucket{le="0.1"}'] == 1
+    assert samples['t_lat_seconds_bucket{le="1"}'] == 2
+    assert samples['t_lat_seconds_bucket{le="+Inf"}'] == 2
+    assert samples["t_lat_seconds_sum"] == pytest.approx(0.55)
+    assert samples["t_lat_seconds_count"] == 2
+    # Round-trip: the parsed text agrees with the JSON snapshot.
+    snap = reg.snapshot()
+    assert snap["t_reqs_total"]["series"][0]["value"] == 3
+    assert snap["t_lat_seconds"]["series"][0]["count"] == 2
+
+
+def test_label_values_are_escaped():
+    reg = metrics.get_registry()
+    reg.counter("t_esc_total", "h", ("msg",)).inc(
+        msg='say "hi"\nback\\slash')
+    text = reg.render_prometheus()
+    assert '\\"hi\\"' in text and "\\n" in text and "\\\\slash" in text
+    assert_valid_prometheus(text)
+
+
+def test_snapshot_is_json_able():
+    reg = metrics.get_registry()
+    reg.register_catalog()
+    reg.counter("tk8s_apply_retries_total").inc(module="m")
+    json.dumps(reg.snapshot())  # must not raise
+
+
+def test_register_catalog_exposes_every_family():
+    reg = metrics.get_registry()
+    reg.register_catalog()
+    snap = reg.snapshot()
+    for name, (kind, _, labelnames, _) in CATALOG.items():
+        assert snap[name]["type"] == kind
+        assert snap[name]["labelnames"] == list(labelnames)
+
+
+# -------------------------------------------------- end-to-end: faulted apply
+
+def _faulted_manager_doc():
+    doc = StateDocument("m1")
+    doc.set_backend_config({"memory": {"name": "m1"}})
+    doc.set("driver", {"name": "sim", "fault_plan": {"faults": [
+        {"op": "create_resource", "match": {"name": "m1-manager"},
+         "times": 2, "error": "instance boot failed"}]}})
+    doc.set_manager({"source": "modules/bare-metal-manager",
+                     "name": "m1", "host": "192.168.0.10"})
+    return doc
+
+
+def test_faulted_apply_moves_retry_and_fault_counters():
+    doc = _faulted_manager_doc()
+    sleeps = []
+    ex = LocalExecutor(log=lambda m: None, sleep=sleeps.append,
+                       retry=RetryPolicy(max_retries=3, backoff=0.5))
+    ex.apply(doc)
+
+    retries = metrics.counter("tk8s_apply_retries_total")
+    assert retries.value(module="cluster-manager") == 2
+    assert metrics.counter("tk8s_module_apply_attempts_total").value(
+        module="cluster-manager") == 3
+    assert metrics.counter("tk8s_apply_faults_total").value(
+        kind="transient") == 2
+    assert metrics.counter("tk8s_cloudsim_faults_total").value(
+        kind="transient") == 2
+    assert metrics.counter("tk8s_apply_backoff_seconds_total").value() \
+        == pytest.approx(sum(sleeps)) and sum(sleeps) > 0
+    assert metrics.counter("tk8s_applies_total").value(status="ok") == 1
+
+    h = metrics.histogram("tk8s_module_apply_duration_seconds")
+    assert h.count(module="cluster-manager") == 1
+    # The histogram observation IS the journal duration (one truth).
+    journal = load_executor_state(doc).journal
+    assert h.sum(module="cluster-manager") == pytest.approx(
+        journal["durations"]["cluster-manager"])
+    assert metrics.counter("tk8s_cloudsim_ops_total").value(
+        op="create_resource") >= 1
+    assert metrics.counter("tk8s_state_saves_total").value(
+        backend="memory") >= 2
+
+
+def test_exhausted_retries_count_a_failed_apply():
+    doc = _faulted_manager_doc()
+    ex = LocalExecutor(log=lambda m: None, sleep=lambda s: None,
+                       retry=RetryPolicy(max_retries=1))
+    with pytest.raises(TransientApplyError):
+        ex.apply(doc)
+    assert metrics.counter("tk8s_applies_total").value(status="failed") == 1
+    assert metrics.counter("tk8s_apply_retries_total").value(
+        module="cluster-manager") == 1
+
+
+def test_preemption_increments_counter():
+    from triton_kubernetes_tpu.executor.cloudsim import CloudSimulator
+    from triton_kubernetes_tpu.topology import (SliceSpec,
+                                                host_labels_for_slice)
+
+    sim = CloudSimulator()
+    sim.create_hosted_cluster("gke", "ml")
+    spec = SliceSpec.from_accelerator("v5e-16")
+    sim.create_node_pool("gke", "ml", "pool0", spec.num_hosts,
+                         node_labels=host_labels_for_slice(spec, "ml-pool0"))
+    sim.preempt_slice("ml-pool0")
+    assert metrics.counter(
+        "tk8s_cloudsim_preemptions_total").value() == 1
+
+
+# ------------------------------------------------------- manager HTTP surface
+
+def test_manager_serves_metrics_and_healthz(tmp_path):
+    with ManagerServer("m1", state_path=str(tmp_path / "state.json")) as s:
+        with urllib.request.urlopen(s.url + "/healthz") as resp:
+            assert resp.status == 200
+            assert json.load(resp)["ok"] is True
+        ManagerClient(s.url).ping()
+        with urllib.request.urlopen(s.url + "/metrics") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+    lines = assert_valid_prometheus(body)
+    assert lines, "metrics body must not be empty"
+    samples = _parse_samples(body)
+    assert samples[
+        'tk8s_manager_requests_total{route="/healthz",method="GET",'
+        'code="200"}'] == 1
+    assert samples[
+        'tk8s_manager_requests_total{route="/v3",method="GET",'
+        'code="200"}'] == 1
+    # Client side of the same ping.
+    assert samples[
+        'tk8s_manager_client_requests_total{method="GET",'
+        'status="200"}'] >= 1
+
+
+def test_manager_request_counter_normalizes_routes(tmp_path):
+    with ManagerServer("m1", state_path=str(tmp_path / "state.json")) as s:
+        c = ManagerClient(s.url)
+        c.init_token("http://mgr")
+        cluster = c.create_or_get_cluster("c1")
+        c.nodes(cluster["id"])
+    reqs = metrics.counter("tk8s_manager_requests_total")
+    # The per-id nodes listing lands on one bounded-cardinality series.
+    assert reqs.value(route="/v3/clusters/{id}/nodes", method="GET",
+                      code="200") == 1
+    assert reqs.value(route="/v3/cluster", method="POST", code="201") == 1
+
+
+def test_client_counts_retry_after_sleeps(monkeypatch):
+    from tests.test_manager import _http_stub
+
+    _http_stub(monkeypatch, [("err", 429, 7), ("err", 503, None),
+                             ("ok", {"ok": True}, None)])
+    sleeps = []
+    c = ManagerClient("http://mgr.test", retries=3, backoff=0.2,
+                      sleep=sleeps.append)
+    c.ping()
+    assert metrics.counter(
+        "tk8s_manager_client_retry_sleep_seconds_total").value() \
+        == pytest.approx(sum(sleeps)) and sleeps == [7.0, 0.4]
+    reqs = metrics.counter("tk8s_manager_client_requests_total")
+    assert reqs.value(method="GET", status="429") == 1
+    assert reqs.value(method="GET", status="503") == 1
+    assert reqs.value(method="GET", status="200") == 1
+    assert metrics.histogram(
+        "tk8s_manager_client_request_seconds").count(method="GET") == 3
+
+
+# ------------------------------------------------------------- CLI surfaces
+
+def _manager_cli_args(tmp_path, name):
+    return ["--non-interactive",
+            "--set", "backend_provider=local",
+            "--set", f"backend_root={tmp_path}",
+            "--set", f"name={name}",
+            "--set", "manager_cloud_provider=bare-metal",
+            "--set", "host=10.0.0.1"]
+
+
+def test_trace_out_matches_apply_journal(tmp_path, capsys):
+    from triton_kubernetes_tpu.cli.main import main
+    from triton_kubernetes_tpu.utils import configure
+
+    trace_path = tmp_path / "trace.json"
+    rc = main(["--trace-out", str(trace_path)]
+              + _manager_cli_args(tmp_path, "obsv")
+              + ["create", "manager"])
+    configure()  # restore the default logger for other tests
+    assert rc == 0, capsys.readouterr().err
+
+    trace = json.loads(trace_path.read_text())
+    events = {e["name"]: e for e in trace["traceEvents"]}
+    assert set(events) == {"apply", "module.cluster-manager"}
+    mod = events["module.cluster-manager"]
+    assert mod["ph"] == "X" and mod["args"]["path"] == \
+        "apply/module.cluster-manager"
+    assert events["apply"]["dur"] >= mod["dur"] > 0
+
+    # The exported span duration IS the journal's module duration.
+    be = LocalBackend(str(tmp_path))
+    doc = be.state("obsv")
+    doc.set_backend_config(be.executor_backend_config("obsv"))
+    journal = load_executor_state(doc).journal
+    assert journal["completed"] == ["cluster-manager"]
+    assert mod["dur"] == pytest.approx(
+        journal["durations"]["cluster-manager"] * 1e6, abs=0.5)
+
+
+def test_trace_out_written_even_on_failed_command(tmp_path, capsys):
+    from triton_kubernetes_tpu.cli.main import main
+    from triton_kubernetes_tpu.utils import configure
+
+    trace_path = tmp_path / "trace.json"
+    # Missing required inputs: the command fails but the trace still lands.
+    rc = main(["--trace-out", str(trace_path), "--non-interactive",
+               "--set", "backend_provider=local",
+               "--set", f"backend_root={tmp_path}",
+               "create", "manager"])
+    configure()
+    assert rc == 1
+    assert json.loads(trace_path.read_text())["traceEvents"] == []
+
+
+def test_metrics_verb_prometheus_and_json(tmp_path, capsys):
+    from triton_kubernetes_tpu.cli.main import main
+    from triton_kubernetes_tpu.utils import configure
+
+    assert main(["metrics"]) == 0
+    text = capsys.readouterr().out
+    assert_valid_prometheus(text)
+    for name in CATALOG:  # full catalog pre-registered, zero series
+        assert f"# TYPE {name} " in text
+
+    assert main(["--json", "metrics"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert set(CATALOG) <= set(snap)
+    assert snap["tk8s_applies_total"]["type"] == "counter"
+    configure()
+
+
+# ------------------------------------------------------------ repair outcome
+
+def test_repair_outcomes_are_counted(tmp_path):
+    """A repair that finds nothing to do is a *failed* repair run (typed
+    NoUnhealthyNodesError) and the outcome counter says so."""
+    from triton_kubernetes_tpu.backends import MemoryBackend
+    from triton_kubernetes_tpu.config import Config, InputResolver
+    from triton_kubernetes_tpu.workflows import (
+        NoPreemptedSlicesError,
+        WorkflowContext,
+        new_cluster,
+        new_manager,
+        repair_slice,
+    )
+
+    be = MemoryBackend()
+    ex = LocalExecutor(log=lambda m: None)
+
+    def ctx_for(values):
+        cfg = Config(env={})
+        for k, v in values.items():
+            cfg.set(k, v)
+        return WorkflowContext(backend=be, executor=ex,
+                               resolver=InputResolver(cfg, None, True))
+
+    new_manager(ctx_for({"manager_cloud_provider": "bare-metal",
+                         "name": "m1", "host": "10.0.0.2"}))
+    new_cluster(ctx_for({
+        "cluster_manager": "m1", "cluster_cloud_provider": "gcp-tpu",
+        "name": "ml", "gcp_path_to_credentials": "/tmp/creds.json",
+        "gcp_project_id": "p1",
+        "nodes": [{"hostname": "pool0", "tpu_accelerator": "v5e-16"}]}))
+    with pytest.raises(NoPreemptedSlicesError):
+        repair_slice(ctx_for({"cluster_manager": "m1",
+                              "cluster_name": "ml", "confirm": True}))
+    assert metrics.counter("tk8s_repairs_total").value(
+        kind="slice", outcome="failed") == 1
+    assert metrics.counter("tk8s_repairs_total").value(
+        kind="slice", outcome="ok") == 0

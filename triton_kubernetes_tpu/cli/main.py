@@ -150,6 +150,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="structured JSON-lines log output")
     p.add_argument("--log-level", choices=["debug", "info", "warn", "error"],
                    default="info", help="log verbosity (default: info)")
+    p.add_argument("--trace-out", metavar="FILE",
+                   help="write every span of this run as Chrome trace-event "
+                        "JSON (open in ui.perfetto.dev)")
     p.add_argument("--max-retries", type=int, metavar="N",
                    help="per-module retries for transient apply faults "
                         "(default: 3; config key max_retries)")
@@ -183,6 +186,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="structurally validate the shipped terraform module tree and "
              "every stored state document (no terraform binary needed)")
 
+    sub.add_parser(
+        "metrics",
+        help="dump the in-process metrics registry (Prometheus text; "
+             "--json for the snapshot)")
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -201,7 +209,30 @@ def main(argv: Optional[List[str]] = None,
         build_parser().print_help()
         return 1
 
-    logger = configure(json_mode=args.json, level=args.log_level)
+    trace = None
+    if args.trace_out:
+        from ..utils.trace import TraceCollector
+
+        trace = TraceCollector()
+    logger = configure(json_mode=args.json, level=args.log_level,
+                       trace=trace)
+
+    if args.command == "metrics":
+        # The full catalog (docs/guide/observability.md), zero-valued
+        # families included, from this process's default registry.
+        from ..utils import metrics as m
+
+        reg = m.get_registry()
+        reg.register_catalog()
+        if args.json:
+            print(json.dumps(reg.snapshot(), indent=2, sort_keys=True))
+        else:
+            print(reg.render_prometheus(), end="")
+        if trace is not None:
+            # Honor the global contract (a file always lands) even though
+            # this command opens no spans.
+            trace.write(args.trace_out)
+        return 0
 
     config = Config(config_file=args.config)
     for item in args.overrides:
@@ -289,6 +320,13 @@ def main(argv: Optional[List[str]] = None,
     except KeyboardInterrupt:
         print("\naborted", file=sys.stderr)
         return 130
+    finally:
+        # Written even when the command failed: the trace of a crashed
+        # apply is the one the operator most wants to open in Perfetto.
+        if trace is not None:
+            trace.write(args.trace_out)
+            logger.info("trace written", file=args.trace_out,
+                        spans=len(trace.events()))
     return 0
 
 

@@ -27,6 +27,7 @@ import hashlib
 from typing import Any, Dict, List, Optional
 
 from ..manager import protocol
+from ..utils import metrics
 
 
 def _token(*parts: str) -> str:
@@ -115,6 +116,8 @@ class FaultPlan:
                 continue
             if self._matches(rule, op, info):
                 rule["fired"] += 1
+                metrics.counter("tk8s_cloudsim_faults_total").inc(
+                    kind=rule["kind"])
                 msg = rule.get("error") or f"injected fault on {op}"
                 exc = (FatalFaultError if rule["kind"] == "fatal"
                        else TransientFaultError)
@@ -155,6 +158,7 @@ class CloudSimulator:
         injected failure always leaves the op not-yet-applied (the module
         retries it via its own idempotent create-or-get)."""
         self.ops += 1
+        metrics.counter("tk8s_cloudsim_ops_total").inc(op=op)
         if self.fault_plan is not None:
             self.fault_plan.check(self, op, info)
 
@@ -418,6 +422,7 @@ class CloudSimulator:
                 hit.append(node["name"])
         if not hit:
             raise CloudSimError(f"no node pool carries slice {slice_id!r}")
+        metrics.counter("tk8s_cloudsim_preemptions_total").inc()
         return hit
 
     def cordon_slice(self, slice_id: str) -> List[str]:

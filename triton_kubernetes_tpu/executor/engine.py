@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..state import StateDocument
 from ..modules import get_module
+from ..utils import metrics
 from ..modules.base import DriverContext
 from .cloudsim import CloudSimulator, FatalFaultError, TransientFaultError
 from .drivers import make_driver
@@ -174,6 +175,8 @@ def load_executor_state(doc: StateDocument) -> ExecutorState:
 def save_executor_state(doc: StateDocument, est: ExecutorState) -> None:
     est.serial += 1
     loc = _backend_location(doc)
+    metrics.counter("tk8s_state_saves_total").inc(
+        backend=next(iter(loc), "unknown"))
     if "memory" in loc:
         _MEMORY_STATES[loc["memory"]["name"]] = copy.deepcopy(est.to_dict())
         return
@@ -273,6 +276,7 @@ class LocalExecutor:
             "order": run_order,
             "completed": [],
             "retries": {},
+            "durations": {},
             "backoff_total": 0.0,
             "failed": None,
             "status": "in-progress",
@@ -300,9 +304,16 @@ class LocalExecutor:
                         raise ApplyError(f"module {name!r}: {e}") from e
                     ctx = DriverContext(cloud=cloud, workdir=workdir, module_key=name)
                     with self.logger.span(f"module.{name}", action=action.value,
-                                          source=module.SOURCE):
+                                          source=module.SOURCE) as msp:
                         mod_outputs, resources = self._apply_one_with_retry(
                             name, module, resolved, ctx, journal)
+                    # One truth for this module's wall time: the span's
+                    # duration feeds the histogram, the journal, and (via
+                    # --trace-out) the exported trace event identically.
+                    metrics.histogram(
+                        "tk8s_module_apply_duration_seconds").observe(
+                        msp.duration_s, module=name)
+                    journal["durations"][name] = msp.duration_s
                     missing = [o for o in module.OUTPUTS if o not in mod_outputs]
                     if missing:
                         raise FatalApplyError(
@@ -336,6 +347,8 @@ class LocalExecutor:
             journal["status"] = "failed"
             raise
         finally:
+            metrics.counter("tk8s_applies_total").inc(
+                status=journal["status"])
             est.cloud = cloud.to_dict()
             save_executor_state(doc, est)
         return plan
@@ -354,12 +367,15 @@ class LocalExecutor:
         policy = self.retry
         attempt = 0
         while True:
+            metrics.counter("tk8s_module_apply_attempts_total").inc(
+                module=name)
             try:
                 result = module.apply(resolved, ctx)
                 journal["failed"] = None  # recovered: the record is history
                 return result
             except Exception as e:
                 kind = classify_fault(e)
+                metrics.counter("tk8s_apply_faults_total").inc(kind=kind)
                 journal["failed"] = {"module": name, "error": str(e),
                                      "kind": kind, "attempts": attempt + 1}
                 if kind == "fatal":
@@ -379,6 +395,8 @@ class LocalExecutor:
                 attempt += 1
                 journal["retries"][name] = attempt
                 journal["backoff_total"] += delay
+                metrics.counter("tk8s_apply_retries_total").inc(module=name)
+                metrics.counter("tk8s_apply_backoff_seconds_total").inc(delay)
                 self.log(f"module.{name}: transient fault "
                          f"(attempt {attempt}/{policy.max_retries}, "
                          f"retry in {delay:g}s): {e}")

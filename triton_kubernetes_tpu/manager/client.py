@@ -21,6 +21,8 @@ import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from ..utils import metrics
+
 
 class ManagerClientError(RuntimeError):
     pass
@@ -95,6 +97,15 @@ class ManagerClient:
         return served
 
     # ------------------------------------------------------------ transport
+    @staticmethod
+    def _observe(method: str, t0: float, status: str) -> None:
+        """Per-attempt request metrics: count by method+status (HTTP code
+        or 'unreachable'), latency histogram by method."""
+        metrics.counter("tk8s_manager_client_requests_total").inc(
+            method=method, status=status)
+        metrics.histogram("tk8s_manager_client_request_seconds").observe(
+            time.perf_counter() - t0, method=method)
+
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None,
                  authed: bool = True) -> Dict[str, Any]:
@@ -111,12 +122,17 @@ class ManagerClient:
                 f"{self.url}{path}", data=data, headers=headers,
                 method=method)
             delay = self.backoff * (2 ** attempt)
+            t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.timeout,
                         context=self._context()) as resp:
-                    return json.loads(resp.read() or b"{}")
+                    raw = resp.read()
+                    self._observe(method, t0,
+                                  str(getattr(resp, "status", 200)))
+                    return json.loads(raw or b"{}")
             except urllib.error.HTTPError as e:
+                self._observe(method, t0, str(e.code))
                 if e.code in (429, 503):
                     # Overload/unavailable is transient; the server's
                     # Retry-After (delta-seconds) overrides our backoff.
@@ -134,6 +150,9 @@ class ManagerClient:
                                 f"budget exhausted ({slept:.1f}s slept, "
                                 f"deadline {self.retry_deadline:g}s)") from e
                         slept += delay
+                        metrics.counter(
+                            "tk8s_manager_client_retry_sleep_seconds_total"
+                        ).inc(delay)
                         self._sleep(delay)
                     continue
                 detail = ""
@@ -146,6 +165,7 @@ class ManagerClient:
                     f"{method} {path} -> {e.code}"
                     + (f": {detail}" if detail else "")) from e
             except (urllib.error.URLError, OSError, TimeoutError) as e:
+                self._observe(method, t0, "unreachable")
                 last = e
                 if attempt < self.retries:
                     if slept + delay > self.retry_deadline:
@@ -154,6 +174,9 @@ class ManagerClient:
                             f"({slept:.1f}s slept, deadline "
                             f"{self.retry_deadline:g}s): {e}") from e
                     slept += delay
+                    metrics.counter(
+                        "tk8s_manager_client_retry_sleep_seconds_total"
+                    ).inc(delay)
                     self._sleep(delay)
         if isinstance(last, urllib.error.HTTPError):
             raise ManagerClientError(

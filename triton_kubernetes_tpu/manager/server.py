@@ -12,6 +12,8 @@ Wire surface (Rancher-v3-flavored, the contract of the scripts):
 method    path                                   auth
 ========  =====================================  ====================
 GET       /v3                                    none (health)
+GET       /healthz                               none (liveness)
+GET       /metrics                               none (Prometheus text)
 GET       /v3/settings/cacerts                   none (public CA)
 POST      /v3-admin/init-token                   loopback only
 GET       /v3/cluster?name=N                     basic
@@ -38,10 +40,27 @@ from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from . import protocol
+from ..utils import metrics
 
 # Agents heartbeat every 60s (manager/agent.py default); three missed
 # beats flips a node to NotReady in the nodes listing.
 HEARTBEAT_STALE_S = 180.0
+
+
+def _route_label(path: str) -> str:
+    """Normalize a request path to a bounded-cardinality route label —
+    per-id paths must not mint one series per cluster."""
+    if path in ("/v3", "/metrics", "/healthz", "/v3/settings/cacerts",
+                "/v3/cluster", "/v3/clusterregistrationtoken",
+                "/v3-admin/init-token", "/v3/agent/register"):
+        return path
+    if path.startswith("/v3/import/") and path.endswith(".yaml"):
+        return "/v3/import/{id}.yaml"
+    if path.startswith("/v3/clusters/"):
+        if path.endswith("/nodes"):
+            return "/v3/clusters/{id}/nodes"
+        return "/v3/clusters/{id}"
+    return "other"
 
 
 class ManagerState:
@@ -156,6 +175,21 @@ class _Handler(BaseHTTPRequestHandler):
         if os.environ.get("TK8S_MANAGER_DEBUG"):
             super().log_message(fmt, *args)
 
+    def send_response(self, code: int, message: Optional[str] = None) -> None:
+        self._last_code = code  # stashed for the per-route request counter
+        super().send_response(code, message)
+
+    def _counted(self, handler) -> None:
+        """Run a verb handler and count the request by normalized route,
+        method, and response code (0 = connection died before a response)."""
+        self._last_code = 0
+        try:
+            handler()
+        finally:
+            metrics.counter("tk8s_manager_requests_total").inc(
+                route=_route_label(urlparse(self.path).path),
+                method=self.command, code=str(self._last_code))
+
     def _json(self, code: int, payload: Dict[str, Any]) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
@@ -193,10 +227,33 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- routes
     def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        self._counted(self._get)
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._counted(self._post)
+
+    def _get(self) -> None:
         try:
             url = urlparse(self.path)
             if url.path == "/v3":
                 self._json(200, {"type": "apiRoot", "name": self.state.name})
+                return
+            if url.path == "/healthz":
+                # Liveness/readiness for the container orchestrator: the
+                # server thread is accepting and state is loaded.
+                self._json(200, {"ok": True, "name": self.state.name})
+                return
+            if url.path == "/metrics":
+                # Prometheus scrape of the process-default registry —
+                # unauthenticated, like the health endpoints (the registry
+                # carries operational counts, never credentials).
+                body = metrics.get_registry().render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             if url.path == "/v3/settings/cacerts":
                 # Public like Rancher's: agents verify their --ca-checksum
@@ -278,7 +335,7 @@ class _Handler(BaseHTTPRequestHandler):
         except _BadRequest as e:
             self._json(400, {"type": "error", "message": str(e)})
 
-    def do_POST(self) -> None:  # noqa: N802
+    def _post(self) -> None:
         try:
             url = urlparse(self.path)
             if url.path == "/v3-admin/init-token":

@@ -1,10 +1,31 @@
-"""Shared utilities: structured logging and step tracing."""
+"""Shared utilities: structured logging, step tracing, and metrics."""
 
+from . import metrics
 from .logging import (
     Logger,
     Span,
     configure,
     get_logger,
 )
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from .trace import TraceCollector
 
-__all__ = ["Logger", "Span", "configure", "get_logger"]
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Logger",
+    "MetricsRegistry",
+    "Span",
+    "TraceCollector",
+    "configure",
+    "get_logger",
+    "get_registry",
+    "metrics",
+]

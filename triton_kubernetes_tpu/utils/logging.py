@@ -17,9 +17,23 @@ import json
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional, TextIO
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, TextIO
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .trace import TraceCollector
 
 LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
+
+
+def _level_no(level: str) -> int:
+    """Numeric level, or ValueError naming the valid choices — a typo'd
+    level must not surface as a bare KeyError deep in a log call."""
+    try:
+        return LEVELS[level]
+    except KeyError:
+        raise ValueError(
+            f"unknown log level {level!r} "
+            f"(valid: {sorted(LEVELS, key=LEVELS.get)})") from None
 
 
 class Logger:
@@ -31,18 +45,22 @@ class Logger:
     """
 
     def __init__(self, stream: Optional[TextIO] = None, *,
-                 json_mode: bool = False, level: str = "info"):
+                 json_mode: bool = False, level: str = "info",
+                 trace: Optional["TraceCollector"] = None):
         # None = "current sys.stderr", resolved at emit time so the logger
         # follows stream redirection (pytest capsys, daemonized CLIs).
         self._stream = stream
         self.json_mode = json_mode
-        self.level_no = LEVELS[level]
+        self.level_no = _level_no(level)
+        # Optional span sink (utils/trace.TraceCollector): every finished
+        # span is exported as a Chrome trace event (--trace-out).
+        self.trace = trace
         self._lock = threading.Lock()
         self._span_stack = threading.local()
 
     # ------------------------------------------------------------------ emit
     def log(self, level: str, msg: str, **fields: Any) -> None:
-        if LEVELS[level] < self.level_no:
+        if _level_no(level) < self.level_no:
             return
         spans = self._spans()
         if self.json_mode:
@@ -53,7 +71,10 @@ class Logger:
             rec.update(fields)
             line = json.dumps(rec, sort_keys=True, default=str)
         else:
-            prefix = "".join(f"[{s.name}] " for s in spans[-1:])
+            # Full parent/child chain, same shape as the JSON `span` field
+            # (text mode used to truncate to the innermost span).
+            prefix = (f"[{'/'.join(s.name for s in spans)}] "
+                      if spans else "")
             extras = " ".join(f"{k}={v}" for k, v in fields.items())
             line = f"{prefix}{msg}" + (f"  ({extras})" if extras else "")
             if level in ("warn", "error"):
@@ -96,23 +117,34 @@ class Span:
         self.name = name
         self.fields = fields
         self.t0 = 0.0
+        self.t0_wall = 0.0
         self.duration_s: Optional[float] = None
 
     def __enter__(self) -> "Span":
         self.logger._spans().append(self)
         self.t0 = time.monotonic()
+        self.t0_wall = time.time()
         self.logger.debug("begin", **self.fields)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
-        self.duration_s = round(time.monotonic() - self.t0, 3)
+        # Unrounded monotonic duration: the trace export and the apply
+        # journal must agree to the microsecond; the *log line* rounds.
+        self.duration_s = time.monotonic() - self.t0
         try:
             if exc is None:
-                self.logger.info("done", duration_s=self.duration_s,
+                self.logger.info("done",
+                                 duration_s=round(self.duration_s, 3),
                                  **self.fields)
             else:
-                self.logger.error("failed", duration_s=self.duration_s,
+                self.logger.error("failed",
+                                  duration_s=round(self.duration_s, 3),
                                   error=str(exc), **self.fields)
+            if self.logger.trace is not None:
+                path = "/".join(s.name for s in self.logger._spans())
+                self.logger.trace.add_span(
+                    self.name, path, self.t0_wall, self.duration_s,
+                    self.fields, error=None if exc is None else str(exc))
         finally:
             stack = self.logger._spans()
             if stack and stack[-1] is self:
@@ -123,10 +155,12 @@ _default = Logger()
 
 
 def configure(*, stream: Optional[TextIO] = None, json_mode: bool = False,
-              level: str = "info") -> Logger:
+              level: str = "info",
+              trace: Optional["TraceCollector"] = None) -> Logger:
     """Reconfigure the process-default logger (CLI startup)."""
     global _default
-    _default = Logger(stream=stream, json_mode=json_mode, level=level)
+    _default = Logger(stream=stream, json_mode=json_mode, level=level,
+                      trace=trace)
     return _default
 
 

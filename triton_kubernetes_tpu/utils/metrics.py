@@ -1,0 +1,392 @@
+"""Process-wide metrics: counters, gauges, histograms, Prometheus text.
+
+The measurement counterpart of :mod:`.logging`: where spans answer "what
+is happening right now", the registry answers the operator questions PR 1
+left open — how many retries fired, what backoff cost, how long each
+module takes, which faults hit. Same design rules as the logger: no
+external deps (this is not a client-library vendoring), thread-safe, and
+one process-default instance reachable from anywhere
+(:func:`get_registry`, mirroring ``get_logger()``).
+
+Exposition surfaces:
+
+* :meth:`MetricsRegistry.render_prometheus` — Prometheus text format
+  (``GET /metrics`` on the manager, the ``tk8s metrics`` CLI verb);
+* :meth:`MetricsRegistry.snapshot` — JSON-able dict (``tk8s metrics
+  --json``, CI evidence artifacts).
+
+Metric families are create-or-get by name, so instrumented call sites
+just say ``metrics.counter("tk8s_apply_retries_total").inc(module=m)``
+— help text, label names, and histogram buckets come from the
+:data:`CATALOG` below, the single source of truth that docs and the
+``tk8s metrics`` dump share.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Seconds-scale latency buckets: module applies range from sub-ms
+# (simulator) to minutes (real drivers); HTTP calls live in the middle.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+# name -> (kind, help, labelnames, buckets-or-None). The one catalog the
+# instrumentation, the docs table (docs/guide/observability.md), and the
+# `tk8s metrics` pre-registration all read.
+CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]] = {
+    # -------------------------------------------------- executor/engine.py
+    "tk8s_module_apply_duration_seconds": (
+        "histogram", "Wall-clock duration of one module apply "
+        "(including retries and backoff)", ("module",), DEFAULT_BUCKETS),
+    "tk8s_module_apply_attempts_total": (
+        "counter", "Module apply attempts (first try + every retry)",
+        ("module",), None),
+    "tk8s_apply_retries_total": (
+        "counter", "Retries taken after a transient module-apply fault",
+        ("module",), None),
+    "tk8s_apply_faults_total": (
+        "counter", "Module-apply faults by retryability classification",
+        ("kind",), None),
+    "tk8s_apply_backoff_seconds_total": (
+        "counter", "Total seconds slept in retry backoff across applies",
+        (), None),
+    "tk8s_applies_total": (
+        "counter", "Whole-graph applies by terminal journal status",
+        ("status",), None),
+    "tk8s_state_saves_total": (
+        "counter", "Executor-state (journal) saves by backend kind",
+        ("backend",), None),
+    # ------------------------------------------------ executor/cloudsim.py
+    "tk8s_cloudsim_ops_total": (
+        "counter", "Simulated cloud mutations by operation", ("op",), None),
+    "tk8s_cloudsim_faults_total": (
+        "counter", "Injected simulator faults fired, by kind",
+        ("kind",), None),
+    "tk8s_cloudsim_preemptions_total": (
+        "counter", "TPU slice preemptions fired in the simulator", (), None),
+    # -------------------------------------------------- manager/client.py
+    "tk8s_manager_client_requests_total": (
+        "counter", "Manager-client HTTP requests by method and status "
+        "(HTTP code, or 'unreachable')", ("method", "status"), None),
+    "tk8s_manager_client_request_seconds": (
+        "histogram", "Manager-client HTTP request latency per attempt",
+        ("method",), DEFAULT_BUCKETS),
+    "tk8s_manager_client_retry_sleep_seconds_total": (
+        "counter", "Seconds the manager client slept between retries "
+        "(its own backoff and server Retry-After)", (), None),
+    # -------------------------------------------------- manager/server.py
+    "tk8s_manager_requests_total": (
+        "counter", "Manager-server HTTP requests by normalized route, "
+        "method, and response code", ("route", "method", "code"), None),
+    # ------------------------------------------------- workflows/repair.py
+    "tk8s_repairs_total": (
+        "counter", "repair {node,slice} workflow runs by outcome",
+        ("kind", "outcome"), None),
+}
+
+_VALID_KINDS = ("counter", "gauge", "histogram")
+
+
+def _escape_label(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    """One metric family: a name, label schema, and its labeled series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 lock: threading.RLock):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock  # the owning registry's lock, shared
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {list(self.labelnames)}, "
+                f"got {sorted(labels)}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def _label_str(self, key: Tuple[str, ...]) -> str:
+        if not self.labelnames:
+            return ""
+        pairs = ",".join(f'{n}="{_escape_label(v)}"'
+                         for n, v in zip(self.labelnames, key))
+        return "{" + pairs + "}"
+
+    def samples(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"labels": dict(zip(self.labelnames, key)),
+                     "value": value}
+                    for key, value in sorted(self._series.items())]
+
+
+class Counter(_Metric):
+    """Monotonically increasing count. Prometheus convention: name ends
+    in ``_total``."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease "
+                             f"(inc by {amount})")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    """A value that can go up and down (queue depth, in-flight ops)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: Any) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (Prometheus semantics: cumulative buckets,
+    implicit ``+Inf``, plus ``_sum`` and ``_count``)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 lock: threading.RLock,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames, lock)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError(f"histogram {name!r} needs at least one bucket")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = {"counts": [0] * len(self.buckets),
+                          "sum": 0.0, "count": 0}
+                self._series[key] = series
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    series["counts"][i] += 1
+                    break  # counts are per-bucket here; cumulated on render
+            series["sum"] += v
+            series["count"] += 1
+
+    def count(self, **labels: Any) -> int:
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            return series["count"] if series else 0
+
+    def sum(self, **labels: Any) -> float:
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            return series["sum"] if series else 0.0
+
+    def samples(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for key, series in sorted(self._series.items()):
+                cum, buckets = 0, {}
+                for le, c in zip(self.buckets, series["counts"]):
+                    cum += c
+                    buckets[_format_value(le)] = cum
+                buckets["+Inf"] = series["count"]
+                out.append({"labels": dict(zip(self.labelnames, key)),
+                            "buckets": buckets, "sum": series["sum"],
+                            "count": series["count"]})
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe named collection of metric families.
+
+    ``counter``/``gauge``/``histogram`` are create-or-get: the first call
+    fixes the family's help/labels (falling back to :data:`CATALOG` when
+    omitted); later calls must agree on kind and label names.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Metric] = {}
+
+    # ------------------------------------------------------------ families
+    def _get_or_create(self, kind: str, name: str, help: Optional[str],
+                       labelnames: Optional[Sequence[str]],
+                       buckets: Optional[Sequence[float]]) -> _Metric:
+        cat = CATALOG.get(name)
+        if help is None:
+            help = cat[1] if cat else ""
+        if labelnames is None:
+            labelnames = cat[2] if cat else ()
+        if buckets is None:
+            buckets = (cat[3] if cat and cat[3] else DEFAULT_BUCKETS)
+        with self._lock:
+            existing = self._families.get(name)
+            if existing is not None:
+                if existing.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, not {kind}")
+                if existing.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{list(existing.labelnames)}, not {list(labelnames)}")
+                return existing
+            if kind == "counter":
+                fam: _Metric = Counter(name, help, labelnames, self._lock)
+            elif kind == "gauge":
+                fam = Gauge(name, help, labelnames, self._lock)
+            elif kind == "histogram":
+                fam = Histogram(name, help, labelnames, self._lock, buckets)
+            else:
+                raise ValueError(f"unknown metric kind {kind!r} "
+                                 f"(valid: {list(_VALID_KINDS)})")
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: Optional[str] = None,
+                labelnames: Optional[Sequence[str]] = None) -> Counter:
+        return self._get_or_create("counter", name, help, labelnames, None)  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: Optional[str] = None,
+              labelnames: Optional[Sequence[str]] = None) -> Gauge:
+        return self._get_or_create("gauge", name, help, labelnames, None)  # type: ignore[return-value]
+
+    def histogram(self, name: str, help: Optional[str] = None,
+                  labelnames: Optional[Sequence[str]] = None,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create("histogram", name, help, labelnames,
+                                   buckets)  # type: ignore[return-value]
+
+    def register_catalog(self) -> None:
+        """Instantiate every :data:`CATALOG` family (zero series), so a
+        dump shows the full metric surface even before traffic."""
+        for name, (kind, help, labelnames, buckets) in CATALOG.items():
+            self._get_or_create(kind, name, help, labelnames, buckets)
+
+    def reset(self) -> None:
+        """Drop every family (tests). Call sites re-create on demand."""
+        with self._lock:
+            self._families.clear()
+
+    # ---------------------------------------------------------- exposition
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view: {name: {type, help, labelnames, series}}."""
+        with self._lock:
+            fams = list(self._families.values())
+        out: Dict[str, Any] = {}
+        for fam in sorted(fams, key=lambda f: f.name):
+            out[fam.name] = {
+                "type": fam.kind,
+                "help": fam.help,
+                "labelnames": list(fam.labelnames),
+                "series": fam.samples(),
+            }
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (0.0.4)."""
+        with self._lock:
+            fams = list(self._families.values())
+        lines: List[str] = []
+        for fam in sorted(fams, key=lambda f: f.name):
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            if isinstance(fam, Histogram):
+                for s in fam.samples():
+                    base = [(n, s["labels"][n]) for n in fam.labelnames]
+                    for le, cum in s["buckets"].items():
+                        pairs = ",".join(
+                            [f'{n}="{_escape_label(v)}"' for n, v in base]
+                            + [f'le="{le}"'])
+                        lines.append(
+                            f"{fam.name}_bucket{{{pairs}}} {cum}")
+                    suffix = fam._label_str(
+                        tuple(s["labels"][n] for n in fam.labelnames))
+                    lines.append(f"{fam.name}_sum{suffix} "
+                                 f"{_format_value(s['sum'])}")
+                    lines.append(f"{fam.name}_count{suffix} {s['count']}")
+            else:
+                for s in fam.samples():
+                    suffix = fam._label_str(
+                        tuple(s["labels"][n] for n in fam.labelnames))
+                    lines.append(
+                        f"{fam.name}{suffix} {_format_value(s['value'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_default = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-default registry (mirrors ``get_logger()``)."""
+    return _default
+
+
+def configure(registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    """Swap the process-default registry (tests, embedders)."""
+    global _default
+    _default = registry if registry is not None else MetricsRegistry()
+    return _default
+
+
+# Convenience module-level constructors against the *current* default
+# registry — instrumented call sites use these so a registry swap/reset
+# takes effect immediately (no stale family references).
+def counter(name: str, help: Optional[str] = None,
+            labelnames: Optional[Sequence[str]] = None) -> Counter:
+    return get_registry().counter(name, help, labelnames)
+
+
+def gauge(name: str, help: Optional[str] = None,
+          labelnames: Optional[Sequence[str]] = None) -> Gauge:
+    return get_registry().gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: Optional[str] = None,
+              labelnames: Optional[Sequence[str]] = None,
+              buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return get_registry().histogram(name, help, labelnames, buckets)

@@ -1,0 +1,73 @@
+"""Span tree -> Chrome trace-event JSON (Perfetto / chrome://tracing).
+
+:class:`~.logging.Span` already times every provisioning phase; this
+module makes those timings machine-readable. A :class:`TraceCollector`
+attached to the logger (``configure(trace=...)``, or the CLI's global
+``--trace-out FILE``) receives one complete event per finished span and
+serializes the Trace Event Format's JSON object form, so any
+``apply``/``destroy``/``repair`` run opens directly in
+https://ui.perfetto.dev.
+
+Events use the ``"ph": "X"`` (complete) phase: wall-clock ``ts`` plus
+monotonic-derived ``dur``, both in microseconds, with the span's nesting
+path and fields under ``args``. Thread ids are real, so concurrent
+spans (threaded workflows) land on separate tracks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class TraceCollector:
+    """Accumulates finished spans as Chrome trace events. Thread-safe;
+    one instance per traced run (the CLI makes one per invocation)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+
+    def add_span(self, name: str, path: str, start_wall_s: float,
+                 duration_s: float, fields: Optional[Dict[str, Any]] = None,
+                 error: Optional[str] = None) -> None:
+        args: Dict[str, Any] = {"path": path}
+        for k, v in (fields or {}).items():
+            args[k] = v if isinstance(v, (int, float, bool)) else str(v)
+        if error is not None:
+            args["error"] = error
+        event = {
+            "name": name,
+            "cat": "span" if error is None else "span,error",
+            "ph": "X",
+            "ts": round(start_wall_s * 1e6, 3),
+            "dur": round(duration_s * 1e6, 3),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": args,
+        }
+        with self._lock:
+            self._events.append(event)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            events = sorted(self._events, key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> None:
+        """Serialize to ``path`` atomically (the CLI writes on exit, even
+        after a failed command — a crashed apply's trace is the one you
+        most want to open)."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)

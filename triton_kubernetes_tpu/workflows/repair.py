@@ -28,6 +28,7 @@ from typing import Dict
 
 from ..state import parse_cluster_key
 from ..topology import SliceSpec, verify_slice_labels
+from ..utils import metrics
 from .common import (
     WorkflowContext,
     WorkflowError,
@@ -52,7 +53,28 @@ class NoPreemptedSlicesError(WorkflowError):
     """The driver's cloud state records no preempted TPU slice pools."""
 
 
+def _counted_repair(kind: str, fn, ctx: WorkflowContext) -> str:
+    """Run a repair verb and record its outcome
+    (``tk8s_repairs_total{kind,outcome}``): ``ok`` on success, ``aborted``
+    when the operator declined the confirm, ``failed`` on any error —
+    including the typed nothing-to-repair/blind-health cases, which an
+    alerting rule watching repair failures should see."""
+    try:
+        result = fn(ctx)
+    except BaseException:
+        metrics.counter("tk8s_repairs_total").inc(kind=kind,
+                                                  outcome="failed")
+        raise
+    metrics.counter("tk8s_repairs_total").inc(
+        kind=kind, outcome="ok" if result else "aborted")
+    return result
+
+
 def repair_node(ctx: WorkflowContext) -> str:
+    return _counted_repair("node", _repair_node, ctx)
+
+
+def _repair_node(ctx: WorkflowContext) -> str:
     r = ctx.resolver
     manager = select_manager(ctx)
     state = ctx.backend.state(manager)
@@ -126,6 +148,10 @@ def _pick_unhealthy(ctx: WorkflowContext, state, cluster_key: str,
 # --------------------------------------------------------------- slice repair
 
 def repair_slice(ctx: WorkflowContext) -> str:
+    return _counted_repair("slice", _repair_slice, ctx)
+
+
+def _repair_slice(ctx: WorkflowContext) -> str:
     """Replace a preempted TPU slice pool and restore its ICI labels.
 
     Detect → cordon → replace → re-label → verify, all against the
