@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Produce the perf evidence artifact: a pipelined-vs-synchronous A/B of
+the training hot path on the CPU test mesh, written to
+docs/ci-evidence/perf-<tag>.json.
+
+The reviewable counterpart of tests/test_step_pipeline.py, mirroring
+scripts/ci/{fault,observability}_evidence.py: both arms run the SAME
+AOT-compiled step over the SAME batch order through
+train.pipeline.run_pipelined — the synchronous arm with ``sync_every=1``
+(one device->host sync per step, the old loop shape), the pipelined arm
+with ``sync_every=8`` plus a DevicePrefetch input. The artifact shows
+
+- per-step host syncs eliminated (``host_syncs`` from the metrics
+  registry: == steps for sync, == ceil(steps/8) for pipelined),
+- steps/sec for both arms (pipelined must not lose),
+- prefetch-wait seconds (~0: input overlaps compute),
+- the AOT lower-vs-compile split,
+- losses bitwise identical between arms (the determinism contract).
+
+Throughput figures vary run to run; every count is deterministic.
+
+Usage: python scripts/ci/perf_evidence.py [tag]  (default: local)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+# 8 virtual CPU devices, exactly like tests/conftest.py (must land before
+# a jax backend initializes).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from triton_kubernetes_tpu.models import get_config  # noqa: E402
+from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh  # noqa: E402
+from triton_kubernetes_tpu.train import (  # noqa: E402
+    DevicePrefetch, aot_compile_step, init_state, make_optimizer,
+    make_train_step, run_pipelined)
+from triton_kubernetes_tpu.train.data import synthetic_batches  # noqa: E402
+from triton_kubernetes_tpu.train.trainer import batch_spec  # noqa: E402
+from triton_kubernetes_tpu.utils import metrics  # noqa: E402
+
+STEPS = 24
+SYNC_EVERY = 8
+BATCH, SEQ = 8, 32
+
+
+def run_arm(step, cfg, mesh, opt, batches, sync_every, prefetch_depth):
+    """One A/B arm on a fresh registry + fresh (identically-seeded) state;
+    returns (registry counts, report)."""
+    metrics.configure()
+    state = init_state(cfg, mesh, opt)
+    prefetch = None
+    source = iter(list(batches))
+    if prefetch_depth:
+        from jax.sharding import NamedSharding
+
+        prefetch = DevicePrefetch(
+            source, sharding=NamedSharding(mesh, batch_spec()),
+            buffer_size=prefetch_depth)
+        source = prefetch
+    t0 = time.perf_counter()
+    state, report = run_pipelined(
+        step, state, source, sync_every=sync_every, max_steps=STEPS,
+        tokens_per_step=BATCH * SEQ, config_name=cfg.name, prefetch=prefetch)
+    wall = time.perf_counter() - t0
+    counts = {
+        "host_syncs": int(metrics.counter(
+            "tk8s_train_host_syncs_total").value(config=cfg.name)),
+        "steps_observed": int(metrics.histogram(
+            "tk8s_train_step_duration_seconds").count(config=cfg.name)),
+        "tokens": int(metrics.counter(
+            "tk8s_train_tokens_total").value(config=cfg.name)),
+    }
+    if prefetch is not None:
+        prefetch.close()
+    return counts, report, wall
+
+
+def main(argv):
+    tag = argv[1] if len(argv) > 1 else "local"
+    out_dir = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        os.pardir, os.pardir, "docs", "ci-evidence"))
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"perf-{tag}.json")
+
+    cfg = get_config("llama-test")
+    mesh = create_mesh(MeshConfig(fsdp=4, tensor=2))
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+
+    gen = synthetic_batches(cfg.vocab_size, BATCH, SEQ)
+    host_batches = [next(gen) for _ in range(STEPS)]
+    batches = [{"tokens": jnp.asarray(b["tokens"])} for b in host_batches]
+
+    # One shared AOT-compiled step: both arms execute the identical
+    # program; compile cost is reported, not smeared into either arm.
+    metrics.configure()
+    state0 = init_state(cfg, mesh, opt)
+    step, timings = aot_compile_step(
+        make_train_step(cfg, mesh, opt), state0, batches[0],
+        config_name=cfg.name)
+    del state0  # lowering shapes only; each arm re-inits identically
+
+    sync_counts, sync_report, sync_wall = run_arm(
+        step, cfg, mesh, opt, batches, sync_every=1, prefetch_depth=0)
+    pipe_counts, pipe_report, pipe_wall = run_arm(
+        step, cfg, mesh, opt, batches, sync_every=SYNC_EVERY,
+        prefetch_depth=2)
+
+    bitwise = sync_report.losses == pipe_report.losses
+    evidence = {
+        "tag": tag,
+        "config": cfg.name,
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "steps": STEPS,
+        "tokens_per_step": BATCH * SEQ,
+        "compile": {
+            "lower_seconds": round(timings.lower_seconds, 3),
+            "compile_seconds": round(timings.compile_seconds, 3),
+        },
+        "synchronous": {
+            "sync_every": 1,
+            "steps_per_sec": round(STEPS / sync_wall, 3),
+            **sync_counts,
+        },
+        "pipelined": {
+            "sync_every": SYNC_EVERY,
+            "steps_per_sec": round(STEPS / pipe_wall, 3),
+            "prefetch_wait_seconds": round(
+                pipe_report.prefetch_wait_seconds, 4),
+            **pipe_counts,
+        },
+        "speedup": round(sync_wall / max(pipe_wall, 1e-9), 4),
+        "per_step_host_syncs_eliminated": (
+            sync_counts["host_syncs"] == STEPS
+            and pipe_counts["host_syncs"] == -(-STEPS // SYNC_EVERY)),
+        "losses_bitwise_identical": bitwise,
+    }
+    with open(out_path, "w") as f:
+        json.dump(evidence, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"perf evidence written: {out_path}")
+    print(json.dumps(evidence["synchronous"]))
+    print(json.dumps(evidence["pipelined"]))
+    print(f"speedup={evidence['speedup']}")
+
+    # Hard contracts (deterministic); throughput is evidence, not a gate,
+    # but a gross regression (pipelined < 80% of sync) fails loudly.
+    if not bitwise:
+        print("FAIL: pipelined losses diverge from synchronous",
+              file=sys.stderr)
+        return 1
+    if not evidence["per_step_host_syncs_eliminated"]:
+        print("FAIL: host-sync counts do not show per-step syncs removed",
+              file=sys.stderr)
+        return 1
+    if evidence["speedup"] < 0.8:
+        print("FAIL: pipelined loop grossly slower than synchronous",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

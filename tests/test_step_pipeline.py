@@ -1,0 +1,355 @@
+"""Step-pipelined training hot path (ISSUE 3 tentpole).
+
+train/data.py DevicePrefetch + train/pipeline.py run_pipelined +
+train/trainer.py AOT compile split: overlap is measured (prefetch-wait
+accounting, tk8s_train_* families), the pipelined loop is bitwise
+identical to a per-step-synced loop, short epochs end cleanly, and the
+persistent-compile-cache plumbing bench.py relies on round-trips.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.models import get_config
+from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh
+from triton_kubernetes_tpu.train import (
+    DevicePrefetch,
+    aot_compile_step,
+    init_state,
+    make_optimizer,
+    make_train_step,
+    run_pipelined,
+)
+from triton_kubernetes_tpu.train.data import synthetic_batches
+from triton_kubernetes_tpu.utils import metrics as metrics_mod
+
+
+@pytest.fixture()
+def fresh_registry():
+    """Swap in an empty process-default registry; restore the old one."""
+    old = metrics_mod.get_registry()
+    reg = metrics_mod.configure()
+    yield reg
+    metrics_mod.configure(old)
+
+
+def _host_batches(n, batch=4, seq=32, vocab=256):
+    gen = synthetic_batches(vocab, batch, seq)
+    return [next(gen) for _ in range(n)]
+
+
+# ---------------------------------------------------------- DevicePrefetch
+
+def test_prefetch_fake_clock_wait_accounting():
+    """Inline (unthreaded) mode with an injected clock: only the stall on
+    an empty buffer counts as prefetch wait. The first batch costs one
+    production (0.5 fake-seconds); every later batch was staged ahead, so
+    wait stays exactly at the first stall — prefetch wait ~= 0 once the
+    producer is ahead."""
+    clock = {"t": 0.0}
+
+    def source():
+        for b in _host_batches(5):
+            clock["t"] += 0.5  # production cost, visible to the fake clock
+            yield b
+
+    pf = DevicePrefetch(source(), buffer_size=2, threaded=False,
+                        clock=lambda: clock["t"])
+    first = next(pf)
+    assert first["tokens"].shape == (4, 33)
+    assert pf.wait_seconds == pytest.approx(0.5)  # the one cold stall
+    rest = list(pf)
+    assert len(rest) == 4  # exhaustion: finite source ends the iterator
+    assert pf.wait_seconds == pytest.approx(0.5)  # no further stalls
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetch_threaded_overlap_wait_near_zero():
+    """When the producer runs ahead (finite source, fully drained into
+    the queue before the consumer asks), the consumer's measured input
+    wait is ~0 — host input fully overlaps 'compute'."""
+    batches = _host_batches(4)
+    pf = DevicePrefetch(iter(batches), buffer_size=4)
+    deadline = time.time() + 5.0
+    while pf._queue.qsize() < 4 and time.time() < deadline:
+        time.sleep(0.005)  # let the producer thread run ahead
+    out = list(pf)
+    assert len(out) == 4
+    assert pf.wait_seconds < 0.25  # µs-scale in practice; CI-safe slack
+
+
+def test_prefetch_threaded_slow_producer_wait_is_visible():
+    """A producer slower than the consumer shows up in wait_seconds —
+    the stall is measured, not hidden."""
+    def slow_source():
+        for b in _host_batches(3):
+            time.sleep(0.15)
+            yield b
+
+    pf = DevicePrefetch(slow_source(), buffer_size=2)
+    t0 = time.perf_counter()
+    out = list(pf)
+    assert len(out) == 3
+    assert time.perf_counter() - t0 >= 0.3
+    assert pf.wait_seconds >= 0.1  # at least one real stall attributed
+
+
+def test_prefetch_places_leaves_on_device_with_sharding(cpu_mesh_devices):
+    import jax
+    from jax.sharding import NamedSharding
+
+    from triton_kubernetes_tpu.train.trainer import batch_spec
+
+    mesh = create_mesh(MeshConfig(fsdp=4, tensor=2))
+    sharding = NamedSharding(mesh, batch_spec())
+    pf = DevicePrefetch(iter(_host_batches(2)), sharding=sharding)
+    batch = next(pf)
+    assert isinstance(batch["tokens"], jax.Array)
+    assert batch["tokens"].sharding == sharding
+    pf.close()
+
+
+def test_prefetch_propagates_producer_errors():
+    def bad_source():
+        yield _host_batches(1)[0]
+        raise RuntimeError("disk ate the shard")
+
+    pf = DevicePrefetch(bad_source(), buffer_size=2)
+    next(pf)
+    with pytest.raises(RuntimeError, match="disk ate the shard"):
+        while True:
+            next(pf)
+
+
+def test_prefetch_rejects_bad_buffer_size():
+    with pytest.raises(ValueError, match="buffer_size"):
+        DevicePrefetch(iter([]), buffer_size=0)
+
+
+# ----------------------------------------------------------- run_pipelined
+
+def _tiny_setup():
+    cfg = get_config("llama-test")
+    mesh = create_mesh(MeshConfig(fsdp=4, tensor=2))
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    step = make_train_step(cfg, mesh, opt)
+    return cfg, mesh, opt, step
+
+
+def test_pipelined_loop_bitwise_identical_to_sync(cpu_mesh_devices,
+                                                  fresh_registry):
+    """The tentpole determinism contract: removing per-step host syncs
+    must not move a single bit of the math. Same step fn, same batch
+    order — per-step losses from the per-K-synced loop equal the
+    per-step-synced loop's exactly (float equality, no tolerance)."""
+    import jax.numpy as jnp
+
+    cfg, mesh, opt, step = _tiny_setup()
+    batches = [{"tokens": jnp.asarray(b["tokens"])}
+               for b in _host_batches(7)]
+
+    # Reference: the old loop shape — one host sync per step.
+    state = init_state(cfg, mesh, opt)
+    sync_losses = []
+    for b in batches:
+        state, metrics = step(state, b)
+        sync_losses.append(float(metrics["loss"]))
+
+    # Pipelined: one host sync per 3 steps (the last window is partial).
+    state2 = init_state(cfg, mesh, opt)
+    state2, report = run_pipelined(
+        step, state2, batches, sync_every=3, max_steps=len(batches),
+        tokens_per_step=4 * 32, config_name="llama-test")
+
+    assert report.steps == 7
+    assert report.sync_points == 3  # ceil(7/3): 3+3+1
+    assert report.losses == sync_losses  # bitwise, not approx
+    assert int(state2.step) == int(state.step)
+
+    # The overlap evidence: syncs are per-window, tokens/steps per step.
+    assert metrics_mod.counter("tk8s_train_host_syncs_total").value(
+        config="llama-test") == 3
+    assert metrics_mod.histogram(
+        "tk8s_train_step_duration_seconds").count(config="llama-test") == 7
+    assert metrics_mod.counter("tk8s_train_tokens_total").value(
+        config="llama-test") == 7 * 4 * 32
+
+
+def test_pipelined_loop_short_epoch_and_empty(cpu_mesh_devices,
+                                              fresh_registry):
+    """A finite source shorter than max_steps ends the loop cleanly with
+    the partial tail window synced; an empty source does zero steps."""
+    import jax.numpy as jnp
+
+    cfg, mesh, opt, step = _tiny_setup()
+    batches = iter([{"tokens": jnp.asarray(b["tokens"])}
+                    for b in _host_batches(5)])
+    state = init_state(cfg, mesh, opt)
+    state, report = run_pipelined(step, state, batches, sync_every=4,
+                                  max_steps=100)
+    assert report.steps == 5
+    assert len(report.losses) == 5
+    assert report.sync_points == 2  # 4 + the short tail of 1
+    assert np.isfinite(report.last_metrics["loss"])
+
+    state, report = run_pipelined(step, state, iter([]), sync_every=4)
+    assert report.steps == 0 and report.losses == []
+
+
+def test_pipelined_loop_on_sync_callback_and_list_contract(
+        cpu_mesh_devices, fresh_registry):
+    import jax.numpy as jnp
+
+    cfg, mesh, opt, step = _tiny_setup()
+    batches = [{"tokens": jnp.asarray(_host_batches(1)[0]["tokens"])}]
+    state = init_state(cfg, mesh, opt)
+    seen = []
+    state, report = run_pipelined(
+        step, state, batches, sync_every=2, max_steps=5,
+        on_sync=lambda done, st, losses, dt: seen.append((done, len(losses))))
+    assert seen == [(2, 2), (4, 2), (5, 1)]
+    with pytest.raises(ValueError, match="max_steps"):
+        run_pipelined(step, state, batches, sync_every=2)  # list, no bound
+    with pytest.raises(ValueError, match="sync_every"):
+        run_pipelined(step, state, batches, sync_every=0, max_steps=1)
+
+
+def test_pipelined_loop_force_sync_splits_windows(cpu_mesh_devices,
+                                                  fresh_registry):
+    """force_sync closes a window early at caller boundaries (checkpoint
+    multiples) without shrinking sync_every for the other windows."""
+    import jax.numpy as jnp
+
+    cfg, mesh, opt, step = _tiny_setup()
+    batches = [{"tokens": jnp.asarray(_host_batches(1)[0]["tokens"])}]
+    state = init_state(cfg, mesh, opt)
+    seen = []
+    state, report = run_pipelined(
+        step, state, batches, sync_every=4, max_steps=10,
+        on_sync=lambda done, st, losses, dt: seen.append(done),
+        force_sync=lambda done: done % 5 == 0)
+    assert seen == [4, 5, 9, 10]
+    assert report.sync_points == 4
+
+
+def test_pipelined_loop_with_device_prefetch_end_to_end(cpu_mesh_devices,
+                                                        fresh_registry):
+    """The full hot path: DevicePrefetch feeding run_pipelined, wait
+    accounting mirrored into the gauge at sync points."""
+    from jax.sharding import NamedSharding
+
+    from triton_kubernetes_tpu.train.trainer import batch_spec
+
+    cfg, mesh, opt, step = _tiny_setup()
+    pf = DevicePrefetch(iter(_host_batches(6)),
+                        sharding=NamedSharding(mesh, batch_spec()))
+    state = init_state(cfg, mesh, opt)
+    state, report = run_pipelined(step, state, pf, sync_every=3,
+                                  tokens_per_step=4 * 32,
+                                  config_name="llama-test")
+    assert report.steps == 6
+    assert all(np.isfinite(l) for l in report.losses)
+    assert report.prefetch_wait_seconds == pytest.approx(
+        pf.wait_seconds)
+    gauge = metrics_mod.gauge("tk8s_train_prefetch_wait_seconds")
+    assert gauge.value() == pytest.approx(pf.wait_seconds)
+
+
+# ------------------------------------------------- AOT compile + the cache
+
+def test_aot_compile_split_and_executable(cpu_mesh_devices, fresh_registry):
+    """aot_compile_step: the split is measured, published through the
+    gauge, and the returned executable computes the same step as the
+    jitted original."""
+    import jax.numpy as jnp
+
+    cfg, mesh, opt, step = _tiny_setup()
+    batch = {"tokens": jnp.asarray(_host_batches(1)[0]["tokens"])}
+
+    state = init_state(cfg, mesh, opt)
+    compiled, timings = aot_compile_step(step, state, batch,
+                                         config_name="llama-test")
+    assert timings.lower_seconds >= 0 and timings.compile_seconds >= 0
+    assert timings.total_seconds == pytest.approx(
+        timings.lower_seconds + timings.compile_seconds)
+    gauge = metrics_mod.gauge("tk8s_train_compile_seconds")
+    assert gauge.value(config="llama-test", phase="lower") == \
+        timings.lower_seconds
+    assert gauge.value(config="llama-test", phase="compile") == \
+        timings.compile_seconds
+
+    state_c, metrics_c = compiled(state, batch)
+    state_j = init_state(cfg, mesh, opt)
+    state_j, metrics_j = step(state_j, batch)
+    assert float(metrics_c["loss"]) == float(metrics_j["loss"])
+
+
+def test_enable_compile_cache_configures_jax(tmp_path):
+    import jax
+
+    from triton_kubernetes_tpu.train import enable_compile_cache
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        out = enable_compile_cache(str(tmp_path / "cache"))
+        assert out == str(tmp_path / "cache")
+        assert (tmp_path / "cache").is_dir()
+        assert jax.config.jax_compilation_cache_dir == out
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+# ------------------------------------------------------- bench.py plumbing
+
+def test_bench_last_phase_parses_markers():
+    import bench
+
+    err = ("[bench-child] compile cache: /tmp/x\n"
+           "[bench-child] phase=backend_init\n"
+           "noise phase=red_herring\n"
+           "[bench-child] phase=compile (lower took 12.0s)\n")
+    assert bench._last_phase(err) == "compile"
+    assert bench._last_phase("no markers at all") == ""
+
+
+def test_bench_compile_cache_dir_env_override(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("BENCH_COMPILE_CACHE_DIR", "/tmp/pinned")
+    assert bench.compile_cache_dir() == "/tmp/pinned"
+    monkeypatch.delenv("BENCH_COMPILE_CACHE_DIR")
+    assert "tk8s-bench-compile-cache" in bench.compile_cache_dir()
+
+
+def test_bench_configs_ship_fused_ce():
+    """BENCH_r05 regression: the headline configs must measure the fused
+    CE head, not the [B,S,V]-materializing dense one."""
+    assert get_config("llama3-bench").fused_ce is True
+    # And the fast no-pad path applies: chunk divides the bench vocab.
+    cfg = get_config("llama3-bench")
+    assert cfg.vocab_size % cfg.ce_chunk == 0
+
+
+def test_measure_sync_every_passthrough(cpu_mesh_devices, fresh_registry):
+    """measure_tokens_per_sec drives the pipelined loop: sync cadence is
+    per window (or per sync_every), never per step."""
+    import jax.numpy as jnp
+
+    from triton_kubernetes_tpu.train.measure import measure_tokens_per_sec
+
+    cfg, mesh, opt, step = _tiny_setup()
+    state = init_state(cfg, mesh, opt)
+    batch = {"tokens": jnp.asarray(_host_batches(1)[0]["tokens"])}
+    tps, loss, state = measure_tokens_per_sec(
+        step, state, [batch], tokens_per_step=4 * 32,
+        warmup=1, n_short=2, n_long=4, config_name="llama-test")
+    assert tps > 0 and np.isfinite(loss)
+    # warmup(1) + short(2) + long(4) windows, one sync each.
+    assert metrics_mod.counter("tk8s_train_host_syncs_total").value(
+        config="llama-test") == 3
+    assert metrics_mod.histogram(
+        "tk8s_train_step_duration_seconds").count(config="llama-test") == 7

@@ -61,6 +61,39 @@ def test_data_dir_and_checkpoint_resume(cpu_mesh_devices, tmp_path, capsys):
     assert train[-1]["step"] == 4
 
 
+def test_checkpoint_cadence_not_quantized_by_sync_window(
+        cpu_mesh_devices, tmp_path, capsys):
+    """--checkpoint-every smaller than the sync window still saves at
+    every configured multiple: a forced sync splits the window exactly
+    at checkpoint boundaries instead of silently dropping saves (and
+    without shrinking the sync cadence anywhere else)."""
+    ckpt = tmp_path / "ckpt"
+    rc, err = _run(capsys, [
+        "--model", "llama-test", "--steps", "4", "--batch-size", "4",
+        "--seq-len", "16", "--fsdp", "4", "--tensor", "2",
+        "--checkpoint-dir", str(ckpt), "--checkpoint-every", "2",
+        "--log-every", "4", "--json-logs"])
+    assert rc == 0
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    saves = [l["step"] for l in lines if l["msg"] == "checkpoint saved"]
+    assert saves == [2, 4]
+
+
+def test_profile_dir_traces_single_window_run(cpu_mesh_devices, tmp_path,
+                                              capsys):
+    """A run that fits in one sync window still produces a trace (the
+    profiler starts before the loop — AOT compile already excluded)."""
+    rc, err = _run(capsys, [
+        "--model", "llama-test", "--steps", "2", "--batch-size", "4",
+        "--seq-len", "16", "--fsdp", "4", "--tensor", "2",
+        "--log-every", "10", "--profile-dir", str(tmp_path / "prof"),
+        "--json-logs"])
+    assert rc == 0
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    assert any(l["msg"] == "profiler trace written" for l in lines)
+    assert (tmp_path / "prof").exists()
+
+
 def test_bad_batch_divisibility(cpu_mesh_devices, capsys):
     rc, _ = _run(capsys, [
         "--model", "llama-test", "--steps", "1", "--batch-size", "3",

@@ -125,10 +125,16 @@ MIXTRAL_8X7B = _register(ModelConfig(
 # ---- single-chip bench config (~420M params, fits v5e 16 GB with Adam).
 # head_dim 128 like the real Llama-3 family: full MXU lanes in the flash
 # kernels and half the flat batch*head grid rows vs 16x64 at equal FLOPs.
+# fused_ce on: at vocab 32768 the f32 logits + cotangent are the step's
+# largest activations (2 x B*S*V*4B of pure HBM traffic) — the bench
+# number must measure the head the production path ships with, and the
+# flag had silently defaulted off here (BENCH_r05). Parity vs the dense
+# head is pinned in tests/test_train.py::test_fused_ce_matches_logits_path
+# and the op-level grads test.
 LLAMA3_BENCH = _register(ModelConfig(
     name="llama3-bench", vocab_size=32_768, embed_dim=1024, num_layers=24,
     num_heads=8, num_kv_heads=4, head_dim=128, mlp_dim=4096,
-    max_seq_len=2048, remat_policy="dots"))
+    max_seq_len=2048, remat_policy="dots", fused_ce=True))
 
 # ---- CPU-mesh test miniatures (dims divisible by 2-way tp/sp/fsdp) ----
 LLAMA_TEST = _register(ModelConfig(

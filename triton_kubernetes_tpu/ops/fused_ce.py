@@ -28,6 +28,8 @@ DEFAULT_CHUNK = 8192
 def _chunked_w(w: jnp.ndarray, chunk: int):
     """[D, V] -> [nc, D, chunk] (vocab-padded); pads score -inf via mask
     handled by callers using the true V."""
+    if chunk < 1:
+        raise ValueError(f"ce_chunk must be >= 1, got {chunk}")
     d, v = w.shape
     pad = (-v) % chunk
     if pad:
@@ -53,9 +55,12 @@ def _forward(h, w, targets, chunk):
         w_chunk, start = xs
         logits = jnp.einsum("td,dc->tc", h, w_chunk.astype(dtype),
                             preferred_element_type=jnp.float32)
-        # Padded vocab columns must not contribute.
-        col = start + jnp.arange(chunk)
-        logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
+        if v_pad != v:
+            # Padded vocab columns must not contribute. When the chunk
+            # divides the vocab (llama3-bench: 32768 % 8192 == 0) there is
+            # no padding and the [T, C] mask+where never materializes.
+            col = start + jnp.arange(chunk)
+            logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.exp(
             logits - m_new[:, None]).sum(axis=-1)
@@ -86,9 +91,10 @@ def _backward(chunk, residuals, g):
         w_chunk, start = xs
         logits = jnp.einsum("td,dc->tc", h, w_chunk.astype(dtype),
                             preferred_element_type=jnp.float32)
-        col = start + jnp.arange(chunk)
-        p = jnp.where(col[None, :] < v,
-                      jnp.exp(logits - lse[:, None]), 0.0)
+        p = jnp.exp(logits - lse[:, None])
+        if v_pad != v:  # zero the padded columns' softmax mass (see fwd)
+            col = start + jnp.arange(chunk)
+            p = jnp.where(col[None, :] < v, p, 0.0)
         in_chunk = (targets >= start) & (targets < start + chunk)
         idx = jnp.clip(targets - start, 0, chunk - 1)
         onehot = (jnp.arange(chunk)[None, :] == idx[:, None]) & \

@@ -6,8 +6,18 @@ package provides the trainer those jobs run: sharded train step, MFU
 accounting, data pipeline, and orbax checkpointing.
 """
 
+from .data import DevicePrefetch
 from .mfu import flops_per_token, mfu, tokens_per_sec_for_mfu
-from .trainer import TrainState, make_optimizer, make_train_step, init_state
+from .pipeline import LoopReport, run_pipelined
+from .trainer import (
+    CompileTimings,
+    TrainState,
+    aot_compile_step,
+    enable_compile_cache,
+    init_state,
+    make_optimizer,
+    make_train_step,
+)
 
 __all__ = [
     "flops_per_token",
@@ -17,4 +27,10 @@ __all__ = [
     "make_optimizer",
     "make_train_step",
     "init_state",
+    "DevicePrefetch",
+    "LoopReport",
+    "run_pipelined",
+    "CompileTimings",
+    "aot_compile_step",
+    "enable_compile_cache",
 ]

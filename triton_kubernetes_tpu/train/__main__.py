@@ -63,6 +63,17 @@ def _build_parser() -> argparse.ArgumentParser:
                         "steps into this directory (view with "
                         "tensorboard/xprof; SURVEY.md §5 tracing "
                         "obligation)")
+    p.add_argument("--sync-every", type=int, default=0,
+                   help="steps between device->host metric syncs; also "
+                        "the in-flight bound of the pipelined loop "
+                        "(0 = --log-every)")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="device-prefetch buffer depth (0 disables the "
+                        "background producer + jax.device_put staging)")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="JAX persistent compilation cache directory; "
+                        "reused across runs so restarts skip XLA "
+                        "recompilation")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--json-logs", action="store_true")
     p.add_argument("--distributed", choices=["auto", "on", "off"],
@@ -121,7 +132,9 @@ def main(argv=None) -> int:
     from ..ops.ring_attention import make_ring_attention
     from ..parallel import MeshConfig, create_mesh
     from ..parallel.mesh import describe_mesh
-    from .trainer import init_state, make_optimizer, make_train_step
+    from .trainer import (
+        aot_compile_step, enable_compile_cache, init_state, make_optimizer,
+        make_train_step)
     from .mfu import flops_per_token, mfu as compute_mfu
 
     from ..config.config import parse_scalar
@@ -132,6 +145,10 @@ def main(argv=None) -> int:
         if not sep:
             raise SystemExit(f"--model-opt expects K=V, got {item!r}")
         overrides[key] = parse_scalar(value)
+    if args.compile_cache_dir:
+        cache = enable_compile_cache(args.compile_cache_dir)
+        log.log("info", "persistent compile cache",
+                dir=cache or "(unsupported by this jax)")
     config = get_config(args.model, **overrides)
     seq_len = args.seq_len or config.max_seq_len
     mesh_cfg = MeshConfig(
@@ -203,49 +220,103 @@ def main(argv=None) -> int:
         log.log("info", "skipping consumed batches", count=start_step)
         for _ in range(start_step):
             next(gen)
-    t0 = time.perf_counter()
-    timed_from = start_step
     tokens_per_step = batch_size * seq_len
     last_loss = float("nan")
     tracing = False
+    max_steps = max(args.steps - start_step, 0)
+    if args.dry_run:
+        max_steps = min(max_steps, 1)
+    sync_every = 1 if args.dry_run else \
+        max(args.sync_every or args.log_every, 1)
+    # Checkpoints happen at sync points; force an extra sync exactly at
+    # every configured multiple (windows split there — the requested
+    # sync_every cadence is preserved everywhere else, and resume from a
+    # non-aligned step keeps the absolute multiples).
+    force_sync = None
+    if args.checkpoint_every and args.checkpoint_dir:
+        force_sync = lambda done: \
+            (start_step + done) % args.checkpoint_every == 0
+
+    # Step-pipelined hot path (train/pipeline.py): steps dispatch back to
+    # back with the next batch's host->device transfer already in flight
+    # (DevicePrefetch) and ONE host sync per window — never one per step.
+    from .data import DevicePrefetch
+    from .pipeline import run_pipelined
+    from .trainer import batch_spec
+    from jax.sharding import NamedSharding
+
+    host_batches = ({"tokens": b["tokens"]} for b in gen)
+    # device_put with a mesh sharding needs the whole array addressable;
+    # multi-host slices keep the historical feed (jit stages per step).
+    prefetch = None
+    if args.prefetch > 0 and jax.process_count() == 1 and max_steps:
+        prefetch = DevicePrefetch(
+            host_batches, sharding=NamedSharding(mesh, batch_spec()),
+            buffer_size=args.prefetch)
+    batches = prefetch if prefetch is not None else host_batches
+
+    timings = None
+    if max_steps:
+        # AOT compile against the exact first batch: the compile cost is
+        # measured and attributed (lower vs XLA) instead of silently
+        # diluting the first window, and the loop cannot retrace.
+        import itertools
+
+        first = next(batches, None)
+        if first is None:
+            max_steps = 0
+        else:
+            step_fn, timings = aot_compile_step(
+                step_fn, state, first, config_name=config.name)
+            log.log("info", "train step compiled",
+                    lower_s=round(timings.lower_seconds, 3),
+                    compile_s=round(timings.compile_seconds, 3),
+                    cache_dir=timings.cache_dir or "")
+            batches = itertools.chain([first], batches)
+
+    last_ckpt_mark = start_step // args.checkpoint_every \
+        if args.checkpoint_every else 0
+
+    def on_sync(done, cur_state, window_losses, window_dt):
+        nonlocal last_loss, last_ckpt_mark
+        gstep = start_step + done
+        last_loss = window_losses[-1]
+        tps = tokens_per_step * len(window_losses) / max(window_dt, 1e-9)
+        fields = dict(step=gstep, loss=round(last_loss, 4),
+                      tokens_per_sec=round(tps, 1),
+                      tflops=round(tps * fpt / 1e12, 2))
+        if peak:
+            fields["mfu"] = round(compute_mfu(tps, config, seq_len, peak), 4)
+        if prefetch is not None:
+            fields["prefetch_wait_s"] = round(prefetch.wait_seconds, 4)
+        log.log("info", "train", **fields)
+        if ckpt and args.checkpoint_every:
+            mark = gstep // args.checkpoint_every
+            if mark > last_ckpt_mark:
+                last_ckpt_mark = mark
+                ckpt.save(gstep, cur_state)
+                log.log("info", "checkpoint saved", step=gstep)
+
     try:
-        for i in range(start_step, args.steps):
-            # Both sources yield int32 numpy [B, S+1]; jit places it on the
-            # mesh directly, no eager host->device staging.
-            state, metrics = step_fn(state, {"tokens": next(gen)["tokens"]})
-            if i == start_step:
-                # Restart the throughput window after the compile step so the
-                # reported tokens/sec is steady-state, not compile-diluted.
-                float(metrics["loss"])
-                t0 = time.perf_counter()
-                timed_from = i + 1
-                if args.profile_dir and not args.dry_run \
-                        and args.steps > start_step + 1:
-                    # Steady-state steps only: the compile step would dwarf
-                    # everything else in the trace.
-                    jax.profiler.start_trace(args.profile_dir)
-                    tracing = True
-                    log.log("info", "profiler tracing", dir=args.profile_dir)
-            if args.dry_run or (i + 1) % args.log_every == 0 \
-                    or i + 1 == args.steps:
-                last_loss = float(metrics["loss"])  # device sync
-                dt = time.perf_counter() - t0
-                done = i + 1 - timed_from
-                tps = tokens_per_step * done / max(dt, 1e-9) if done else 0.0
-                fields = dict(step=i + 1, loss=round(last_loss, 4),
-                              tokens_per_sec=round(tps, 1),
-                              tflops=round(tps * fpt / 1e12, 2))
-                if peak:
-                    fields["mfu"] = round(compute_mfu(
-                        tps, config, seq_len, peak), 4)
-                log.log("info", "train", **fields)
-            if ckpt and args.checkpoint_every \
-                    and (i + 1) % args.checkpoint_every == 0:
-                ckpt.save(i + 1, state)
-                log.log("info", "checkpoint saved", step=i + 1)
-            if args.dry_run:
-                break
+        if max_steps:
+            if args.profile_dir and not args.dry_run:
+                # The compile step is already excluded (AOT above), so the
+                # whole loop is steady state — trace all of it. Single-
+                # window runs get a trace too.
+                jax.profiler.start_trace(args.profile_dir)
+                tracing = True
+                log.log("info", "profiler tracing", dir=args.profile_dir)
+            state, report = run_pipelined(
+                step_fn, state, batches, sync_every=sync_every,
+                max_steps=max_steps, tokens_per_step=tokens_per_step,
+                config_name=config.name, on_sync=on_sync,
+                force_sync=force_sync, prefetch=prefetch)
+            if report.steps < max_steps:
+                log.log("warn", "data exhausted before requested steps",
+                        done=start_step + report.steps, want=args.steps)
     finally:
+        if prefetch is not None:
+            prefetch.close()
         if tracing:
             # try/finally: the trace matters MOST when the run dies (OOM,
             # interrupt) — sync so it holds completed device work, then

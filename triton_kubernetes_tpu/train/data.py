@@ -10,12 +10,23 @@ the trainer shards over (data, fsdp):
 - ``PackedDataset`` — zero-copy np.memmap over a flat binary token file
   (the MaxText-style pretokenized format): fixed-length windows, no Python
   per-token work, so host input never gates the device step.
+
+Either source can be wrapped in :class:`DevicePrefetch`, the
+double-buffered host->device staging layer of the pipelined training loop
+(train/pipeline.py): a background thread assembles host batches while
+``jax.device_put`` keeps the next sharded batch's transfer in flight
+under the current step, so the loop's input wait is ~0 whenever the
+producer keeps up (measured, not assumed: ``wait_seconds`` feeds the
+``tk8s_train_prefetch_wait_seconds`` gauge).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterator
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator
 
 import numpy as np
 
@@ -251,3 +262,158 @@ class ShardedTokenPipeline:
             self.close()
         except Exception:
             pass
+
+
+# --------------------------------------------------------------------------
+# Device prefetch: the input half of the step-pipelined training loop.
+# --------------------------------------------------------------------------
+
+class _Drained:
+    """Queue sentinel: the producer finished the source."""
+
+
+class _ProducerError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DevicePrefetch:
+    """Double-buffered host->device prefetch over a host-batch iterator.
+
+    Two overlaps, both ahead of the device step that will consume them:
+
+    1. **Host batch assembly** — a daemon thread drains ``source`` into a
+       bounded queue (``buffer_size`` deep), so Python-side batch work
+       (Markov generation, memmap gathers) runs during device compute.
+    2. **Host->device transfer** — each dequeued batch is staged with
+       ``jax.device_put`` (against ``sharding`` when given) as soon as a
+       buffer slot frees up. ``device_put`` is asynchronous, so the DMA
+       of batch i+1 rides under step i; by the time the loop asks for the
+       next batch its buffers are already resident.
+
+    The iterator yields whatever structure ``source`` yields (dicts of
+    arrays), with every leaf placed on device. Finite sources terminate
+    the iterator normally (StopIteration) — short-epoch runs just end
+    early instead of crashing the loop.
+
+    Measurement: ``wait_seconds`` accumulates the time ``__next__`` spent
+    blocked on the producer (the loop's only input stall); ``last_wait``
+    holds the most recent one. The pipelined loop mirrors ``wait_seconds``
+    into the ``tk8s_train_prefetch_wait_seconds`` gauge at each sync.
+    ``threaded=False`` runs the producer inline (deterministic tests,
+    single-threaded embedders) — staging still happens one batch ahead.
+    """
+
+    def __init__(self, source: Iterable[Any], sharding=None,
+                 buffer_size: int = 2, threaded: bool = True,
+                 clock: Callable[[], float] = time.perf_counter):
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        self.sharding = sharding
+        self.buffer_size = buffer_size
+        self.threaded = threaded
+        self.wait_seconds = 0.0
+        self.last_wait = 0.0
+        self.batches_out = 0
+        self._clock = clock
+        self._source = iter(source)
+        self._staged: list = []  # device-put batches, oldest first
+        self._exhausted = False
+        self._pending_error: BaseException | None = None
+        if threaded:
+            self._queue: queue.Queue = queue.Queue(maxsize=buffer_size)
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._produce, name="tk8s-prefetch", daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------ producer
+    def _produce(self) -> None:
+        try:
+            for item in self._source:
+                if self._stop.is_set():
+                    return
+                self._queue.put(item)
+            self._queue.put(_Drained)
+        except BaseException as e:  # surfaced on the consumer side
+            self._queue.put(_ProducerError(e))
+
+    def _next_host(self):
+        """One host batch from the producer, or _Drained; blocks (timed)."""
+        if not self.threaded:
+            return next(self._source, _Drained)
+        item = self._queue.get()
+        if isinstance(item, _ProducerError):
+            self._exhausted = True
+            raise item.exc
+        return item
+
+    # ------------------------------------------------------------ consumer
+    def _place(self, batch):
+        import jax
+
+        if self.sharding is None:
+            return jax.tree.map(jax.device_put, batch)
+        return jax.tree.map(
+            lambda leaf: jax.device_put(leaf, self.sharding), batch)
+
+    def _fill(self, block: bool) -> None:
+        """Stage batches until the buffer is full. Only an *empty* buffer
+        under ``block`` is allowed to wait on the producer (and that wait
+        is the measured input stall); top-ups are opportunistic."""
+        while not self._exhausted and len(self._staged) < self.buffer_size:
+            must_wait = block and not self._staged
+            if self.threaded and not must_wait and self._queue.empty():
+                return  # opportunistic top-up only; never block here
+            t0 = self._clock()
+            try:
+                item = self._next_host()
+            except BaseException as e:
+                if not self._staged:
+                    raise
+                # Hand out the batches produced before the failure first;
+                # re-raise once the buffer drains.
+                self._pending_error = e
+                self._exhausted = True
+                return
+            wait = self._clock() - t0
+            if must_wait:
+                self.last_wait = wait
+                self.wait_seconds += wait
+            if item is _Drained:
+                self._exhausted = True
+                return
+            self._staged.append(self._place(item))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._fill(block=True)
+        if not self._staged:
+            self.close()
+            if self._pending_error is not None:
+                e, self._pending_error = self._pending_error, None
+                raise e
+            raise StopIteration
+        out = self._staged.pop(0)
+        self.batches_out += 1
+        self._fill(block=False)  # start the next transfer before returning
+        return out
+
+    def close(self) -> None:
+        self._exhausted = True
+        if self.threaded:
+            self._stop.set()
+            # Unblock a producer parked on a full queue.
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -7,7 +7,12 @@ might get baked into bench.py is produced the same way:
   can return before the computation finishes; only a device->host fetch
   (``float(metrics["loss"])``) is a reliable barrier;
 - two-point timing: (t_long - t_short) cancels the fixed dispatch+fetch
-  overhead of the tunnel (up to ~0.5 s per window).
+  overhead of the tunnel (up to ~0.5 s per window);
+- pipelined execution: each window runs through
+  :func:`..train.pipeline.run_pipelined` — steps dispatch back to back
+  with zero per-step host syncs, exactly like the production loop being
+  measured, and the run feeds the ``tk8s_train_*`` metric families so a
+  bench number comes with its step-duration histogram attached.
 """
 
 from __future__ import annotations
@@ -18,10 +23,17 @@ from typing import Any, Dict, List, Tuple
 
 def measure_tokens_per_sec(step, state, batches: List[Dict[str, Any]],
                            tokens_per_step: int, warmup: int,
-                           n_short: int, n_long: int
+                           n_short: int, n_long: int,
+                           sync_every: int = 0,
+                           config_name: str = "",
                            ) -> Tuple[float, float, Any]:
     """Returns (tokens/sec, last loss, final state). ``n_long`` must
-    exceed ``n_short`` (the timed window is their difference)."""
+    exceed ``n_short`` (the timed window is their difference).
+    ``sync_every`` sets the host-sync cadence inside each window; 0 syncs
+    once at the window end (the historical behavior — the whole window is
+    in flight)."""
+    from .pipeline import run_pipelined
+
     if n_long <= n_short:
         raise ValueError(
             f"n_long ({n_long}) must exceed n_short ({n_short})")
@@ -30,10 +42,12 @@ def measure_tokens_per_sec(step, state, batches: List[Dict[str, Any]],
         nonlocal state
         t0 = time.perf_counter()
         loss = float("nan")
-        for i in range(n):
-            state, metrics = step(state, batches[i % len(batches)])
         if n:
-            loss = float(metrics["loss"])  # device->host sync barrier
+            state, report = run_pipelined(
+                step, state, list(batches), max_steps=n,
+                sync_every=sync_every or n,
+                tokens_per_step=tokens_per_step, config_name=config_name)
+            loss = report.losses[-1]  # fetched at the window's sync point
         return time.perf_counter() - t0, loss
 
     run(warmup)

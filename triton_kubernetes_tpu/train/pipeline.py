@@ -29,7 +29,10 @@ to the dense einsum.
 
 from __future__ import annotations
 
-from typing import Optional
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -188,3 +191,149 @@ def pipeline_forward(
 
 def pipeline_degree(mesh: Mesh) -> int:
     return mesh_axis_size(mesh, AXIS_STAGE)
+
+
+# ==========================================================================
+# Host-side step pipelining: the producer/consumer training loop.
+#
+# The GPipe schedule above pipelines *within* one step; this section
+# pipelines *across* steps. JAX dispatch is asynchronous, so the fast loop
+# is simply the one that never forces a device->host sync: steps are
+# dispatched back to back (the device queue keeps up to ``sync_every``
+# steps in flight), per-step metrics stay resident as device scalars, and
+# the host touches the device exactly once per sync window — one
+# ``device_get`` of the window's metric scalars, which also drains the
+# in-flight queue and thereby bounds it. Input never gates dispatch when
+# the batches iterator is a ``train.data.DevicePrefetch``.
+#
+# Every quantity the old per-step loop printed is still available — just
+# amortized: per-step losses come out bitwise identical (same step_fn,
+# same batch order; the sync cadence does not touch the math), and the
+# overlap itself is measurable through the ``tk8s_train_*`` families
+# (utils/metrics.py CATALOG) instead of being vibes.
+# ==========================================================================
+
+
+@dataclass
+class LoopReport:
+    """What one ``run_pipelined`` call did, fully host-resident."""
+
+    steps: int = 0
+    losses: List[float] = field(default_factory=list)  # per step, in order
+    sync_points: int = 0
+    wall_seconds: float = 0.0
+    steps_per_sec: float = 0.0
+    tokens_per_sec: float = 0.0
+    prefetch_wait_seconds: float = 0.0
+    last_metrics: Dict[str, float] = field(default_factory=dict)
+
+
+def run_pipelined(
+    step_fn: Callable[[Any, Any], Tuple[Any, Dict[str, jnp.ndarray]]],
+    state: Any,
+    batches: Iterable[Any],
+    *,
+    sync_every: int = 8,
+    max_steps: Optional[int] = None,
+    tokens_per_step: int = 0,
+    config_name: str = "",
+    on_sync: Optional[Callable[[int, Any, List[float], float], None]] = None,
+    force_sync: Optional[Callable[[int], bool]] = None,
+    prefetch: Any = None,
+    clock: Callable[[], float] = time.perf_counter,
+) -> Tuple[Any, LoopReport]:
+    """Bounded-async training loop: dispatch every step, sync every K.
+
+    ``batches`` is any iterable of step inputs (a list/tuple is cycled —
+    pass ``max_steps`` then); a finite iterator ends the loop early
+    (short epoch), which is reported, not an error. ``sync_every`` is both
+    the host-sync cadence and the in-flight bound: the window fetch waits
+    on the newest dispatched step, so at most ``sync_every`` steps are
+    ever outstanding. ``on_sync(step, state, window_losses,
+    window_seconds)`` runs at each sync point — the only place logging
+    and checkpointing belong (anything per-step would reintroduce the
+    sync this loop exists to remove). ``force_sync(steps_done)`` may
+    close a window early at caller-meaningful boundaries (checkpoint
+    multiples) without shrinking ``sync_every`` for every other window.
+    ``prefetch`` names the :class:`..train.data.DevicePrefetch` feeding
+    ``batches`` when the iterable wraps it (e.g. in an
+    ``itertools.chain``), so input-wait accounting still reaches the
+    gauge.
+
+    Returns ``(final_state, LoopReport)``; ``report.losses`` is bitwise
+    identical to what a per-step-synced loop over the same step_fn and
+    batch order would fetch.
+    """
+    from ..utils import metrics as _metrics
+
+    if sync_every < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    if isinstance(batches, (list, tuple)):
+        if max_steps is None:
+            raise ValueError(
+                "a list of batches is cycled forever; pass max_steps")
+        batches_it: Iterable[Any] = itertools.cycle(batches)
+    else:
+        batches_it = batches
+    if max_steps is not None:
+        batches_it = itertools.islice(batches_it, max_steps)
+
+    hist = _metrics.histogram("tk8s_train_step_duration_seconds")
+    tokens_total = _metrics.counter("tk8s_train_tokens_total")
+    syncs_total = _metrics.counter("tk8s_train_host_syncs_total")
+    wait_gauge = _metrics.gauge("tk8s_train_prefetch_wait_seconds")
+    inflight_gauge = _metrics.gauge("tk8s_train_steps_in_flight")
+
+    report = LoopReport()
+    window: List[Dict[str, jnp.ndarray]] = []
+    t_start = clock()
+    t_window = t_start
+
+    def sync() -> None:
+        nonlocal t_window
+        if not window:
+            return
+        inflight_gauge.set(len(window))
+        # THE host sync: one transfer of the window's metric scalars
+        # (losses + the newest step's full metrics dict, combined so the
+        # host_syncs count equals real transfer points). Fetching the
+        # newest step transitively drains every step dispatched before
+        # it, so this both reports and bounds.
+        fetched, last_vals = jax.device_get(
+            ([m["loss"] for m in window], window[-1]))
+        dt = clock() - t_window
+        window_losses = [float(x) for x in fetched]
+        report.last_metrics = {k: float(v) for k, v in last_vals.items()}
+        report.losses.extend(window_losses)
+        report.sync_points += 1
+        per_step = dt / len(window)
+        for _ in window:
+            hist.observe(per_step, config=config_name)
+        if tokens_per_step:
+            tokens_total.inc(tokens_per_step * len(window),
+                             config=config_name)
+        syncs_total.inc(config=config_name)
+        wait = getattr(prefetch if prefetch is not None else batches,
+                       "wait_seconds", None)
+        if wait is not None:
+            report.prefetch_wait_seconds = float(wait)
+            wait_gauge.set(float(wait))
+        inflight_gauge.set(0)
+        window.clear()
+        if on_sync is not None:
+            on_sync(report.steps, state, window_losses, dt)
+        t_window = clock()
+
+    for batch in batches_it:
+        state, metrics = step_fn(state, batch)
+        window.append(metrics)
+        report.steps += 1
+        if len(window) >= sync_every or (
+                force_sync is not None and force_sync(report.steps)):
+            sync()
+    sync()
+    report.wall_seconds = max(clock() - t_start, 1e-9)
+    report.steps_per_sec = report.steps / report.wall_seconds
+    report.tokens_per_sec = (
+        report.steps * tokens_per_step / report.wall_seconds)
+    return state, report

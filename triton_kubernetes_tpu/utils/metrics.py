@@ -87,6 +87,29 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
     "tk8s_repairs_total": (
         "counter", "repair {node,slice} workflow runs by outcome",
         ("kind", "outcome"), None),
+    # ------------------------------------- train/pipeline.py (step loop)
+    "tk8s_train_step_duration_seconds": (
+        "histogram", "Per-step wall-clock duration, amortized over each "
+        "sync window of the pipelined training loop", ("config",),
+        DEFAULT_BUCKETS),
+    "tk8s_train_tokens_total": (
+        "counter", "Tokens trained, incremented at each host sync point",
+        ("config",), None),
+    "tk8s_train_host_syncs_total": (
+        "counter", "Device->host metric syncs taken by the training loop "
+        "(one per sync window, NOT one per step)", ("config",), None),
+    "tk8s_train_prefetch_wait_seconds": (
+        "gauge", "Seconds the training loop has spent blocked waiting on "
+        "the device-prefetch iterator (cumulative; ~0 means host input "
+        "fully overlaps device compute)", (), None),
+    "tk8s_train_steps_in_flight": (
+        "gauge", "Dispatched-but-unsynced steps currently in flight in "
+        "the pipelined training loop", (), None),
+    # ------------------------------------ train/trainer.py (AOT compile)
+    "tk8s_train_compile_seconds": (
+        "gauge", "AOT compile-time split of the train step by phase "
+        "(lower / compile); near-zero compile on a warm persistent "
+        "cache", ("config", "phase"), None),
 }
 
 _VALID_KINDS = ("counter", "gauge", "histogram")
