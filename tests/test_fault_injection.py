@@ -145,6 +145,66 @@ def test_preempt_fires_on_mutation_clock():
     assert all(n["labels"] == {} and n["preempted"] for n in pool["nodes"])
 
 
+def _pooled_sim():
+    sim = CloudSimulator()
+    sim.create_hosted_cluster("gke", "ml")
+    from triton_kubernetes_tpu.topology import (SliceSpec,
+                                                host_labels_for_slice)
+
+    spec = SliceSpec.from_accelerator("v5e-16")
+    sim.create_node_pool("gke", "ml", "pool0", spec.num_hosts,
+                         node_labels=host_labels_for_slice(spec, "ml-pool0"))
+    return sim
+
+
+def test_graceful_warning_preemption_delivers_signal_then_reclaims():
+    """The GKE contract in the simulator: the graceful-warning mode sends
+    a real SIGTERM to the trainer process at the warning tick (here: our
+    own pid, caught by the production PreemptionGuard handler), and only
+    reclaims the slice grace_ops mutations later — the window where the
+    emergency checkpoint gets written."""
+    import os
+
+    from triton_kubernetes_tpu.train.resilience import PreemptionGuard
+
+    sim = _pooled_sim()
+    at = sim.ops + 1
+    armed = CloudSimulator(sim.to_dict())
+    armed.fault_plan = FaultPlan({"faults": [
+        {"op": "preempt", "slice_id": "ml-pool0", "at_op": at,
+         "mode": "graceful-warning", "notify_pid": os.getpid(),
+         "grace_ops": 2}]})
+    guard = PreemptionGuard()
+    with guard:
+        armed.create_resource("net", "a")  # warning tick: SIGTERM lands
+        assert guard.requested
+        # Warned but NOT yet reclaimed: the pool is marked, still whole.
+        pool = armed.get_resource("gke_cluster", "ml")["node_pools"]["pool0"]
+        assert pool.get("preempt_warning") and not pool.get("preempted")
+        assert armed.preempted_slices() == {}
+        armed.create_resource("net", "b")  # grace window passes...
+        armed.create_resource("net", "c")  # ...reclaim fires
+    assert list(armed.preempted_slices()) == ["ml-pool0"]
+
+
+def test_graceful_warning_state_roundtrip_does_not_rewarn():
+    """warned/fired flags serialize with the cloud state: a rebuilt
+    simulator continues the sequence (no duplicate SIGTERM, reclaim still
+    due) instead of restarting it."""
+    sim = _pooled_sim()
+    sim.fault_plan = FaultPlan({"faults": [
+        {"op": "preempt", "slice_id": "ml-pool0", "at_op": sim.ops + 1,
+         "mode": "graceful-warning", "notify_pid": 0,  # no signal target
+         "grace_ops": 2}]})
+    sim.create_resource("net", "a")
+    assert sim.fault_plan.rules[0]["warned"] == 1
+    revived = CloudSimulator(sim.to_dict())
+    assert revived.fault_plan.rules[0]["warned"] == 1
+    revived.create_resource("net", "b")
+    revived.create_resource("net", "c")
+    assert list(revived.preempted_slices()) == ["ml-pool0"]
+
+
 # ------------------------------------------------------------- engine retry
 
 def test_engine_retries_boot_fault_with_backoff():

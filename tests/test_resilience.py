@@ -1,0 +1,748 @@
+"""Survive-the-step (ISSUE 4): preemption-aware emergency checkpoints,
+integrity-verified restore with quarantine + fallback, and the
+loss-anomaly rollback guard.
+
+Everything tier-1 here is deterministic: preemption is a real SIGTERM
+delivered to our own pid at a chosen sync point (the handler path is the
+production path), corruption is a literal truncation/bit-flip of real
+orbax files, and anomalies are injected losses. The slow-marked test at
+the bottom runs the whole kill-and-resume loop through actual trainer
+subprocesses with the cloudsim graceful-warning fault delivering the
+signal.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.train.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointIntegrityError,
+    CheckpointManager,
+    MeshMismatchError,
+    restore_newest_verified,
+)
+from triton_kubernetes_tpu.train.resilience import (
+    EXIT_RESUME,
+    Anomaly,
+    AnomalyAbortedError,
+    LossAnomalyGuard,
+    PreemptionGuard,
+    run_resilient,
+)
+from triton_kubernetes_tpu.utils import metrics as metrics_mod
+
+
+@pytest.fixture()
+def fresh_registry():
+    old = metrics_mod.get_registry()
+    reg = metrics_mod.configure()
+    yield reg
+    metrics_mod.configure(old)
+
+
+# ----------------------------------------------------------- fake workload
+
+def _fake_state(step=0, w=0.0):
+    return {"step": np.asarray(step, np.int32),
+            "w": np.asarray(w, np.float32)}
+
+
+def _fake_batches(start):
+    """Deterministic stream: batch i carries the value i (1-based), so
+    the final state's ``w`` proves exactly which batches were trained."""
+    def gen():
+        i = start
+        while True:
+            i += 1
+            yield {"x": np.asarray(float(i), np.float32)}
+    return gen()
+
+
+def _fake_step(loss_for=None):
+    """step_fn over the fake state: w accumulates batch values; loss is
+    1/step unless ``loss_for(step, batch_value)`` overrides it."""
+    def step_fn(state, batch):
+        s = int(state["step"]) + 1
+        loss = 1.0 / s
+        if loss_for is not None:
+            override = loss_for(s, float(batch["x"]))
+            if override is not None:
+                loss = override
+        return ({"step": np.asarray(s, np.int32),
+                 "w": np.asarray(state["w"] + batch["x"], np.float32)},
+                {"loss": np.asarray(loss, np.float32)})
+    return step_fn
+
+
+# ------------------------------------------------- manifest commit marker
+
+def test_save_writes_manifest_and_verifies(tmp_path, fresh_registry):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, _fake_state(1), wait=True)
+    sdir = tmp_path / "ckpt" / "1"
+    manifest = json.loads((sdir / MANIFEST_NAME).read_text())
+    assert manifest["step"] == 1 and manifest["kind"] == "scheduled"
+    assert manifest["files"] and manifest["digest"]
+    assert any(leaf["path"].endswith("['w']") for leaf in manifest["tree"])
+    mgr.verify_step(1)  # no raise
+    assert mgr.latest_verified_step() == 1
+    # Save metrics moved: duration observed, bytes counted.
+    assert metrics_mod.histogram(
+        "tk8s_train_checkpoint_save_duration_seconds").count(
+        kind="scheduled") == 1
+    assert metrics_mod.counter(
+        "tk8s_train_checkpoint_bytes_total").value(kind="scheduled") > 0
+    mgr.close()
+
+
+def test_async_save_finalized_by_idempotent_close(tmp_path):
+    """Satellite: a scheduled async save is not committed until close()
+    (or the next wait) writes its manifest; close is idempotent and an
+    atexit guard covers the forgot-to-close path."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(3, _fake_state(3), wait=False)
+    mgr.close()
+    assert (tmp_path / "ckpt" / "3" / MANIFEST_NAME).exists()
+    mgr.close()  # second close: no-op, no raise
+    with pytest.raises(Exception, match="closed"):
+        mgr.save(4, _fake_state(4))
+
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt2"))
+    mgr2.save(1, _fake_state(1), wait=False)
+    mgr2._atexit_guard()  # what atexit would run on process exit
+    assert (tmp_path / "ckpt2" / "1" / MANIFEST_NAME).exists()
+
+
+def _data_files(step_dir):
+    return [f for f in glob.glob(os.path.join(step_dir, "**"),
+                                 recursive=True)
+            if os.path.isfile(f) and not f.endswith(MANIFEST_NAME)]
+
+
+def _corrupt(step_dir, mode):
+    target = max(_data_files(step_dir), key=os.path.getsize)
+    if mode == "truncate":
+        with open(target, "r+b") as f:
+            f.truncate(max(os.path.getsize(target) // 2, 1))
+    elif mode == "bitflip":
+        with open(target, "r+b") as f:
+            f.seek(os.path.getsize(target) // 2)
+            byte = f.read(1)
+            f.seek(os.path.getsize(target) // 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+    else:
+        raise AssertionError(mode)
+    return target
+
+
+# -------------------------------------- corruption: quarantine + fallback
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+def test_corrupt_latest_quarantined_restore_falls_back(tmp_path, mode,
+                                                       fresh_registry):
+    """The corruption proof: truncating or bit-flipping the latest
+    checkpoint makes restore quarantine it (rename, not delete) and fall
+    back to the prior verified step automatically, with the verify-failure
+    counter incremented — no manual intervention."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, _fake_state(1, w=10.0), wait=True)
+    mgr.save(2, _fake_state(2, w=20.0), wait=True)
+    _corrupt(str(tmp_path / "ckpt" / "2"), mode)
+
+    restored = mgr.restore(_fake_state())
+    assert mgr.last_restored_step == 1
+    assert float(restored["w"]) == 10.0
+    # Quarantined, not deleted: the bad step moved aside whole.
+    quarantined = os.listdir(tmp_path / "ckpt" / "quarantine")
+    assert len(quarantined) == 1 and quarantined[0].startswith("2-")
+    assert mgr.all_steps() == [1]
+    reasons = {s["labels"]["reason"]: s["value"] for s in
+               metrics_mod.counter(
+                   "tk8s_train_checkpoint_verify_failures_total").samples()}
+    assert sum(reasons.values()) >= 1
+    assert metrics_mod.counter(
+        "tk8s_train_checkpoint_fallback_restores_total").value() == 1
+    mgr.close()
+
+
+def test_missing_manifest_means_uncommitted(tmp_path, fresh_registry):
+    """A step directory without a manifest is a save the process died
+    inside — never restored, quarantined on sight."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, _fake_state(1, w=1.0), wait=True)
+    mgr.save(2, _fake_state(2, w=2.0), wait=True)
+    os.remove(tmp_path / "ckpt" / "2" / MANIFEST_NAME)
+    with pytest.raises(CheckpointIntegrityError) as e:
+        mgr.verify_step(2)
+    assert e.value.reason == "missing-manifest"
+    restored = mgr.restore(_fake_state())
+    assert mgr.last_restored_step == 1 and float(restored["w"]) == 1.0
+    mgr.close()
+
+
+def test_all_steps_corrupt_is_typed_error(tmp_path, fresh_registry):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, _fake_state(1), wait=True)
+    _corrupt(str(tmp_path / "ckpt" / "1"), "bitflip")
+    with pytest.raises(CheckpointIntegrityError, match="no checkpoint"):
+        mgr.restore(_fake_state())
+    mgr.close()
+
+
+def test_corrupt_emergency_falls_back_to_scheduled_dir(tmp_path,
+                                                       fresh_registry):
+    """Cross-manager resume (the trainer's --resume path): a bit-rotted
+    emergency checkpoint is quarantined and resume lands on the newest
+    verified *scheduled* checkpoint in the other directory."""
+    sched = CheckpointManager(str(tmp_path / "ckpt"))
+    em = CheckpointManager(str(tmp_path / "emergency"))
+    sched.save(4, _fake_state(4, w=4.0), wait=True)
+    em.save(6, _fake_state(6, w=6.0), wait=True, kind="emergency")
+    _corrupt(str(tmp_path / "emergency" / "6"), "bitflip")
+
+    restored, best, step = restore_newest_verified(_fake_state(), sched, em)
+    assert best is sched and step == 4
+    assert float(restored["w"]) == 4.0
+    assert os.listdir(tmp_path / "emergency" / "quarantine")
+
+    # All-corrupt: a typed, loud error — never a silent fresh retrain.
+    _corrupt(str(tmp_path / "ckpt" / "4"), "truncate")
+    with pytest.raises(CheckpointIntegrityError, match="any directory"):
+        restore_newest_verified(_fake_state(), sched, em)
+    with pytest.raises(FileNotFoundError, match="no checkpoints"):
+        restore_newest_verified(_fake_state(), sched, em)
+    sched.close()
+    em.close()
+
+
+def test_torn_manifest_detected(tmp_path, fresh_registry):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, _fake_state(1), wait=True)
+    mpath = tmp_path / "ckpt" / "1" / MANIFEST_NAME
+    mpath.write_text(mpath.read_text()[:20])  # torn mid-write
+    with pytest.raises(CheckpointIntegrityError) as e:
+        mgr.verify_step(1)
+    assert e.value.reason == "torn-manifest"
+    mgr.close()
+
+
+# ------------------------------------------------- mesh-mismatch satellite
+
+def test_restore_mesh_mismatch_is_typed_and_actionable(tmp_path,
+                                                       cpu_mesh_devices):
+    """Satellite: resuming on a mesh whose device count doesn't divide
+    the saved sharding raises a typed, actionable error — not a raw
+    Orbax/XLA one."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("fsdp",))
+    state = {"w": jax.device_put(jnp.arange(64, dtype=jnp.float32),
+                                 NamedSharding(mesh4, P("fsdp")))}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, state, wait=True)
+
+    mesh3 = Mesh(np.array(jax.devices()[:3]), ("fsdp",))
+    target = {"w": jax.ShapeDtypeStruct(
+        (64,), jnp.float32, sharding=NamedSharding(mesh3, P("fsdp")))}
+    with pytest.raises(MeshMismatchError,
+                       match="must divide every sharded dimension"):
+        mgr.restore(target)
+    # The bad-mesh probe quarantined nothing: the checkpoint is intact
+    # and restores fine on a dividing mesh.
+    assert mgr.latest_verified_step() == 1
+    mgr.close()
+
+
+# -------------------------------------------------------- preemption guard
+
+def test_preemption_guard_real_sigterm_sets_flag():
+    guard = PreemptionGuard()
+    before = signal.getsignal(signal.SIGTERM)
+    with guard:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5.0
+        while not guard.requested and time.time() < deadline:
+            time.sleep(0.01)
+        assert guard.requested and guard.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) == before  # handlers restored
+
+
+def test_run_pipelined_should_stop_syncs_partial_window(cpu_mesh_devices,
+                                                        fresh_registry):
+    """The loop honors the stop flag between dispatches: the partial
+    window is synced (losses land) and the report says interrupted."""
+    import jax.numpy as jnp
+
+    from triton_kubernetes_tpu.models import get_config
+    from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh
+    from triton_kubernetes_tpu.train import (
+        init_state, make_optimizer, make_train_step, run_pipelined)
+    from triton_kubernetes_tpu.train.data import synthetic_batches
+
+    cfg = get_config("llama-test")
+    mesh = create_mesh(MeshConfig(fsdp=4, tensor=2))
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    step = make_train_step(cfg, mesh, opt)
+    state = init_state(cfg, mesh, opt)
+    gen = synthetic_batches(cfg.vocab_size, 4, 32)
+    batches = [{"tokens": jnp.asarray(next(gen)["tokens"])}
+               for _ in range(8)]
+    flag = {"stop": False}
+    done = []
+    state, report = run_pipelined(
+        step, state, batches, sync_every=3, max_steps=8,
+        on_sync=lambda n, st, losses, dt: (
+            done.append(n), flag.__setitem__("stop", n >= 3)),
+        should_stop=lambda: flag["stop"])
+    assert report.interrupted
+    assert report.steps == 3 and len(report.losses) == 3
+    assert int(state.step) == 3
+
+
+def test_run_resilient_preemption_emergency_save_then_resume(
+        tmp_path, fresh_registry):
+    """Kill-and-resume on the fake workload with a REAL signal: SIGTERM
+    lands mid-run, the loop force-syncs, an emergency checkpoint commits,
+    and a fresh run_resilient resumes to exactly the uninterrupted final
+    state."""
+    # Uninterrupted reference.
+    state, rep = run_resilient(
+        _fake_step(), _fake_state(), _fake_batches, target_step=10,
+        sync_every=2)
+    ref_w, ref_losses = float(state["w"]), rep.losses
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    guard = PreemptionGuard()
+    with guard:
+        def on_sync(gstep, st, losses, dt):
+            if gstep == 4:
+                os.kill(os.getpid(), signal.SIGTERM)
+        state, rep = run_resilient(
+            _fake_step(), _fake_state(), _fake_batches, ckpt=ckpt,
+            target_step=10, sync_every=2, preemption=guard, on_sync=on_sync)
+    assert rep.interrupted and rep.emergency_step == 4
+    assert rep.steps == 4
+    assert ckpt.latest_verified_step() == 4
+    assert metrics_mod.counter(
+        "tk8s_train_checkpoint_emergency_saves_total").value() == 1
+    # The manifest marks it as an emergency save.
+    manifest = json.loads(
+        (tmp_path / "ckpt" / "4" / MANIFEST_NAME).read_text())
+    assert manifest["kind"] == "emergency"
+
+    # Fresh "process": restore, then train the remaining steps.
+    restored = ckpt.restore(_fake_state())
+    start = int(restored["step"])
+    assert start == 4
+    state2, rep2 = run_resilient(
+        _fake_step(), restored, _fake_batches, ckpt=ckpt,
+        target_step=10, start_step=start, sync_every=2)
+    assert float(state2["w"]) == ref_w
+    assert rep.losses + rep2.losses == ref_losses
+    ckpt.close()
+
+
+def test_preemption_before_any_step_keeps_durable_checkpoint_intact(
+        tmp_path, fresh_registry):
+    """Regression: a warning that lands before any new step trains must
+    NOT rewrite (quarantine-and-resave) the checkpoint the run restored
+    from — inside the kill window that rewrite could destroy the only
+    durable copy. Skip the save; the on-disk step already IS the state."""
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    ckpt.save(4, _fake_state(4, w=4.0), wait=True)
+
+    guard = PreemptionGuard()
+    guard.trip()  # preempted before the loop dispatches anything
+    restored = ckpt.restore(_fake_state())
+    state, rep = run_resilient(
+        _fake_step(), restored, _fake_batches, ckpt=ckpt,
+        target_step=10, start_step=4, sync_every=2, preemption=guard)
+    assert rep.interrupted and rep.steps == 0
+    assert rep.emergency_step is None  # nothing new: no save, no rewrite
+    assert ckpt.all_steps() == [4]
+    assert not (tmp_path / "ckpt" / "quarantine").exists()
+    assert metrics_mod.counter(
+        "tk8s_train_checkpoint_emergency_saves_total").value() == 0
+    ckpt.close()
+
+
+def test_rollback_after_emergency_resume_stays_at_resume_point(
+        tmp_path, fresh_registry):
+    """Regression: resuming from an emergency checkpoint ahead of the
+    scheduled dir's newest step, a first-window anomaly must roll back to
+    the RESUME step (baseline-saved into the scheduled dir), not to the
+    stale older scheduled step — which would silently discard durable
+    progress and misalign the report."""
+    sched = CheckpointManager(str(tmp_path / "ckpt"))
+    sched.save(2, _fake_state(2, w=999.0), wait=True)  # stale, behind
+
+    glitch = {"armed": True}
+
+    def loss_for(step, x):
+        if step == 6 and glitch["armed"]:
+            glitch["armed"] = False
+            return float("nan")
+        return None
+
+    start = _fake_state(4, w=sum(range(1, 5)))  # "restored from emergency"
+    state, rep = run_resilient(
+        _fake_step(loss_for), start, _fake_batches, ckpt=sched,
+        target_step=8, start_step=4, sync_every=2, checkpoint_every=4,
+        guard=LossAnomalyGuard(factor=0.0), max_rollbacks=2)
+    assert rep.rollbacks == 1
+    assert rep.restored_steps == [4]  # never past the resume point
+    assert rep.steps == 4 and len(rep.losses) == 4
+    assert float(state["w"]) == sum(range(1, 9))
+    sched.close()
+
+def test_anomaly_guard_screens_nan_inf_and_spike():
+    guard = LossAnomalyGuard(factor=4.0, min_history=3)
+    assert guard.screen([1.0, 0.9, 1.1], 1) is None
+    hit = guard.screen([1.0, float("nan"), 0.9], 4)
+    assert isinstance(hit, Anomaly)
+    assert (hit.step, hit.reason) == (5, "non-finite")
+    assert guard.screen([float("inf")], 7).reason == "non-finite"
+    spike = guard.screen([1.05, 50.0], 8)
+    assert spike.reason == "spike" and spike.step == 9
+    assert spike.median == pytest.approx(1.0, abs=0.2)
+    # factor<=0 disables the spike rule but never the finite check.
+    lax = LossAnomalyGuard(factor=0.0, min_history=1)
+    assert lax.screen([1.0, 1e9], 1) is None
+    assert lax.screen([float("nan")], 3).reason == "non-finite"
+
+
+def test_transient_nan_rolls_back_and_continues(tmp_path, fresh_registry):
+    """A one-off NaN window rolls back to the last checkpoint, replays,
+    and the run completes with the exact uninterrupted final state."""
+    glitch = {"armed": True}
+
+    def loss_for(step, x):
+        if step == 6 and glitch["armed"]:
+            glitch["armed"] = False
+            return float("nan")
+        return None
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    state, rep = run_resilient(
+        _fake_step(loss_for), _fake_state(), _fake_batches, ckpt=ckpt,
+        target_step=10, sync_every=2, checkpoint_every=4,
+        guard=LossAnomalyGuard(factor=10.0, min_history=2), max_rollbacks=3)
+    assert rep.rollbacks == 1 and rep.restored_steps == [4]
+    assert rep.anomalies[0].reason == "non-finite"
+    assert rep.anomalies[0].step == 6
+    assert rep.steps == 10
+    assert float(state["w"]) == sum(range(1, 11))  # every batch exactly once
+    assert rep.losses == [pytest.approx(1.0 / s) for s in range(1, 11)]
+    assert metrics_mod.counter("tk8s_train_anomaly_rollbacks_total").value(
+        reason="non-finite") == 1
+    ckpt.close()
+
+
+def test_spike_rolls_back_too(tmp_path, fresh_registry):
+    glitch = {"armed": True}
+
+    def loss_for(step, x):
+        if step == 5 and glitch["armed"]:
+            glitch["armed"] = False
+            return 1000.0  # >> factor * median(1, 1/2, 1/3, 1/4)
+        return None
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    state, rep = run_resilient(
+        _fake_step(loss_for), _fake_state(), _fake_batches, ckpt=ckpt,
+        target_step=8, sync_every=2, checkpoint_every=2,
+        guard=LossAnomalyGuard(factor=10.0, min_history=2))
+    assert rep.rollbacks == 1 and rep.anomalies[0].reason == "spike"
+    assert rep.steps == 8 and float(state["w"]) == sum(range(1, 9))
+    ckpt.close()
+
+
+def test_persistent_anomaly_aborts_after_budget(tmp_path, fresh_registry):
+    """A NaN welded to a step aborts after max_rollbacks consecutive
+    trips with a typed error, instead of looping forever."""
+    def loss_for(step, x):
+        return float("nan") if step == 4 else None
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    with pytest.raises(AnomalyAbortedError, match="consecutive"):
+        run_resilient(
+            _fake_step(loss_for), _fake_state(), _fake_batches, ckpt=ckpt,
+            target_step=8, sync_every=2, checkpoint_every=2,
+            guard=LossAnomalyGuard(factor=0.0), max_rollbacks=2)
+    assert metrics_mod.counter("tk8s_train_anomaly_rollbacks_total").value(
+        reason="non-finite") == 2
+    assert metrics_mod.counter("tk8s_train_anomaly_aborts_total").value() == 1
+    ckpt.close()
+
+
+def test_persistent_anomaly_far_from_checkpoint_still_aborts(
+        tmp_path, fresh_registry):
+    """Regression (livelock): when the rollback target is more than one
+    window behind the anomaly, the replayed clean windows must NOT reset
+    the abort budget — a deterministic NaN aborts, never loops forever."""
+    def loss_for(step, x):
+        return float("nan") if step == 7 else None
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    with pytest.raises(AnomalyAbortedError, match="consecutive"):
+        run_resilient(
+            _fake_step(loss_for), _fake_state(), _fake_batches, ckpt=ckpt,
+            target_step=10, sync_every=2, checkpoint_every=4,
+            guard=LossAnomalyGuard(factor=0.0), max_rollbacks=2)
+    assert metrics_mod.counter("tk8s_train_anomaly_rollbacks_total").value(
+        reason="non-finite") == 2
+    ckpt.close()
+
+
+def test_resave_never_adopts_a_previous_runs_step(tmp_path, fresh_registry):
+    """Regression: a fresh run writing into a dirty checkpoint dir must
+    quarantine-and-replace a colliding committed step from the earlier
+    run, never silently adopt it (a later rollback would restore foreign
+    model state)."""
+    old = CheckpointManager(str(tmp_path / "ckpt"))
+    old.save(2, _fake_state(2, w=111.0), wait=True)
+    old.close()
+
+    fresh = CheckpointManager(str(tmp_path / "ckpt"))
+    fresh.save(2, _fake_state(2, w=222.0), wait=True)
+    restored = fresh.restore(_fake_state())
+    assert float(restored["w"]) == 222.0
+    assert any(d.startswith("2-superseded")
+               for d in os.listdir(tmp_path / "ckpt" / "quarantine"))
+    # Same-instance re-save (emergency landing on a scheduled boundary)
+    # is still the silent no-op it was designed to be.
+    fresh.save(2, _fake_state(2, w=333.0), wait=True, kind="emergency")
+    assert float(fresh.restore(_fake_state())["w"]) == 222.0
+    fresh.close()
+
+
+def test_skip_anomalous_window_routes_around_poison_batch(tmp_path,
+                                                          fresh_registry):
+    """A NaN welded to a *batch* completes under skip_anomalous_window:
+    the stream resumes after the offending window, the model state never
+    contains the poisoned update."""
+    def loss_for(step, x):
+        return float("nan") if x == 4.0 else None
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    state, rep = run_resilient(
+        _fake_step(loss_for), _fake_state(), _fake_batches, ckpt=ckpt,
+        target_step=8, sync_every=2, checkpoint_every=2,
+        guard=LossAnomalyGuard(factor=0.0), max_rollbacks=2,
+        skip_anomalous_window=True)
+    assert rep.rollbacks == 1
+    assert rep.steps == 8
+    # Batches 3,4 (the tripped window) were skipped; 5..10 trained instead.
+    assert float(state["w"]) == 1 + 2 + sum(range(5, 11))
+    ckpt.close()
+
+
+def test_two_skips_compound_the_stream_offset(tmp_path, fresh_registry):
+    """Regression: a second rollback after a skip must honor the offset
+    the first skip introduced. Poison batches 4 AND 9: the second trip's
+    window consumed data 9,10 (not 7,8 — the raw step indices), so the
+    skip must land the stream at 11, not back inside poisoned water."""
+    def loss_for(step, x):
+        return float("nan") if x in (4.0, 9.0) else None
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    state, rep = run_resilient(
+        _fake_step(loss_for), _fake_state(), _fake_batches, ckpt=ckpt,
+        target_step=8, sync_every=2, checkpoint_every=2,
+        guard=LossAnomalyGuard(factor=0.0), max_rollbacks=2,
+        skip_anomalous_window=True)
+    assert rep.rollbacks == 2 and rep.steps == 8
+    # steps 1,2 <- data 1,2; window (3,4) tripped+skipped; steps 3..6 <-
+    # data 5..8; window (9,10) tripped+skipped; steps 7,8 <- data 11,12.
+    assert float(state["w"]) == 1 + 2 + sum(range(5, 9)) + 11 + 12
+    ckpt.close()
+
+
+def test_cross_dir_resume_prefers_newest_verified_anywhere(tmp_path,
+                                                           fresh_registry):
+    """Regression: a torn emergency step must fall back to the other
+    directory's newer verified step, not to an older step in its own."""
+    sched = CheckpointManager(str(tmp_path / "ckpt"))
+    em = CheckpointManager(str(tmp_path / "emergency"))
+    em.save(5, _fake_state(5, w=5.0), wait=True, kind="emergency")
+    sched.save(10, _fake_state(10, w=10.0), wait=True)
+    em.save(12, _fake_state(12, w=12.0), wait=True, kind="emergency")
+    _corrupt(str(tmp_path / "emergency" / "12"), "bitflip")
+
+    restored, best, step = restore_newest_verified(_fake_state(), sched, em)
+    assert (best, step) == (sched, 10)
+    assert float(restored["w"]) == 10.0
+    assert em.all_steps() == [5]  # 12 quarantined, 5 untouched
+    sched.close()
+    em.close()
+
+
+def test_rollback_resets_guard_history():
+    """Regression: replayed windows must not enter the median history a
+    second time (duplicates would skew spike detection)."""
+    guard = LossAnomalyGuard(factor=4.0, min_history=2)
+    assert guard.screen([1.0, 1.1, 0.9, 1.0], 1) is None
+    assert len(guard._hist) == 4
+    guard.reset_history([1.0, 1.1])  # rollback kept only steps 1-2
+    assert list(guard._hist) == [1.0, 1.1]
+    # Replay screens the same window again: history stays duplicate-free
+    # relative to the accepted-loss list the driver maintains.
+    assert guard.screen([0.9, 1.0], 3) is None
+    assert list(guard._hist) == [1.0, 1.1, 0.9, 1.0]
+
+
+def test_guarded_clean_path_bitwise_identical_to_pipelined(
+        tmp_path, cpu_mesh_devices, fresh_registry):
+    """Acceptance: per-step losses on the non-tripping path are bitwise
+    identical to PR 3's pipelined loop — the guard adds one host-side
+    screen over already-fetched floats and nothing else."""
+    import jax.numpy as jnp
+
+    from triton_kubernetes_tpu.models import get_config
+    from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh
+    from triton_kubernetes_tpu.train import (
+        init_state, make_optimizer, make_train_step, run_pipelined)
+    from triton_kubernetes_tpu.train.data import synthetic_batches
+
+    cfg = get_config("llama-test")
+    mesh = create_mesh(MeshConfig(fsdp=4, tensor=2))
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    step = make_train_step(cfg, mesh, opt)
+    gen = synthetic_batches(cfg.vocab_size, 4, 32)
+    batches = [{"tokens": jnp.asarray(next(gen)["tokens"])}
+               for _ in range(6)]
+
+    state = init_state(cfg, mesh, opt)
+    state, ref = run_pipelined(step, state, list(batches), sync_every=2,
+                               max_steps=6)
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    state2 = init_state(cfg, mesh, opt)
+    state2, rep = run_resilient(
+        step, state2, lambda start: iter(batches[start:]), ckpt=ckpt,
+        target_step=6, sync_every=2, checkpoint_every=2,
+        guard=LossAnomalyGuard(factor=100.0, min_history=2))
+    assert rep.rollbacks == 0
+    assert rep.losses == ref.losses  # bitwise, no tolerance
+    ckpt.close()
+
+
+# --------------------------------------- the full loop through the trainer
+
+def _run_trainer(args, env_extra=None, timeout=240):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.update(env_extra or {})
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.Popen(
+        [sys.executable, "-m", "triton_kubernetes_tpu.train"] + args,
+        cwd=repo, env=env, stderr=subprocess.PIPE, stdout=subprocess.PIPE,
+        text=True)
+
+
+def _train_lines(err):
+    return [json.loads(l) for l in err.splitlines()
+            if l.startswith("{") and json.loads(l).get("msg") == "train"]
+
+
+@pytest.mark.slow
+def test_trainer_sigterm_kill_and_resume_matches_uninterrupted(tmp_path):
+    """The acceptance loop through real processes: the cloudsim
+    graceful-warning preemption delivers SIGTERM to a live trainer
+    mid-run -> the emergency checkpoint lands in --emergency-dir -> the
+    process exits with the resume code -> a fresh process resumes and its
+    post-restore losses match the uninterrupted run's (same tolerance
+    discipline as test_checkpoint_elastic_reshard_across_meshes; on the
+    *same* mesh the logged values are in fact identical)."""
+    from triton_kubernetes_tpu.executor.cloudsim import CloudSimulator
+    from triton_kubernetes_tpu.topology import (SliceSpec,
+                                                host_labels_for_slice)
+
+    common = ["--model", "llama-test", "--batch-size", "4",
+              "--seq-len", "16", "--fsdp", "4", "--tensor", "2",
+              "--steps", "400", "--sync-every", "2", "--log-every", "2",
+              "--json-logs"]
+    # Uninterrupted reference run.
+    ref = _run_trainer(common)
+    _, ref_err = ref.communicate(timeout=240)
+    assert ref.returncode == 0, ref_err
+    ref_losses = {l["step"]: l["loss"] for l in _train_lines(ref_err)}
+
+    ckpt_args = ["--checkpoint-dir", str(tmp_path / "ckpt"),
+                 "--checkpoint-every", "4",
+                 "--emergency-dir", str(tmp_path / "emergency")]
+    child = _run_trainer(common + ckpt_args)
+    try:
+        # The "cluster controller": a sim whose fault plan warns the
+        # trainer's pid, then reclaims the slice at the next tick.
+        sim = CloudSimulator()
+        sim.create_hosted_cluster("gke", "ml")
+        spec = SliceSpec.from_accelerator("v5e-16")
+        sim.create_node_pool("gke", "ml", "pool0", spec.num_hosts,
+                             node_labels=host_labels_for_slice(
+                                 spec, "ml-pool0"))
+        from triton_kubernetes_tpu.executor.cloudsim import FaultPlan
+        sim.fault_plan = FaultPlan({"faults": [
+            {"op": "preempt", "slice_id": "ml-pool0",
+             "at_op": sim.ops + 1, "mode": "graceful-warning",
+             "notify_pid": child.pid, "grace_ops": 1}]})
+        # Let the trainer get past compile into real steps, then tick the
+        # mutation clock: warning (SIGTERM to the child), then reclaim.
+        deadline = time.time() + 240
+        while time.time() < deadline and child.poll() is None:
+            time.sleep(0.2)
+            if (tmp_path / "ckpt" / "4").exists():
+                break
+        assert child.poll() is None, child.communicate()[1]
+        sim.create_resource("net", "a")   # tick -> SIGTERM delivered
+        sim.create_resource("net", "b")   # tick -> slice reclaimed
+        assert list(sim.preempted_slices()) == ["ml-pool0"]
+        _, err = child.communicate(timeout=240)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    assert child.returncode == EXIT_RESUME, err
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    assert any(l["msg"] == "emergency checkpoint saved" for l in lines), err
+    # The emergency checkpoint committed (manifest present) in the
+    # emergency dir; interrupted-run losses already match the reference.
+    em_steps = [d for d in os.listdir(tmp_path / "emergency")
+                if d.isdigit()]
+    assert em_steps
+    assert (tmp_path / "emergency" / em_steps[0] / MANIFEST_NAME).exists()
+    # SIGTERM can force-sync a partial window at a step the reference
+    # never synced at — compare the steps both runs logged.
+    pre = [l for l in _train_lines(err) if l["step"] in ref_losses]
+    assert pre
+    for l in pre:
+        assert l["loss"] == ref_losses[l["step"]], (l, err[-500:])
+
+    # Fresh process: resumes (emergency dir considered) and the
+    # post-restore losses match the uninterrupted run's.
+    resumed = _run_trainer(common + ckpt_args + ["--resume"])
+    _, err2 = resumed.communicate(timeout=240)
+    assert resumed.returncode == 0, err2
+    lines2 = [json.loads(l) for l in err2.splitlines() if l.startswith("{")]
+    resumed_at = [l for l in lines2 if l["msg"] == "resumed"]
+    assert resumed_at and resumed_at[0]["step"] >= 4
+    post = _train_lines(err2)
+    assert post and post[-1]["step"] == 400
+    # Windows realign only at steps both runs synced (resume may start on
+    # an odd step); the final step is always common. Same mesh: identical.
+    overlap = [l for l in post if l["step"] in ref_losses]
+    assert any(l["step"] == 400 for l in overlap)
+    for l in overlap:
+        np.testing.assert_allclose(l["loss"], ref_losses[l["step"]],
+                                   rtol=1e-5)

@@ -125,6 +125,38 @@ def test_prefetch_propagates_producer_errors():
             next(pf)
 
 
+@pytest.mark.parametrize("threaded", [True, False])
+def test_prefetch_producer_error_chains_real_cause(threaded):
+    """A mid-stream producer exception surfaces AFTER the already-staged
+    batches, as PrefetchProducerError with the original exception chained
+    (`raise ... from`) — the generator frame that blew up stays visible
+    even when it died on the background thread."""
+    from triton_kubernetes_tpu.train.data import PrefetchProducerError
+
+    boom = ValueError("shard 7 has 3 trailing bytes")
+
+    def bad_source():
+        for b in _host_batches(3):
+            yield b
+        raise boom
+
+    pf = DevicePrefetch(bad_source(), buffer_size=2, threaded=threaded)
+    got = [next(pf) for _ in range(3)]  # staged batches delivered first
+    assert len(got) == 3
+    with pytest.raises(PrefetchProducerError,
+                       match="3 trailing bytes") as excinfo:
+        next(pf)
+    assert excinfo.value.__cause__ is boom
+    # The real cause's traceback survives the thread/queue boundary.
+    assert boom.__traceback__ is not None
+    frames = []
+    tb = boom.__traceback__
+    while tb is not None:
+        frames.append(tb.tb_frame.f_code.co_name)
+        tb = tb.tb_next
+    assert "bad_source" in frames
+
+
 def test_prefetch_rejects_bad_buffer_size():
     with pytest.raises(ValueError, match="buffer_size"):
         DevicePrefetch(iter([]), buffer_size=0)

@@ -95,6 +95,35 @@ def test_jobset_render_multihost():
     assert svc["spec"]["clusterIP"] is None or svc["spec"]["clusterIP"] == "None"
 
 
+def test_jobset_resume_exit_code_restarts_not_fails():
+    """The resilience contract end to end: the trainer's EXIT_RESUME and
+    the Job's podFailurePolicy agree, a 75-exit (or a disruption) is
+    Ignored (pod recreated, not counted), and every other exit still
+    fails the job fast."""
+    from triton_kubernetes_tpu.topology.jobset import RESUME_EXIT_CODE
+    from triton_kubernetes_tpu.train.resilience import EXIT_RESUME
+
+    assert RESUME_EXIT_CODE == EXIT_RESUME
+    spec = SliceSpec.from_accelerator("v5e-16")
+    job = render_jobset("train", spec, "s0", image="img",
+                        command=["python", "-m",
+                                 "triton_kubernetes_tpu.train", "--resume"])
+    rules = job["spec"]["podFailurePolicy"]["rules"]
+    ignore_codes = [r for r in rules if r["action"] == "Ignore"
+                    and "onExitCodes" in r]
+    assert ignore_codes and ignore_codes[0]["onExitCodes"]["values"] == [
+        RESUME_EXIT_CODE]
+    assert ignore_codes[0]["onExitCodes"]["containerName"] == "worker"
+    assert any(r["action"] == "Ignore" and "onPodConditions" in r
+               for r in rules)
+    fail = [r for r in rules if r["action"] == "FailJob"]
+    assert fail and fail[0]["onExitCodes"]["operator"] == "NotIn"
+    # podFailurePolicy requires restartPolicy Never, and it validates.
+    assert job["spec"]["template"]["spec"]["restartPolicy"] == "Never"
+    from triton_kubernetes_tpu.topology.validate import validate_manifest
+    validate_manifest(job)
+
+
 def test_peak_flops_table_sane():
     for gen in TPU_GENERATIONS.values():
         assert gen.peak_bf16_tflops > 100

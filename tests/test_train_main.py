@@ -94,6 +94,41 @@ def test_profile_dir_traces_single_window_run(cpu_mesh_devices, tmp_path,
     assert (tmp_path / "prof").exists()
 
 
+def test_zero_step_run_reports_na_not_nan(cpu_mesh_devices, capsys):
+    """Satellite: before the first sync there is no loss — the done log
+    says "n/a" instead of feeding dashboards a fake NaN datapoint."""
+    rc, err = _run(capsys, [
+        "--model", "llama-test", "--steps", "0", "--batch-size", "4",
+        "--seq-len", "16", "--fsdp", "4", "--tensor", "2", "--json-logs"])
+    assert rc == 0
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    done = [l for l in lines if l["msg"] == "trainer done"]
+    assert done and done[0]["final_loss"] == "n/a"
+
+
+def test_anomaly_and_emergency_flags_clean_run(cpu_mesh_devices, tmp_path,
+                                               capsys):
+    """--anomaly-factor/--max-rollbacks/--emergency-dir wired end to end:
+    a clean run under the guard trains normally (no rollbacks), and a
+    later --resume consults the (empty) emergency dir without tripping."""
+    ckpt = tmp_path / "ckpt"
+    common = [
+        "--model", "llama-test", "--batch-size", "4", "--seq-len", "16",
+        "--fsdp", "4", "--tensor", "2", "--checkpoint-dir", str(ckpt),
+        "--checkpoint-every", "2", "--emergency-dir",
+        str(tmp_path / "emergency"), "--anomaly-factor", "25",
+        "--max-rollbacks", "2", "--log-every", "1", "--json-logs"]
+    rc, err = _run(capsys, common + ["--steps", "2"])
+    assert rc == 0
+    rc, err = _run(capsys, common + ["--steps", "4", "--resume"])
+    assert rc == 0
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    assert any(l["msg"] == "resumed" and l["step"] == 2
+               and l["emergency"] is False for l in lines)
+    train = [l for l in lines if l["msg"] == "train"]
+    assert train[-1]["step"] == 4 and np.isfinite(train[-1]["loss"])
+
+
 def test_bad_batch_divisibility(cpu_mesh_devices, capsys):
     rc, _ = _run(capsys, [
         "--model", "llama-test", "--steps", "1", "--batch-size", "3",

@@ -24,6 +24,8 @@ It models, deterministically (no wall clock, no randomness):
 from __future__ import annotations
 
 import hashlib
+import os
+import signal as _signal
 from typing import Any, Dict, List, Optional
 
 from ..manager import protocol
@@ -73,6 +75,12 @@ class FaultPlan:
            "kind": "fatal", "error": "quota exceeded"},
           # Preempt a named TPU slice when the mutation clock reaches 7:
           {"op": "preempt", "slice_id": "ml-pool0", "at_op": 7},
+          # Graceful-warning preemption: deliver the GKE-style SIGTERM
+          # to the trainer process at the warning tick, reclaim the
+          # slice grace_ops mutations later (0 = same tick):
+          {"op": "preempt", "slice_id": "ml-pool0", "at_op": 7,
+           "mode": "graceful-warning", "notify_pid": 12345,
+           "signal": "SIGTERM", "grace_ops": 3},
         ]}
 
     ``match`` values substring-match the operation's info fields (type,
@@ -107,8 +115,23 @@ class FaultPlan:
         mutation clock has already ticked). Fires due preemptions, then
         raises if an armed fault rule matches this call."""
         for rule in self.rules:
-            if (rule.get("op") == "preempt" and not rule["fired"]
-                    and sim.ops >= int(rule.get("at_op", 0))):
+            if rule.get("op") != "preempt" or rule["fired"]:
+                continue
+            at = int(rule.get("at_op", 0))
+            if rule.get("mode") == "graceful-warning":
+                # The GKE contract: SIGTERM lands first, the reclaim
+                # follows after the grace window. Both anchors are
+                # mutation-clock ticks, so the sequence is deterministic
+                # and the warned/fired flags serialize with the state.
+                if not rule.get("warned") and sim.ops >= at:
+                    rule["warned"] = 1
+                    sim.warn_preemption(rule["slice_id"],
+                                        pid=rule.get("notify_pid"),
+                                        sig=rule.get("signal", "SIGTERM"))
+                if sim.ops >= at + int(rule.get("grace_ops", 0)):
+                    rule["fired"] = 1
+                    sim.preempt_slice(rule["slice_id"])
+            elif sim.ops >= at:
                 rule["fired"] = 1
                 sim.preempt_slice(rule["slice_id"])
         for rule in self.rules:
@@ -404,6 +427,31 @@ class CloudSimulator:
                         for n in pool.get("nodes", []))
                         or f"{rec.get('name')}-{pool_name}" == slice_id):
                     yield rec, pool
+
+    def warn_preemption(self, slice_id: str, pid: Optional[int] = None,
+                        sig: Any = "SIGTERM") -> List[str]:
+        """Graceful preemption warning: GKE sends the workload SIGTERM
+        ~30s before reclaiming a TPU slice (the JobSet termination grace
+        period). The simulator analog marks the slice's pool
+        ``preempt_warning`` and — when ``pid`` names a live trainer
+        process — delivers the real signal, so integration tests drive
+        the trainer's preemption-aware emergency-checkpoint path with an
+        actual SIGTERM, not a mock. Like :meth:`preempt_slice`, this IS
+        the fault event: no clock tick, no fault-plan re-entry."""
+        hit: List[str] = []
+        for _, pool in self._slice_pools(slice_id):
+            pool["preempt_warning"] = True
+            hit.extend(n["name"] for n in pool.get("nodes", []))
+        if not hit:
+            raise CloudSimError(f"no node pool carries slice {slice_id!r}")
+        metrics.counter("tk8s_cloudsim_preempt_warnings_total").inc()
+        if pid:
+            signum = getattr(_signal, sig) if isinstance(sig, str) else sig
+            try:
+                os.kill(int(pid), signum)
+            except ProcessLookupError:
+                pass  # workload already gone; the warning outlived it
+        return hit
 
     def preempt_slice(self, slice_id: str) -> List[str]:
         """Preempt a TPU slice: every host VM in its node pool is

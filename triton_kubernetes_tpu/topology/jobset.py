@@ -19,6 +19,13 @@ from .slices import SliceSpec
 
 COORDINATOR_PORT = 8476
 
+# The trainer's "resume me" exit code (train/resilience.py EXIT_RESUME,
+# duplicated here so rendering never imports the jax-loaded train package;
+# pinned equal in tests/test_topology.py). A preemption-warned worker
+# saves an emergency checkpoint and exits with this code; the Job's
+# podFailurePolicy recreates the pod instead of failing the job.
+RESUME_EXIT_CODE = 75
+
 
 def render_headless_service(name: str, namespace: str = "default") -> Dict[str, Any]:
     return {
@@ -85,7 +92,28 @@ def render_jobset(
             "completions": n,
             "parallelism": n,
             "completionMode": "Indexed",
+            # Real failures still fail fast (the FailJob rule below is the
+            # old backoffLimit: 0 behavior); what must NOT count as
+            # failure is the resilience protocol: a preemption-warned
+            # worker exiting RESUME_EXIT_CODE after its emergency
+            # checkpoint, or the pod being disrupted outright (node
+            # drain, spot reclaim) — those recreate the pod, which
+            # resumes from the newest verified checkpoint (the command
+            # must pass --resume; docs/guide/fault-tolerance.md §5).
             "backoffLimit": 0,
+            "podFailurePolicy": {"rules": [
+                {"action": "Ignore",
+                 "onExitCodes": {"containerName": "worker",
+                                 "operator": "In",
+                                 "values": [RESUME_EXIT_CODE]}},
+                {"action": "Ignore",
+                 "onPodConditions": [{"type": "DisruptionTarget",
+                                      "status": "True"}]},
+                {"action": "FailJob",
+                 "onExitCodes": {"containerName": "worker",
+                                 "operator": "NotIn",
+                                 "values": [RESUME_EXIT_CODE]}},
+            ]},
             "template": {
                 "metadata": {"labels": {"jobset.tk8s.io/name": name}},
                 "spec": {

@@ -157,6 +157,43 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
                     "parallelism": {"type": "integer", "minimum": 0},
                     "completionMode": {"enum": ["NonIndexed", "Indexed"]},
                     "backoffLimit": {"type": "integer", "minimum": 0},
+                    "podFailurePolicy": {
+                        "type": "object",
+                        "required": ["rules"],
+                        "properties": {"rules": {
+                            "type": "array",
+                            "items": {
+                                "type": "object",
+                                "required": ["action"],
+                                "properties": {
+                                    "action": {"enum": [
+                                        "Ignore", "FailJob", "Count",
+                                        "FailIndex"]},
+                                    "onExitCodes": {
+                                        "type": "object",
+                                        "required": ["operator", "values"],
+                                        "properties": {
+                                            "containerName": {
+                                                "type": "string"},
+                                            "operator": {"enum": [
+                                                "In", "NotIn"]},
+                                            "values": {
+                                                "type": "array",
+                                                "items": {
+                                                    "type": "integer"}},
+                                        },
+                                    },
+                                    "onPodConditions": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": ["type"],
+                                        },
+                                    },
+                                },
+                            },
+                        }},
+                    },
                 },
             },
         },

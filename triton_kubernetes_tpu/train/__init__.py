@@ -6,9 +6,23 @@ package provides the trainer those jobs run: sharded train step, MFU
 accounting, data pipeline, and orbax checkpointing.
 """
 
-from .data import DevicePrefetch
+from .checkpoint import (
+    CheckpointIntegrityError,
+    CheckpointManager,
+    MeshMismatchError,
+    restore_newest_verified,
+)
+from .data import DevicePrefetch, PrefetchProducerError
 from .mfu import flops_per_token, mfu, tokens_per_sec_for_mfu
 from .pipeline import LoopReport, run_pipelined
+from .resilience import (
+    EXIT_RESUME,
+    AnomalyAbortedError,
+    LossAnomalyGuard,
+    PreemptionGuard,
+    ResilienceReport,
+    run_resilient,
+)
 from .trainer import (
     CompileTimings,
     TrainState,
@@ -27,9 +41,20 @@ __all__ = [
     "make_optimizer",
     "make_train_step",
     "init_state",
+    "CheckpointManager",
+    "CheckpointIntegrityError",
+    "MeshMismatchError",
+    "restore_newest_verified",
     "DevicePrefetch",
+    "PrefetchProducerError",
     "LoopReport",
     "run_pipelined",
+    "EXIT_RESUME",
+    "AnomalyAbortedError",
+    "LossAnomalyGuard",
+    "PreemptionGuard",
+    "ResilienceReport",
+    "run_resilient",
     "CompileTimings",
     "aot_compile_step",
     "enable_compile_cache",

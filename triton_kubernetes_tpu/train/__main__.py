@@ -11,14 +11,22 @@ automatically. Data comes from the native sharded token pipeline when
 ``--data-dir`` is given (falls back to the pure-Python reader), else from
 the synthetic Markov generator, so the entrypoint always has something to
 train on — the BASELINE "cluster-up then train" gates assume that.
+
+The loop itself is the resilient one (train/resilience.py): SIGTERM (the
+GKE preemption warning) force-syncs the window, writes a synchronous
+emergency checkpoint, and exits with code 75 so the JobSet restart policy
+resumes instead of fails; restores are manifest-verified with automatic
+fallback past corrupt steps; and ``--anomaly-factor`` arms a loss guard
+that rolls back to the last good checkpoint instead of training through a
+NaN.
 """
 
 from __future__ import annotations
 
 import argparse
+import itertools
 import os
 import sys
-import time
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -52,6 +60,21 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="steps between saves (0 = only at the end)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--emergency-dir", default="",
+                   help="directory for preemption emergency checkpoints "
+                        "(default: the checkpoint dir); --resume considers "
+                        "both and restores the newest verified step")
+    p.add_argument("--anomaly-factor", type=float, default=0.0,
+                   help="loss-anomaly guard: roll back to the last good "
+                        "checkpoint when a synced loss exceeds this factor "
+                        "times the running median (NaN/Inf always trip); "
+                        "0 disables the guard")
+    p.add_argument("--max-rollbacks", type=int, default=3,
+                   help="abort (exit 4) after this many consecutive "
+                        "anomaly rollbacks without a clean window")
+    p.add_argument("--skip-anomalous-window", action="store_true",
+                   help="on anomaly rollback, resume the data stream after "
+                        "the offending window instead of replaying it")
     p.add_argument("--model-opt", action="append", default=[],
                    metavar="K=V",
                    help="ModelConfig override, repeatable (e.g. "
@@ -196,16 +219,43 @@ def main(argv=None) -> int:
         config, mesh, opt, attention_fn=attention_fn,
         microbatches=args.microbatches)
 
+    from .checkpoint import CheckpointManager
+    from .resilience import (
+        EXIT_RESUME, AnomalyAbortedError, LossAnomalyGuard, PreemptionGuard,
+        run_resilient)
+
     ckpt = None
+    em_ckpt = None
     if args.checkpoint_dir:
-        from .checkpoint import CheckpointManager
-
         ckpt = CheckpointManager(args.checkpoint_dir)
-        if args.resume and ckpt.latest_step() is not None:
-            state = ckpt.restore(state)
-            log.log("info", "resumed", step=int(state.step))
+    if args.emergency_dir and (
+            ckpt is None
+            or os.path.abspath(args.emergency_dir) != ckpt.directory):
+        # Path-normalized: two orbax managers on one directory would race
+        # each other's GC/finalize and double-list every resume candidate.
+        em_ckpt = CheckpointManager(args.emergency_dir)
+    start_is_checkpointed = False
+    if args.resume and (ckpt is not None or em_ckpt is not None):
+        # The newest *verified* step wins, scheduled or emergency — a torn
+        # emergency save is quarantined and resume falls back to the last
+        # scheduled checkpoint automatically. All-corrupt is a typed,
+        # loud CheckpointIntegrityError, not a silent retrain.
+        from .checkpoint import restore_newest_verified
 
-    gen = _batches(args, config, batch_size, seq_len)
+        try:
+            state, best, best_step = restore_newest_verified(
+                state, ckpt, em_ckpt)
+        except FileNotFoundError:
+            pass  # nothing saved yet: a fresh start under --resume is fine
+        else:
+            # The restored step was just verified end-to-end; when it
+            # lives in the scheduled dir, the guard's baseline check can
+            # skip re-hashing it.
+            start_is_checkpointed = best is ckpt
+            log.log("info", "resumed", step=int(state.step),
+                    source=best.directory,
+                    emergency=best is em_ckpt)
+
     fpt = flops_per_token(config, seq_len)
     from ..topology.slices import peak_bf16_tflops_for_kind
 
@@ -214,57 +264,53 @@ def main(argv=None) -> int:
         jax.devices()[0].device_kind) * n_devices
 
     start_step = int(state.step)
-    if start_step:
-        # Resume: advance the data stream past what the checkpointed run
-        # consumed so no batch is trained twice.
-        log.log("info", "skipping consumed batches", count=start_step)
-        for _ in range(start_step):
-            next(gen)
     tokens_per_step = batch_size * seq_len
-    last_loss = float("nan")
+    last_loss = None  # None until the first sync: never log a fake NaN
     tracing = False
     max_steps = max(args.steps - start_step, 0)
     if args.dry_run:
         max_steps = min(max_steps, 1)
+    target_step = start_step + max_steps
     sync_every = 1 if args.dry_run else \
         max(args.sync_every or args.log_every, 1)
-    # Checkpoints happen at sync points; force an extra sync exactly at
-    # every configured multiple (windows split there — the requested
-    # sync_every cadence is preserved everywhere else, and resume from a
-    # non-aligned step keeps the absolute multiples).
-    force_sync = None
-    if args.checkpoint_every and args.checkpoint_dir:
-        force_sync = lambda done: \
-            (start_step + done) % args.checkpoint_every == 0
 
     # Step-pipelined hot path (train/pipeline.py): steps dispatch back to
     # back with the next batch's host->device transfer already in flight
     # (DevicePrefetch) and ONE host sync per window — never one per step.
+    # The resilient driver (train/resilience.py) rebuilds this stream at a
+    # rolled-back step by deterministic replay: same source, same seed,
+    # skip to the step index.
     from .data import DevicePrefetch
-    from .pipeline import run_pipelined
     from .trainer import batch_spec
     from jax.sharding import NamedSharding
 
-    host_batches = ({"tokens": b["tokens"]} for b in gen)
-    # device_put with a mesh sharding needs the whole array addressable;
-    # multi-host slices keep the historical feed (jit stages per step).
-    prefetch = None
-    if args.prefetch > 0 and jax.process_count() == 1 and max_steps:
-        prefetch = DevicePrefetch(
-            host_batches, sharding=NamedSharding(mesh, batch_spec()),
-            buffer_size=args.prefetch)
-    batches = prefetch if prefetch is not None else host_batches
+    def make_batches(start: int):
+        gen = _batches(args, config, batch_size, seq_len)
+        if start:
+            log.log("info", "skipping consumed batches", count=start)
+            for _ in range(start):
+                next(gen)
+        host = ({"tokens": b["tokens"]} for b in gen)
+        # device_put with a mesh sharding needs the whole array
+        # addressable; multi-host slices keep the historical feed (jit
+        # stages per step).
+        if args.prefetch > 0 and jax.process_count() == 1:
+            pf = DevicePrefetch(
+                host, sharding=NamedSharding(mesh, batch_spec()),
+                buffer_size=args.prefetch)
+            return pf, pf
+        return host, None
 
-    timings = None
+    first_iter, first_pf = (None, None)
     if max_steps:
         # AOT compile against the exact first batch: the compile cost is
         # measured and attributed (lower vs XLA) instead of silently
         # diluting the first window, and the loop cannot retrace.
-        import itertools
-
-        first = next(batches, None)
+        first_iter, first_pf = make_batches(start_step)
+        first = next(first_iter, None)
         if first is None:
             max_steps = 0
+            target_step = start_step
         else:
             step_fn, timings = aot_compile_step(
                 step_fn, state, first, config_name=config.name)
@@ -272,14 +318,20 @@ def main(argv=None) -> int:
                     lower_s=round(timings.lower_seconds, 3),
                     compile_s=round(timings.compile_seconds, 3),
                     cache_dir=timings.cache_dir or "")
-            batches = itertools.chain([first], batches)
+            first_iter = itertools.chain([first], first_iter)
+    holder = {"it": first_iter, "pf": first_pf}
 
-    last_ckpt_mark = start_step // args.checkpoint_every \
-        if args.checkpoint_every else 0
+    def batches_factory(pos: int):
+        if holder["it"] is not None and pos == start_step:
+            out = (holder["it"], holder["pf"])
+            holder["it"] = None
+            return out
+        it, pf = make_batches(pos)
+        holder["pf"] = pf  # keep on_sync's wait accounting on the live one
+        return it, pf
 
-    def on_sync(done, cur_state, window_losses, window_dt):
-        nonlocal last_loss, last_ckpt_mark
-        gstep = start_step + done
+    def on_sync(gstep, cur_state, window_losses, window_dt):
+        nonlocal last_loss
         last_loss = window_losses[-1]
         tps = tokens_per_step * len(window_losses) / max(window_dt, 1e-9)
         fields = dict(step=gstep, loss=round(last_loss, 4),
@@ -287,16 +339,25 @@ def main(argv=None) -> int:
                       tflops=round(tps * fpt / 1e12, 2))
         if peak:
             fields["mfu"] = round(compute_mfu(tps, config, seq_len, peak), 4)
-        if prefetch is not None:
-            fields["prefetch_wait_s"] = round(prefetch.wait_seconds, 4)
+        if holder["pf"] is not None:
+            fields["prefetch_wait_s"] = round(holder["pf"].wait_seconds, 4)
         log.log("info", "train", **fields)
-        if ckpt and args.checkpoint_every:
-            mark = gstep // args.checkpoint_every
-            if mark > last_ckpt_mark:
-                last_ckpt_mark = mark
-                ckpt.save(gstep, cur_state)
-                log.log("info", "checkpoint saved", step=gstep)
 
+    def on_checkpoint(gstep, kind):
+        msg = ("emergency checkpoint saved" if kind == "emergency"
+               else "checkpoint saved")
+        log.log("info" if kind != "emergency" else "warn", msg, step=gstep)
+
+    guard = (LossAnomalyGuard(factor=args.anomaly_factor)
+             if args.anomaly_factor > 0 else None)
+    preempt = PreemptionGuard()
+    try:
+        preempt.install()
+    except ValueError:  # not the main thread (embedded run): unguarded
+        preempt = None
+
+    report = None
+    aborted = None
     try:
         if max_steps:
             if args.profile_dir and not args.dry_run:
@@ -306,17 +367,36 @@ def main(argv=None) -> int:
                 jax.profiler.start_trace(args.profile_dir)
                 tracing = True
                 log.log("info", "profiler tracing", dir=args.profile_dir)
-            state, report = run_pipelined(
-                step_fn, state, batches, sync_every=sync_every,
-                max_steps=max_steps, tokens_per_step=tokens_per_step,
-                config_name=config.name, on_sync=on_sync,
-                force_sync=force_sync, prefetch=prefetch)
-            if report.steps < max_steps:
-                log.log("warn", "data exhausted before requested steps",
-                        done=start_step + report.steps, want=args.steps)
+            try:
+                state, report = run_resilient(
+                    step_fn, state, batches_factory,
+                    ckpt=ckpt, emergency_ckpt=em_ckpt or ckpt,
+                    target_step=target_step, start_step=start_step,
+                    sync_every=sync_every,
+                    checkpoint_every=(args.checkpoint_every if ckpt else 0),
+                    guard=guard, max_rollbacks=args.max_rollbacks,
+                    skip_anomalous_window=args.skip_anomalous_window,
+                    start_is_checkpointed=start_is_checkpointed,
+                    preemption=preempt,
+                    tokens_per_step=tokens_per_step,
+                    config_name=config.name,
+                    on_sync=on_sync, on_checkpoint=on_checkpoint)
+            except AnomalyAbortedError as e:
+                aborted = e
+                log.log("error", "anomaly guard aborted the run",
+                        error=str(e), step=e.anomaly.step,
+                        reason=e.anomaly.reason)
+            else:
+                if report.rollbacks:
+                    log.log("warn", "anomaly rollbacks taken",
+                            rollbacks=report.rollbacks,
+                            restored_steps=report.restored_steps)
+                if (report.steps < max_steps and not report.interrupted):
+                    log.log("warn", "data exhausted before requested steps",
+                            done=start_step + report.steps, want=args.steps)
     finally:
-        if prefetch is not None:
-            prefetch.close()
+        if holder["it"] is not None and holder["pf"] is not None:
+            holder["pf"].close()
         if tracing:
             # try/finally: the trace matters MOST when the run dies (OOM,
             # interrupt) — sync so it holds completed device work, then
@@ -329,12 +409,41 @@ def main(argv=None) -> int:
                 pass
             jax.profiler.stop_trace()
             log.log("info", "profiler trace written", dir=args.profile_dir)
+        if preempt is not None:
+            preempt.uninstall()
+
+    final_loss = round(last_loss, 4) if last_loss is not None else "n/a"
+    if aborted is not None:
+        # The state tree was donated into the failed window: do not touch
+        # it (no final save) — the last good checkpoint is the artifact.
+        for mgr in (ckpt, em_ckpt):
+            if mgr is not None:
+                mgr.close()
+        log.log("info", "trainer done", final_loss=final_loss,
+                outcome="anomaly-abort")
+        return 4
+    if report is not None and report.interrupted:
+        # Preemption warning honored: the emergency checkpoint (manifest-
+        # committed) is on disk; exit with the resume code so the JobSet
+        # restart policy relaunches with --resume instead of failing.
+        for mgr in (ckpt, em_ckpt):
+            if mgr is not None:
+                mgr.close()
+        log.log("warn", "trainer preempted; exiting for resume",
+                step=start_step + report.steps,
+                emergency_step=report.emergency_step,
+                exit_code=EXIT_RESUME)
+        log.log("info", "trainer done", final_loss=final_loss,
+                outcome="preempted")
+        return EXIT_RESUME
     if ckpt:
         if ckpt.latest_step() != int(state.step):
-            ckpt.save(int(state.step), state, wait=True)
+            ckpt.save(int(state.step), state, wait=True, kind="final")
             log.log("info", "final checkpoint", step=int(state.step))
         ckpt.close()
-    log.log("info", "trainer done", final_loss=round(last_loss, 4))
+    if em_ckpt is not None:
+        em_ckpt.close()
+    log.log("info", "trainer done", final_loss=final_loss)
     return 0
 
 

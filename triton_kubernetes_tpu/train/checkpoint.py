@@ -1,4 +1,4 @@
-"""Orbax checkpointing for train state.
+"""Orbax checkpointing for train state, hardened for preemption.
 
 The reference's only checkpoint/backup story is Heptio Ark over the whole
 cluster (SURVEY.md §5) and the provisioning doc itself; workload-level
@@ -16,42 +16,423 @@ save on 4 devices fsdp=4, resume on fsdp=2×tensor=2 and on 8-device
 fsdp=8; training continues numerically identically (post-restore loss
 matches the uninterrupted run to 1e-5 — cross-layout reduction orders
 preclude bitwise claims).
+
+Restore is also **integrity-verified**: every committed save carries a
+sidecar ``manifest.json`` inside its step directory — per-leaf tree
+structure (path, shape, dtype), per-file sizes and SHA-256 content
+checksums, and a whole-manifest digest. The manifest is written *after*
+orbax finishes the step (atomic tmp+rename, fsync'd), so its presence is
+the commit marker: a step without one is a save the process died inside.
+``restore`` verifies the newest candidate first and, on a torn,
+truncated, or bit-rotted step, **quarantines** it (rename into
+``quarantine/``, never delete — it is postmortem evidence) and falls back
+to the newest earlier step that verifies, automatically. Verification
+failures and fallbacks are counted in the ``tk8s_train_checkpoint_*``
+metric families (utils/metrics.py CATALOG).
 """
 
 from __future__ import annotations
 
+import atexit
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
+from ..utils import metrics as _metrics
+
+MANIFEST_NAME = "manifest.json"
+QUARANTINE_DIR = "quarantine"
+
+
+class CheckpointError(RuntimeError):
+    """Base type for checkpoint-subsystem failures."""
+
+
+class CheckpointIntegrityError(CheckpointError):
+    """A step failed manifest verification (uncommitted save, torn
+    manifest, truncated or bit-flipped file). ``reason`` is the bounded
+    machine-readable label fed to
+    ``tk8s_train_checkpoint_verify_failures_total``."""
+
+    def __init__(self, message: str, reason: str = "corrupt"):
+        super().__init__(message)
+        self.reason = reason
+
+
+class MeshMismatchError(CheckpointError):
+    """The restore-target mesh cannot hold the saved arrays: some mesh
+    axis product does not divide a sharded dimension. Raised *before*
+    touching orbax so the operator gets an actionable message instead of
+    a raw Orbax/XLA partitioning traceback."""
+
+
+def _leaf_meta(tree: Any) -> List[Dict[str, Any]]:
+    """Per-leaf (path, shape, dtype) — the manifest's structure section."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [{
+        "path": jax.tree_util.keystr(path),
+        "shape": [int(d) for d in getattr(leaf, "shape", ())],
+        "dtype": str(getattr(leaf, "dtype", type(leaf).__name__)),
+    } for path, leaf in leaves]
+
+
+def _scan_files(step_dir: str) -> Dict[str, Tuple[int, str]]:
+    """{relpath: (bytes, sha256)} over every file of a step directory,
+    the manifest itself excluded."""
+    out: Dict[str, Tuple[int, str]] = {}
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, step_dir)
+            if rel in (MANIFEST_NAME, MANIFEST_NAME + ".tmp"):
+                continue
+            h = hashlib.sha256()
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            out[rel] = (os.path.getsize(full), h.hexdigest())
+    return out
+
+
+def _to_abstract(leaf: Any) -> Any:
+    """Shape-dtype-struct view of a leaf. Already-abstract leaves pass
+    through unchanged — ``ocp.utils.to_shape_dtype_struct`` assumes an
+    orbax metadata sharding on ShapeDtypeStruct inputs and trips over a
+    plain jax one (or None, for host-only trees)."""
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        return leaf
+    return ocp.utils.to_shape_dtype_struct(leaf)
+
+
+def _manifest_digest(manifest: Dict[str, Any]) -> str:
+    """Whole-checkpoint digest over the manifest body (everything but the
+    digest field itself) — the last thing written, i.e. the commit bit."""
+    body = {k: v for k, v in manifest.items() if k != "digest"}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()).hexdigest()
+
 
 class CheckpointManager:
+    """Orbax manager + manifest commit/verify/quarantine/fallback layer.
+
+    Save kinds (the ``kind`` metric label): ``scheduled`` (cadenced saves
+    from the training loop), ``emergency`` (preemption-warning synchronous
+    save), ``final`` (end-of-run). Async saves are *pending* until their
+    manifest commits — ``close()`` (idempotent, also registered via
+    ``atexit``) guarantees every scheduled save is either finalized or
+    quarantined, so a crash between async save and process exit can never
+    leave a half-written step masquerading as ``latest_step()``.
+    """
+
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep, create=True)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._closed = False
+        # step -> {"t0": dispatch clock, "kind": ..., "tree": leaf meta};
+        # entries live from save() until the manifest commits.
+        self._pending: Dict[int, Dict[str, Any]] = {}
+        # Steps whose manifest THIS instance committed: only these may be
+        # silently skipped on re-save — a same-numbered step from an
+        # earlier run is a different state and must never be adopted.
+        self._committed: set = set()
+        self.last_restored_step: Optional[int] = None
+        atexit.register(self._atexit_guard)
 
-    def save(self, step: int, state: Any, wait: bool = False) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+    # ------------------------------------------------------------------ save
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _known_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps() or [])
+
+    def save(self, step: int, state: Any, wait: bool = False,
+             kind: str = "scheduled") -> None:
+        """Schedule (or, with ``wait``/``kind="emergency"``, complete) a
+        save. Emergency saves are always synchronous — an emergency
+        checkpoint that outlives the process is no checkpoint at all.
+        Re-saving a step this instance already committed is a no-op (an
+        emergency save landing exactly on a scheduled checkpoint
+        boundary); a same-numbered step left by an *earlier run* is a
+        different state and is quarantined first, never adopted."""
+        if self._closed:
+            raise CheckpointError(
+                f"CheckpointManager for {self.directory} is closed")
+        if kind == "emergency":
+            _metrics.counter(
+                "tk8s_train_checkpoint_emergency_saves_total").inc()
+            wait = True
+        already = step in self._pending or step in self._committed
+        if not already:
+            if step in self._known_steps():
+                self.quarantine(step, "superseded-by-resave")
+            self._pending[step] = {"t0": time.perf_counter(), "kind": kind,
+                                   "tree": _leaf_meta(state)}
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
-            self._mgr.wait_until_finished()
+            self._finalize()
 
+    def _finalize(self) -> None:
+        """Wait out scheduled async saves and commit their manifests; a
+        failed wait quarantines whatever the dead save left behind."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, {}
+        try:
+            self._mgr.wait_until_finished()
+        except Exception:
+            for step in sorted(pending):
+                if os.path.isdir(self._step_dir(step)):
+                    self.quarantine(step, "async-save-failed")
+            raise
+        for step, info in sorted(pending.items()):
+            sdir = self._step_dir(step)
+            if not os.path.isdir(sdir):  # gc'd by max_to_keep already
+                continue
+            if os.path.exists(os.path.join(sdir, MANIFEST_NAME)):
+                self._committed.add(step)
+                continue
+            files = _scan_files(sdir)
+            manifest: Dict[str, Any] = {
+                "format": 1,
+                "step": step,
+                "kind": info["kind"],
+                "tree": info["tree"],
+                "files": {rel: {"bytes": size, "sha256": digest}
+                          for rel, (size, digest) in sorted(files.items())},
+            }
+            manifest["digest"] = _manifest_digest(manifest)
+            tmp = os.path.join(sdir, MANIFEST_NAME + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(sdir, MANIFEST_NAME))
+            self._committed.add(step)
+            _metrics.histogram(
+                "tk8s_train_checkpoint_save_duration_seconds").observe(
+                time.perf_counter() - info["t0"], kind=info["kind"])
+            _metrics.counter("tk8s_train_checkpoint_bytes_total").inc(
+                sum(size for size, _ in files.values()), kind=info["kind"])
+
+    # ---------------------------------------------------------------- verify
+    def verify_step(self, step: int) -> None:
+        """Raise :class:`CheckpointIntegrityError` unless ``step`` is a
+        committed, byte-intact checkpoint. Every failure is counted."""
+
+        def fail(message: str, reason: str) -> None:
+            _metrics.counter(
+                "tk8s_train_checkpoint_verify_failures_total").inc(
+                reason=reason)
+            raise CheckpointIntegrityError(
+                f"step {step} in {self.directory}: {message}", reason=reason)
+
+        sdir = self._step_dir(step)
+        if not os.path.isdir(sdir):
+            fail("no step directory", "missing-step")
+        mpath = os.path.join(sdir, MANIFEST_NAME)
+        if not os.path.exists(mpath):
+            fail("no manifest — the save never committed", "missing-manifest")
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except ValueError as e:
+            fail(f"torn manifest ({e})", "torn-manifest")
+        if manifest.get("digest") != _manifest_digest(manifest):
+            fail("manifest digest mismatch", "digest-mismatch")
+        actual = _scan_files(sdir)
+        for rel, meta in manifest.get("files", {}).items():
+            got = actual.get(rel)
+            if got is None:
+                fail(f"file {rel} missing", "missing-file")
+            elif got[0] != int(meta["bytes"]):
+                fail(f"file {rel} is {got[0]} bytes, manifest says "
+                     f"{meta['bytes']} (truncated or torn)", "truncated")
+            elif got[1] != meta["sha256"]:
+                fail(f"file {rel} content checksum mismatch (bit rot or "
+                     f"partial overwrite)", "checksum-mismatch")
+
+    def quarantine(self, step: int, reason: str = "corrupt") -> str:
+        """Move a bad step aside (rename, never delete — it is postmortem
+        evidence) and drop it from orbax's step index."""
+        src = self._step_dir(step)
+        qdir = os.path.join(self.directory, QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        slug = "".join(c if c.isalnum() or c in "._-" else "-"
+                       for c in reason)[:64] or "corrupt"
+        dst = os.path.join(qdir, f"{step}-{slug}")
+        n = 1
+        while os.path.exists(dst):
+            dst = os.path.join(qdir, f"{step}-{slug}.{n}")
+            n += 1
+        os.rename(src, dst)
+        self._pending.pop(step, None)
+        self._committed.discard(step)
+        self._mgr.reload()  # latest_step() must not see the quarantined dir
+        return dst
+
+    # --------------------------------------------------------------- restore
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
-        """``state_like``: concrete or abstract (jax.eval_shape output whose
-        leaves carry shardings) tree matching what was saved."""
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+    def all_steps(self) -> List[int]:
+        return self._known_steps()
 
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest step that passes manifest verification (read-only: bad
+        steps are reported by counter but not quarantined here)."""
+        for step in sorted(self._known_steps(), reverse=True):
+            try:
+                self.verify_step(step)
+                return step
+            except CheckpointIntegrityError:
+                continue
+        return None
+
+    @staticmethod
+    def _check_mesh_fits(abstract: Any) -> None:
+        """Typed, actionable error when the target mesh cannot partition
+        the tree — instead of the raw Orbax/XLA ValueError."""
+        for path, leaf in jax.tree_util.tree_flatten_with_path(abstract)[0]:
+            sharding = getattr(leaf, "sharding", None)
+            spec = getattr(sharding, "spec", None)
+            mesh = getattr(sharding, "mesh", None)
+            if spec is None or mesh is None:
+                continue
+            shape = tuple(getattr(leaf, "shape", ()))
+            mesh_shape = dict(mesh.shape)
+            for dim, entry in enumerate(spec):
+                if entry is None or dim >= len(shape):
+                    continue
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                ways = 1
+                for ax in axes:
+                    ways *= mesh_shape.get(ax, 1)
+                if ways > 1 and shape[dim] % ways:
+                    raise MeshMismatchError(
+                        f"cannot restore onto this mesh: leaf "
+                        f"'{jax.tree_util.keystr(path)}' dimension {dim} "
+                        f"(size {shape[dim]}) would be split {ways} ways "
+                        f"by mesh axes {tuple(axes)} of mesh {mesh_shape}; "
+                        f"the restore mesh must divide every sharded "
+                        f"dimension — resume on a device count whose axes "
+                        f"divide the saved shapes (e.g. the original mesh) "
+                        f"or reshard offline")
+
+    def restore(self, state_like: Any, step: Optional[int] = None,
+                verify: bool = True) -> Any:
+        """``state_like``: concrete or abstract (shape-dtype structs whose
+        leaves carry shardings) tree matching what was saved.
+
+        Verifies the newest candidate's manifest first; a step that fails
+        is quarantined and the next older step is tried — the restore
+        self-heals past torn or bit-rotted checkpoints. ``step`` bounds
+        the search (newest verified step <= ``step``); the actually
+        restored step lands in ``last_restored_step``."""
+        self._finalize()  # a restore must see every scheduled save committed
+        abstract = jax.tree.map(_to_abstract, state_like)
+        self._check_mesh_fits(abstract)
+        steps = self._known_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        candidates = [s for s in steps if step is None or s <= step]
+        if not candidates:
+            raise FileNotFoundError(
+                f"no checkpoint at or before step {step} in "
+                f"{self.directory} (have {steps})")
+        failures: List[str] = []
+        for s in sorted(candidates, reverse=True):
+            if verify:
+                try:
+                    self.verify_step(s)
+                except CheckpointIntegrityError as e:
+                    where = self.quarantine(s, e.reason)
+                    failures.append(f"{e} -> quarantined to {where}")
+                    continue
+            restored = self._mgr.restore(
+                s, args=ocp.args.StandardRestore(abstract))
+            if failures:
+                _metrics.counter(
+                    "tk8s_train_checkpoint_fallback_restores_total").inc()
+            self.last_restored_step = s
+            return restored
+        raise CheckpointIntegrityError(
+            f"no checkpoint in {self.directory} passed verification: "
+            + "; ".join(failures), reason="all-quarantined")
+
+    # ----------------------------------------------------------------- close
     def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        """Idempotent: commit (or quarantine) every scheduled async save,
+        then release orbax resources. Also runs at interpreter exit via
+        ``atexit``, so a trainer that forgets close() still never leaves a
+        committed-looking half-step behind."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            atexit.unregister(self._atexit_guard)
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+        try:
+            self._finalize()
+        finally:
+            self._mgr.close()
+
+    def _atexit_guard(self) -> None:
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - best effort at exit
+            pass
+
+
+def restore_newest_verified(state_like: Any, *managers: Any,
+                            ) -> Tuple[Any, Any, int]:
+    """Cross-manager resume: restore the newest verified step across
+    several checkpoint directories (the scheduled dir and the emergency
+    dir). Candidate steps from every manager are tried globally
+    newest-first; one that fails verification is quarantined by its
+    owning manager and the next-newest step — wherever it lives — is
+    tried, so a torn emergency save falls back to the last scheduled
+    checkpoint (never to an older step in its own directory while a
+    newer verified one exists elsewhere). Returns ``(restored_state,
+    manager, step)``. Raises ``FileNotFoundError`` when no manager holds
+    any checkpoint, and :class:`CheckpointIntegrityError` when
+    checkpoints exist but none verifies anywhere."""
+    mgrs = [m for m in managers if m is not None]
+    candidates = [(step, mgr) for mgr in mgrs for step in mgr.all_steps()]
+    # Newest step first; ties keep the caller's manager order (scheduled
+    # before emergency when both committed the same step).
+    candidates.sort(key=lambda c: (-c[0], mgrs.index(c[1])))
+    if not candidates:
+        raise FileNotFoundError(
+            "no checkpoints in any of: "
+            + ", ".join(m.directory for m in mgrs))
+    failures: List[str] = []
+    for step, mgr in candidates:
+        # Verify HERE, not via restore's own fallback: a manager must not
+        # fall back within its own directory past steps another manager
+        # holds verified copies newer than.
+        try:
+            mgr.verify_step(step)
+        except CheckpointIntegrityError as e:
+            where = mgr.quarantine(step, e.reason)
+            failures.append(f"{e} -> quarantined to {where}")
+            continue
+        # verify=False: this exact step was hashed end-to-end two lines
+        # up — re-verifying inside restore would read a multi-GB
+        # checkpoint twice on every resume.
+        restored = mgr.restore(state_like, step=step, verify=False)
+        if failures:
+            _metrics.counter(
+                "tk8s_train_checkpoint_fallback_restores_total").inc()
+        return restored, mgr, step
+    raise CheckpointIntegrityError(
+        "no checkpoint passed verification in any directory: "
+        + "; ".join(failures), reason="all-quarantined")

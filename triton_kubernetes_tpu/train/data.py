@@ -277,6 +277,14 @@ class _ProducerError:
         self.exc = exc
 
 
+class PrefetchProducerError(RuntimeError):
+    """The prefetch producer died mid-stream. Raised on the consumer side
+    with the producer's original exception chained (``raise ... from``),
+    so the real cause — the generator frame that blew up, possibly on a
+    background thread — stays visible in the traceback instead of being
+    reduced to a bare re-raise at the queue boundary."""
+
+
 class DevicePrefetch:
     """Double-buffered host->device prefetch over a host-batch iterator.
 
@@ -339,13 +347,24 @@ class DevicePrefetch:
             self._queue.put(_ProducerError(e))
 
     def _next_host(self):
-        """One host batch from the producer, or _Drained; blocks (timed)."""
+        """One host batch from the producer, or _Drained; blocks (timed).
+        Producer failures surface as :class:`PrefetchProducerError` with
+        the original exception as ``__cause__``."""
         if not self.threaded:
-            return next(self._source, _Drained)
+            try:
+                return next(self._source, _Drained)
+            except Exception as e:
+                raise PrefetchProducerError(
+                    f"prefetch producer failed after {self.batches_out} "
+                    f"batches: {e}") from e
         item = self._queue.get()
         if isinstance(item, _ProducerError):
             self._exhausted = True
-            raise item.exc
+            if isinstance(item.exc, Exception):
+                raise PrefetchProducerError(
+                    f"prefetch producer failed after {self.batches_out} "
+                    f"batches: {item.exc}") from item.exc
+            raise item.exc  # KeyboardInterrupt etc: pass through unwrapped
         return item
 
     # ------------------------------------------------------------ consumer

@@ -221,6 +221,7 @@ class LoopReport:
     steps: int = 0
     losses: List[float] = field(default_factory=list)  # per step, in order
     sync_points: int = 0
+    interrupted: bool = False  # should_stop tripped; partial window synced
     wall_seconds: float = 0.0
     steps_per_sec: float = 0.0
     tokens_per_sec: float = 0.0
@@ -239,6 +240,7 @@ def run_pipelined(
     config_name: str = "",
     on_sync: Optional[Callable[[int, Any, List[float], float], None]] = None,
     force_sync: Optional[Callable[[int], bool]] = None,
+    should_stop: Optional[Callable[[], bool]] = None,
     prefetch: Any = None,
     clock: Callable[[], float] = time.perf_counter,
 ) -> Tuple[Any, LoopReport]:
@@ -255,7 +257,11 @@ def run_pipelined(
     sync this loop exists to remove). ``force_sync(steps_done)`` may
     close a window early at caller-meaningful boundaries (checkpoint
     multiples) without shrinking ``sync_every`` for every other window.
-    ``prefetch`` names the :class:`..train.data.DevicePrefetch` feeding
+    ``should_stop()`` is polled before each dispatch (a host flag read —
+    free); when it turns true the loop syncs the partial window and
+    returns with ``report.interrupted`` set — the preemption-warning
+    path (train/resilience.py): the sync point is where an emergency
+    checkpoint is safe to take. ``prefetch`` names the :class:`..train.data.DevicePrefetch` feeding
     ``batches`` when the iterable wraps it (e.g. in an
     ``itertools.chain``), so input-wait accounting still reaches the
     gauge.
@@ -325,6 +331,9 @@ def run_pipelined(
         t_window = clock()
 
     for batch in batches_it:
+        if should_stop is not None and should_stop():
+            report.interrupted = True
+            break
         state, metrics = step_fn(state, batch)
         window.append(metrics)
         report.steps += 1

@@ -1,0 +1,379 @@
+"""Survive-the-step: preemption-aware saves, loss-anomaly rollback.
+
+"Exploring the limits of Concurrency in ML Training on Google TPUs"
+(PAPERS.md) makes preemption-tolerant checkpoint/resume the defining
+constraint of large pod-slice jobs: recovery is a first-class hot path,
+not an error path. This module is that hot path for the bundled trainer,
+wrapping the step-pipelined loop (train/pipeline.py ``run_pipelined``)
+with three protections:
+
+1. **Preemption-aware emergency save** — GKE delivers SIGTERM ~30s
+   before reclaiming a TPU slice (the JobSet's terminationGracePeriod).
+   :class:`PreemptionGuard` turns that signal into a flag the pipelined
+   loop checks before dispatching each step; on trip the current window
+   is force-synced, a *synchronous* emergency checkpoint is written
+   (``kind="emergency"``, manifest-committed), and the trainer exits
+   with :data:`EXIT_RESUME` so the JobSet restart policy resumes the job
+   instead of failing it.
+
+2. **Loss-anomaly guard with rollback** — at each sync window the
+   already-host-synced losses are screened for NaN/Inf and for a
+   configurable spike factor over a running median
+   (:class:`LossAnomalyGuard`). On trip the loop rolls back to the last
+   *verified* checkpoint, rebuilds the data stream at the rolled-back
+   step (step-indexed replay keeps the resumed batch sequence
+   reproducible), optionally skips the offending window's batches, and
+   aborts with :class:`AnomalyAbortedError` after ``max_rollbacks``
+   consecutive trips instead of looping forever.
+
+3. **Verified restore under everything** — rollbacks and resumes go
+   through ``CheckpointManager.restore``, which quarantines torn or
+   bit-rotted steps and falls back to the newest verifiable earlier one
+   (train/checkpoint.py).
+
+The non-tripping path adds exactly one host-side screen per sync window
+(pure Python over already-fetched floats), so per-step losses stay
+bitwise identical to the bare pipelined loop — pinned in
+tests/test_resilience.py.
+"""
+
+from __future__ import annotations
+
+import math
+import signal
+import statistics
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from .pipeline import run_pipelined
+
+# EX_TEMPFAIL: the distinguishable "resume me" exit code. The JobSet
+# restart policy (topology/jobset.py podFailurePolicy) treats it as
+# retryable — a preempted trainer restarts with --resume, a genuinely
+# failed one (any other nonzero code) does not loop forever.
+EXIT_RESUME = 75
+
+
+class AnomalyAbortedError(RuntimeError):
+    """The guarded loop gave up: ``max_rollbacks`` consecutive anomaly
+    trips without a clean window in between. Carries the last anomaly."""
+
+    def __init__(self, message: str, anomaly: "Anomaly"):
+        super().__init__(message)
+        self.anomaly = anomaly
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT -> a flag the training loop polls.
+
+    Signal handlers must be installed from the main thread; ``install``
+    raises ``ValueError`` elsewhere (callers may then run unguarded).
+    ``trip()`` sets the flag programmatically — tests and in-process
+    orchestrators use it; the signal path and it are equivalent.
+    """
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self.signals = signals
+        self.signum: Optional[int] = None
+        self._event = threading.Event()
+        self._prev: Dict[int, Any] = {}
+
+    def install(self) -> "PreemptionGuard":
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def uninstall(self) -> None:
+        while self._prev:
+            sig, prev = self._prev.popitem()
+            signal.signal(sig, prev)
+
+    def _handle(self, signum, frame) -> None:
+        self.signum = signum
+        self._event.set()
+
+    def trip(self) -> None:
+        self._event.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+@dataclass(frozen=True)
+class Anomaly:
+    """One tripped loss: which step, what value, why."""
+
+    step: int          # absolute (global) step of the offending loss
+    loss: float
+    reason: str        # "non-finite" | "spike"
+    median: float      # running median at screening time (nan for n-f)
+
+
+class LossAnomalyGuard:
+    """Screens per-window losses for NaN/Inf and median-relative spikes.
+
+    Only healthy losses enter the running-median history, so a slow ramp
+    cannot drag the baseline up to meet the spike it should have caught.
+    ``factor`` <= 0 disables the spike rule (non-finite always trips);
+    ``min_history`` healthy losses are required before spikes arm, so
+    the noisy first steps of a fresh run cannot false-positive.
+    """
+
+    def __init__(self, factor: float = 10.0, min_history: int = 4,
+                 history: int = 256):
+        self.factor = factor
+        self.min_history = min_history
+        self._hist: deque = deque(maxlen=history)
+
+    def screen(self, losses: List[float], start_step: int) -> Optional[Anomaly]:
+        """First anomalous loss of a window (absolute steps start at
+        ``start_step`` for ``losses[0]``), or None; healthy prefix values
+        are absorbed into the history either way."""
+        for i, loss in enumerate(losses):
+            if not math.isfinite(loss):
+                return Anomaly(start_step + i, loss, "non-finite",
+                               float("nan"))
+            if self.factor > 0 and len(self._hist) >= self.min_history:
+                med = statistics.median(self._hist)
+                if loss > med * self.factor:
+                    return Anomaly(start_step + i, loss, "spike", med)
+            self._hist.append(loss)
+        return None
+
+    def reset_history(self, losses: List[float]) -> None:
+        """Replace the running-median history (rollback support: replayed
+        windows must not enter the history twice and skew the median)."""
+        self._hist.clear()
+        self._hist.extend(losses[-(self._hist.maxlen or len(losses)):])
+
+
+@dataclass
+class ResilienceReport:
+    """What one ``run_resilient`` call did, host-resident."""
+
+    steps: int = 0                      # accepted steps past start_step
+    losses: List[float] = field(default_factory=list)  # accepted, in order
+    rollbacks: int = 0
+    anomalies: List[Anomaly] = field(default_factory=list)
+    interrupted: bool = False           # preemption flag tripped
+    emergency_step: Optional[int] = None
+    restored_steps: List[int] = field(default_factory=list)  # rollback targets
+    sync_points: int = 0
+
+
+class _AnomalyTrip(Exception):
+    """Internal unwind from the sync callback to the segment driver."""
+
+
+def _abstract_like(state: Any) -> Any:
+    """Shape/dtype/sharding template for rollback restores — built before
+    the first (donating) step invalidates the concrete buffers."""
+    return jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(
+            getattr(leaf, "shape", ()), getattr(leaf, "dtype", None),
+            sharding=getattr(leaf, "sharding", None)),
+        state)
+
+
+def run_resilient(
+    step_fn: Callable[[Any, Any], Tuple[Any, Dict[str, Any]]],
+    state: Any,
+    make_batches: Callable[[int], Any],
+    *,
+    ckpt: Any = None,                   # train.checkpoint.CheckpointManager
+    emergency_ckpt: Any = None,         # defaults to ckpt
+    target_step: int,
+    start_step: int = 0,
+    sync_every: int = 8,
+    checkpoint_every: int = 0,
+    guard: Optional[LossAnomalyGuard] = None,
+    max_rollbacks: int = 3,
+    skip_anomalous_window: bool = False,
+    start_is_checkpointed: bool = False,
+    preemption: Optional[PreemptionGuard] = None,
+    tokens_per_step: int = 0,
+    config_name: str = "",
+    on_sync: Optional[Callable[[int, Any, List[float], float], None]] = None,
+    on_checkpoint: Optional[Callable[[int, str], None]] = None,
+) -> Tuple[Any, ResilienceReport]:
+    """Drive ``run_pipelined`` to ``target_step`` under the guards.
+
+    ``make_batches(step)`` returns a fresh batch iterable positioned so
+    its first batch is the one step ``step + 1`` consumes — the trainer's
+    deterministic stream replay; it may return ``(iterable, prefetch)``
+    to keep ``DevicePrefetch`` wait accounting flowing. Each segment's
+    iterable is closed (if closeable) when the segment ends.
+
+    Checkpoints are cadenced at absolute ``checkpoint_every`` multiples
+    (windows force-split there, exactly like the bare trainer loop) and
+    are what rollback restores; with a ``guard`` active and no verified
+    checkpoint at/below ``start_step``, a baseline save is taken first so
+    the very first window is already protected. ``on_sync(gstep, state,
+    window_losses, dt)`` fires per clean window with *absolute* steps;
+    ``on_checkpoint(gstep, kind)`` after each save.
+    """
+    from ..utils import metrics as _metrics
+
+    if emergency_ckpt is None:
+        emergency_ckpt = ckpt
+    template = _abstract_like(state)
+    report = ResilienceReport()
+    done = start_step        # accepted model step
+    data_pos = start_step    # data-stream position (diverges under skip)
+    # Model step -> data-stream position when that step's checkpoint was
+    # taken. Skips shift data_pos ahead of done, so a rollback must land
+    # the STREAM where it was at the restored step, not at the raw step
+    # index (which would replay — or re-skip into — the wrong batches).
+    data_at: Dict[int, int] = {start_step: start_step}
+    consecutive = 0
+    trip_high = start_step  # furthest window a trip has reached
+    rollback_counter = _metrics.counter("tk8s_train_anomaly_rollbacks_total")
+
+    if (guard is not None and ckpt is not None
+            and not start_is_checkpointed):
+        # Rollback needs a landing spot AT start_step in the scheduled
+        # dir — resume-from-emergency leaves the scheduled dir's newest
+        # step behind the resume point, and rolling back past start_step
+        # would discard durable progress and corrupt the report's
+        # step/loss alignment. ``start_is_checkpointed`` (the caller just
+        # restored this exact step from ``ckpt``) skips the re-hash.
+        if ckpt.latest_verified_step() != start_step:
+            ckpt.save(start_step, state, wait=True)
+            if on_checkpoint is not None:
+                on_checkpoint(start_step, "scheduled")
+
+    while done < target_step:
+        if preemption is not None and preemption.requested:
+            report.interrupted = True
+            break
+        made = make_batches(data_pos)
+        batches, prefetch = made if isinstance(made, tuple) else (made, None)
+        seg_base = done
+        seg_data = data_pos  # step s in this segment reads data index
+        #                      seg_data + (s - seg_base)
+        last_mark = seg_base // checkpoint_every if checkpoint_every else 0
+        trip: Dict[str, Any] = {}
+
+        def _on_sync(seg_done: int, cur_state: Any,
+                     window_losses: List[float], dt: float) -> None:
+            nonlocal consecutive, last_mark
+            gstep = seg_base + seg_done
+            if guard is not None:
+                anomaly = guard.screen(
+                    window_losses, gstep - len(window_losses) + 1)
+                if anomaly is not None:
+                    trip["anomaly"] = anomaly
+                    trip["window_end"] = gstep
+                    raise _AnomalyTrip()
+            if gstep > trip_high:
+                # Only progress PAST the furthest trip resets the abort
+                # budget — replayed clean windows *behind* a recurring
+                # anomaly must not refill it, or a deterministic NaN more
+                # than one window past the checkpoint would roll back
+                # forever instead of aborting.
+                consecutive = 0
+            report.losses.extend(window_losses)
+            report.sync_points += 1
+            if ckpt is not None and checkpoint_every:
+                mark = gstep // checkpoint_every
+                if mark > last_mark:
+                    last_mark = mark
+                    ckpt.save(gstep, cur_state)
+                    data_at[gstep] = seg_data + (gstep - seg_base)
+                    if on_checkpoint is not None:
+                        on_checkpoint(gstep, "scheduled")
+            if on_sync is not None:
+                on_sync(gstep, cur_state, window_losses, dt)
+
+        force_sync = None
+        if checkpoint_every:
+            force_sync = (
+                lambda n, base=seg_base: (base + n) % checkpoint_every == 0)
+        should_stop = (
+            (lambda: preemption.requested) if preemption is not None else None)
+        try:
+            state, seg = run_pipelined(
+                step_fn, state, batches,
+                sync_every=sync_every, max_steps=target_step - seg_base,
+                tokens_per_step=tokens_per_step, config_name=config_name,
+                on_sync=_on_sync, force_sync=force_sync,
+                should_stop=should_stop, prefetch=prefetch)
+        except _AnomalyTrip:
+            anomaly: Anomaly = trip["anomaly"]
+            report.anomalies.append(anomaly)
+            trip_high = max(trip_high, trip["window_end"])
+            consecutive += 1
+            if consecutive > max_rollbacks:
+                _metrics.counter("tk8s_train_anomaly_aborts_total").inc()
+                raise AnomalyAbortedError(
+                    f"aborting after {max_rollbacks} consecutive "
+                    f"loss-anomaly rollbacks without a clean window "
+                    f"(last: {anomaly.reason} loss={anomaly.loss} at step "
+                    f"{anomaly.step})", anomaly)
+            if ckpt is None:
+                _metrics.counter("tk8s_train_anomaly_aborts_total").inc()
+                raise AnomalyAbortedError(
+                    f"loss anomaly at step {anomaly.step} "
+                    f"({anomaly.reason}, loss={anomaly.loss}) with no "
+                    f"checkpoint manager to roll back to", anomaly)
+            report.rollbacks += 1
+            rollback_counter.inc(reason=anomaly.reason)
+            # Newest checkpoint THIS RUN anchored at/below the tripped
+            # window (saves only happen at clean sync points, so every
+            # anchor predates the anomaly). Bounding by the run's own
+            # anchors — not just the step number — keeps a rollback from
+            # landing on a same-numbered stranger from an earlier run or
+            # below start_step; restore still falls back further if the
+            # anchor itself fails verification.
+            target = max(s for s in data_at if s <= trip["window_end"])
+            state = ckpt.restore(template, step=target)
+            good = ckpt.last_restored_step
+            report.restored_steps.append(good)
+            del report.losses[max(good - start_step, 0):]
+            guard.reset_history(report.losses)  # replays must not re-enter
+            done = good
+            # Both branches work in DATA space, honoring earlier skips:
+            # resume the stream where the restored step left it, or just
+            # past the offending window's last consumed batch.
+            data_pos = (seg_data + (trip["window_end"] - seg_base)
+                        if skip_anomalous_window
+                        else data_at.get(good, good))
+            continue
+        finally:
+            close = getattr(batches, "close", None) or getattr(
+                prefetch, "close", None)
+            if close is not None:
+                close()
+        done = seg_base + seg.steps
+        data_pos += seg.steps
+        if seg.interrupted:
+            report.interrupted = True
+            break
+        if seg.steps < target_step - seg_base:
+            break  # data exhausted: a short epoch, reported not raised
+
+    report.steps = done - start_step
+    if report.interrupted:
+        # Nothing new trained (warning landed before the first step, or
+        # right after a resume) => the state at ``done`` is already
+        # durable (or a deterministic re-init): saving again would only
+        # quarantine-and-rewrite a good on-disk step inside the kill
+        # window. Skip; exit-for-resume is still correct.
+        if emergency_ckpt is not None and done > start_step:
+            emergency_ckpt.save(done, state, kind="emergency")
+            report.emergency_step = done
+            if on_checkpoint is not None:
+                on_checkpoint(done, "emergency")
+    return state, report

@@ -69,6 +69,9 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         ("kind",), None),
     "tk8s_cloudsim_preemptions_total": (
         "counter", "TPU slice preemptions fired in the simulator", (), None),
+    "tk8s_cloudsim_preempt_warnings_total": (
+        "counter", "Graceful preemption warnings delivered by the "
+        "simulator (the GKE SIGTERM-before-reclaim analog)", (), None),
     # -------------------------------------------------- manager/client.py
     "tk8s_manager_client_requests_total": (
         "counter", "Manager-client HTTP requests by method and status "
@@ -110,6 +113,33 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "gauge", "AOT compile-time split of the train step by phase "
         "(lower / compile); near-zero compile on a warm persistent "
         "cache", ("config", "phase"), None),
+    # --------------------------------- train/checkpoint.py (integrity)
+    "tk8s_train_checkpoint_save_duration_seconds": (
+        "histogram", "Wall clock from checkpoint-save dispatch to "
+        "manifest commit, by save kind (scheduled/emergency/final)",
+        ("kind",), DEFAULT_BUCKETS),
+    "tk8s_train_checkpoint_bytes_total": (
+        "counter", "Bytes committed to manifest-verified checkpoints, "
+        "by save kind", ("kind",), None),
+    "tk8s_train_checkpoint_verify_failures_total": (
+        "counter", "Checkpoint manifest verification failures, by "
+        "reason (missing-manifest/torn-manifest/digest-mismatch/"
+        "truncated/checksum-mismatch/missing-file/missing-step)",
+        ("reason",), None),
+    "tk8s_train_checkpoint_emergency_saves_total": (
+        "counter", "Synchronous emergency checkpoints written on a "
+        "preemption warning", (), None),
+    "tk8s_train_checkpoint_fallback_restores_total": (
+        "counter", "Restores that quarantined a bad step and fell back "
+        "to an earlier verified one", (), None),
+    # --------------------------------- train/resilience.py (anomaly guard)
+    "tk8s_train_anomaly_rollbacks_total": (
+        "counter", "Loss-anomaly rollbacks taken by the guarded "
+        "training loop, by trip reason (non-finite/spike)",
+        ("reason",), None),
+    "tk8s_train_anomaly_aborts_total": (
+        "counter", "Guarded-loop aborts after the consecutive-rollback "
+        "budget was exhausted", (), None),
 }
 
 _VALID_KINDS = ("counter", "gauge", "histogram")
