@@ -23,7 +23,11 @@ of recompiling from scratch; (b) the child announces each phase
 (``phase=...`` markers on stderr) and reports the measured
 lower-vs-compile-vs-step split in its JSON line; (c) a timed-out attempt
 is classified by the phase it died in (``timeout@compile``,
-``timeout@steps``, ...), so a timeout is attributable, not blind.
+``timeout@steps``, ...), so a timeout is attributable, not blind — a
+child that dies before its FIRST marker (import/plugin handshake) is
+``timeout@init``, and every ``tpu_errors`` entry carries the last
+observed phase (``<class>@<phase>``), closing the BENCH_r01–r05 gap
+where whole rounds logged bare ``tpu_attempt_N:timeout``.
 
 Auto-scales: real TPU -> llama3-bench (~420M, bf16, remat); CPU fallback ->
 llama-test miniature so the script always produces a line.
@@ -234,18 +238,23 @@ def _error_class(exc_or_text) -> str:
 
 
 def _last_phase(stderr: str) -> str:
-    """The phase the child last announced — what a timeout was doing."""
+    """The phase the child last announced — what a failure/timeout was
+    doing. ``init`` when the child died before its FIRST phase marker
+    (interpreter/jax import, the axon plugin handshake): BENCH_r01–r05
+    all recorded bare ``tpu_attempt_N:timeout`` precisely because the
+    child never got as far as ``phase=backend_init``."""
     phase = ""
     for line in stderr.splitlines():
         marker = line.partition("phase=")[2]
         if line.startswith("[bench-child]") and marker:
             phase = marker.split()[0]
-    return phase
+    return phase or "init"
 
 
 def _run_attempt(extra_args: list, env_overrides: dict,
-                 timeout: float) -> tuple[dict | None, str]:
-    """Run the child once. Returns (parsed json line | None, error class)."""
+                 timeout: float) -> tuple[dict | None, str, str]:
+    """Run the child once. Returns (parsed json line | None, error class,
+    last observed child phase)."""
     env = dict(os.environ)
     env.update(env_overrides)
     # Every attempt (and every round) reuses one persistent XLA cache:
@@ -269,22 +278,23 @@ def _run_attempt(extra_args: list, env_overrides: dict,
         ferr.seek(0)
         stdout, stderr = fout.read(), ferr.read()
     sys.stderr.write(stderr[-4000:])
+    phase = _last_phase(stderr)
     if rc is None:
         # Attributable timeout: which phase was the child in when the
         # budget ran out? (timeout@compile means "grow the cache budget",
-        # timeout@backend_init means "tunnel flapping" — different fixes.)
-        phase = _last_phase(stderr)
-        return None, f"timeout@{phase}" if phase else "timeout"
+        # timeout@init means "died before the first marker — tunnel/
+        # import hang" — different fixes.)
+        return None, f"timeout@{phase}", phase
     if rc != 0:
-        return None, _error_class(stderr[-4000:])
+        return None, _error_class(stderr[-4000:]), phase
     for line in reversed(stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line), ""
+                return json.loads(line), "", phase
             except json.JSONDecodeError:
                 continue
-    return None, "no_json_output"
+    return None, "no_json_output", phase
 
 
 def main() -> None:
@@ -311,7 +321,7 @@ def main() -> None:
         print(f"[bench] TPU attempt {attempt + 1}/{TPU_ATTEMPTS} "
               f"(timeout {timeout:.0f}s, platform {tpu_platform})",
               file=sys.stderr, flush=True)
-        result, err = _run_attempt(
+        result, err, phase = _run_attempt(
             [], {"JAX_PLATFORMS": tpu_platform}, timeout)
         if result is not None and result.get("platform") in (
                 "tpu", tpu_platform):
@@ -319,7 +329,12 @@ def main() -> None:
             return
         # A child that came up on some unintended backend is a failed
         # attempt, not a number — fall through to retry / CPU fallback.
+        # Every entry carries the last phase the child reached, so a
+        # whole round of failures is attributable at a glance (timeouts
+        # already embed theirs in the class).
         err = err or "unexpected_platform"
+        if not err.startswith("timeout@"):
+            err = f"{err}@{phase}"
         errors.append(f"tpu_attempt_{attempt + 1}:{err}")
         if attempt + 1 < TPU_ATTEMPTS:
             # Longer backoff helps a flapping tunnel more than a fast
@@ -330,13 +345,15 @@ def main() -> None:
     remaining = deadline - time.monotonic()
     if remaining > 30:
         print("[bench] falling back to CPU", file=sys.stderr, flush=True)
-        result, err = _run_attempt(
+        result, err, phase = _run_attempt(
             ["--platform=cpu"], {}, min(CPU_ATTEMPT_TIMEOUT, remaining))
         if result is not None:
             result["error"] = "tpu_unreachable_cpu_fallback"
             result["tpu_errors"] = errors
             print(json.dumps(result), flush=True)
             return
+        if not err.startswith("timeout@"):
+            err = f"{err}@{phase}"
         errors.append(f"cpu:{err}")
     else:
         errors.append("cpu_skipped_budget_exhausted")

@@ -345,7 +345,28 @@ def test_bench_last_phase_parses_markers():
            "noise phase=red_herring\n"
            "[bench-child] phase=compile (lower took 12.0s)\n")
     assert bench._last_phase(err) == "compile"
-    assert bench._last_phase("no markers at all") == ""
+    # A child that died before its first marker (import/plugin handshake)
+    # classifies as init — the BENCH_r01–r05 bare-timeout gap.
+    assert bench._last_phase("no markers at all") == "init"
+
+
+def test_bench_timeout_before_first_marker_is_timeout_at_init(monkeypatch):
+    """A TPU child that hangs before printing ANY phase marker (import /
+    axon plugin handshake) must classify as ``timeout@init`` — not the
+    bare ``timeout`` every BENCH_r01–r05 round recorded — and the child's
+    last phase rides back for the ``tpu_errors`` entries."""
+    import subprocess
+
+    import bench
+
+    def fake_run(argv, **kwargs):
+        raise subprocess.TimeoutExpired(argv, kwargs.get("timeout", 0))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    result, err, phase = bench._run_attempt([], {}, timeout=1.0)
+    assert result is None
+    assert err == "timeout@init"
+    assert phase == "init"
 
 
 def test_bench_compile_cache_dir_env_override(monkeypatch):

@@ -1,0 +1,461 @@
+"""Wavefront apply/destroy (ISSUE 5 tentpole): DAG-parallel module
+provisioning with bounded concurrency.
+
+The contracts pinned here:
+
+* **Bitwise parity** — final applied state (modules, outputs, cloud —
+  fault firings included) is identical at parallelism 1/2/8; the serial
+  path (N=1) runs inline in exact topological order.
+* **Wavefront shapes** — diamond DAG, 1-wide chain, 12-wide fan-out all
+  schedule correctly (journal v2 wave field = pure DAG depth).
+* **Mid-wave failure + resume** — a branch that dies mid-wave does not
+  lose its completed siblings: they are journaled and saved, the re-run
+  NOOPs them and completes only the remainder.
+* **Sibling isolation** — a retrying branch burns its own backoff budget
+  and never stalls (or charges) parallel lanes.
+* **Destroy parity** — destroy journals like apply (kind=destroy,
+  per-module saves) and a killed destroy resumes over the survivors.
+"""
+
+import json
+import time
+
+import pytest
+
+from triton_kubernetes_tpu.executor import (
+    FatalApplyError,
+    LocalExecutor,
+    PlanAction,
+    RetryPolicy,
+)
+from triton_kubernetes_tpu.executor.cloudsim import CloudSimulator, FaultPlan
+from triton_kubernetes_tpu.executor.engine import (
+    _MEMORY_STATES,
+    load_executor_state,
+)
+from triton_kubernetes_tpu.state import StateDocument
+from triton_kubernetes_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_memory_executor_state():
+    yield
+    _MEMORY_STATES.clear()
+
+
+def _no_sleep(delay):  # tests must never wait on the wall clock
+    raise AssertionError(f"unexpected wall-clock sleep({delay})")
+
+
+def _quiet(parallelism=1, **kw):
+    kw.setdefault("sleep", _no_sleep)
+    return LocalExecutor(log=lambda m: None, parallelism=parallelism, **kw)
+
+
+def _doc(name, driver=None):
+    doc = StateDocument("m1")
+    doc.set_backend_config({"memory": {"name": name}})
+    if driver is not None:
+        doc.set("driver", driver)
+    return doc
+
+
+def _manager(doc, name="m1"):
+    doc.set_manager({"source": "modules/bare-metal-manager",
+                     "name": name, "host": "192.168.0.10"})
+
+
+def _fanout_doc(name, n_hosts=12, driver=None):
+    """manager -> cluster -> n_hosts independent hosts (n-wide wave)."""
+    doc = _doc(name, driver)
+    _manager(doc)
+    ckey = doc.add_cluster("bare-metal", "c1", {
+        "source": "modules/bare-metal-k8s", "name": "c1",
+        "manager_url": "${module.cluster-manager.manager_url}",
+        "manager_access_key": "${module.cluster-manager.manager_access_key}",
+        "manager_secret_key": "${module.cluster-manager.manager_secret_key}",
+    })
+    for i in range(n_hosts):
+        doc.add_node(ckey, f"h-{i}", {
+            "source": "modules/bare-metal-k8s-host",
+            "hostname": f"h-{i}", "host": f"192.168.1.{10 + i}",
+            "rancher_cluster_registration_token":
+                f"${{module.{ckey}.registration_token}}",
+            "rancher_cluster_ca_checksum":
+                f"${{module.{ckey}.ca_checksum}}",
+        })
+    return doc, ckey
+
+
+def _diamond_doc(name, driver=None):
+    """A -> (B, C) -> D: B and C are one wave, D waits for both."""
+    doc = _doc(name, driver)
+    _manager(doc, "a")
+    for mid in ("b", "c"):
+        doc.set(f"module.mgr_{mid}", {
+            "source": "modules/bare-metal-manager", "name": mid,
+            "host": f"192.168.2.{ord(mid)}",
+            "after": "${module.cluster-manager.manager_url}",
+        })
+    doc.set("module.mgr_d", {
+        "source": "modules/bare-metal-manager", "name": "d",
+        "host": "192.168.2.200",
+        "after_b": "${module.mgr_b.manager_url}",
+        "after_c": "${module.mgr_c.manager_url}",
+    })
+    return doc
+
+
+def _fingerprint(doc, with_journal=True):
+    """Canonical bytes of everything the parity contract covers: applied
+    modules + outputs, the full cloud dict (ids, ips, fault-plan fired
+    counts, op clocks), and the deterministic journal fields. Timings
+    (durations, backoff, critical path) vary run to run and are out."""
+    est = load_executor_state(doc)
+    fp = {"modules": est.modules, "cloud": est.cloud, "serial": est.serial}
+    if with_journal:
+        j = est.journal
+        fp["journal"] = {k: j[k] for k in
+                        ("kind", "order", "wave", "waves", "completed",
+                         "retries", "status")}
+    return json.dumps(fp, sort_keys=True)
+
+
+# ------------------------------------------------------------ bitwise parity
+
+def test_parallel_apply_state_bitwise_equal_to_serial():
+    """The acceptance pin: parallelism 1/2/8 leave byte-identical state —
+    same module records, same content-addressed cloud ids/ips, same fault
+    firings (a seeded transient 503 on one branch) — and the same
+    normalized journal order."""
+    driver = {"name": "sim", "fault_plan": {"faults": [
+        {"op": "register_node", "match": {"hostname": "h-3"},
+         "times": 1, "error": "503 service unavailable"}]}}
+    prints = {}
+    for par in (1, 2, 8):
+        doc, _ = _fanout_doc(f"parity-{par}", driver=driver)
+        sleeps = []
+        ex = LocalExecutor(log=lambda m: None, parallelism=par,
+                           retry=RetryPolicy(backoff=0.5), sleep=sleeps.append)
+        ex.apply(doc)
+        assert sleeps == [0.5]  # the fault fired (and was retried) at every N
+        prints[par] = _fingerprint(doc)
+    assert prints[1] == prints[2] == prints[8]
+
+
+def test_serial_parallelism_one_runs_inline_in_topo_order():
+    """N=1 is the historical serial loop: completion order == run order
+    (the journal records completions as they happen), max in-flight 1."""
+    doc, _ = _fanout_doc("serial", n_hosts=3)
+    ex = _quiet(parallelism=1)
+    ex.apply(doc)
+    j = load_executor_state(doc).journal
+    assert j["version"] == 2 and j["kind"] == "apply"
+    assert j["completed"] == j["order"]
+    assert j["parallelism"] == 1
+    assert j["max_in_flight"] == 1
+    assert j["failed"] is None and j["status"] == "ok"
+
+
+# ---------------------------------------------------------------- DAG shapes
+
+def test_chain_is_one_module_per_wave():
+    """1-wide chain: every module is its own wave; parallelism buys
+    nothing but must not reorder anything."""
+    doc = _doc("chain")
+    _manager(doc, "a")
+    prev = "cluster-manager"
+    for mid in ("b", "c", "d"):
+        doc.set(f"module.mgr_{mid}", {
+            "source": "modules/bare-metal-manager", "name": mid,
+            "host": f"192.168.3.{ord(mid)}",
+            "after": f"${{module.{prev}.manager_url}}",
+        })
+        prev = f"mgr_{mid}"
+    ex = _quiet(parallelism=8)
+    ex.apply(doc)
+    j = load_executor_state(doc).journal
+    assert j["wave"] == {"cluster-manager": 0, "mgr_b": 1,
+                         "mgr_c": 2, "mgr_d": 3}
+    assert j["waves"] == 4
+    assert j["completed"] == ["cluster-manager", "mgr_b", "mgr_c", "mgr_d"]
+    assert j["max_in_flight"] == 1  # nothing was ever co-runnable
+
+
+def test_diamond_waves_and_output_visibility():
+    """Diamond DAG: B and C share wave 1, D (wave 2) resolves both
+    branches' outputs — the per-module output-resolution-under-
+    concurrency contract."""
+    for par in (1, 4):
+        doc = _diamond_doc(f"diamond-{par}")
+        ex = _quiet(parallelism=par)
+        ex.apply(doc)
+        j = load_executor_state(doc).journal
+        assert j["wave"] == {"cluster-manager": 0, "mgr_b": 1,
+                             "mgr_c": 1, "mgr_d": 2}
+        assert j["waves"] == 3
+        # D really interpolated both wave-1 outputs.
+        est = load_executor_state(doc)
+        d_cfg = est.modules["mgr_d"]["config"]
+        assert d_cfg["after_b"] == "${module.mgr_b.manager_url}"
+        assert ex.output(doc, "mgr_b")["manager_url"].startswith("https://")
+    assert (_fingerprint_for("diamond-1") == _fingerprint_for("diamond-4"))
+
+
+def _fingerprint_for(name):
+    doc = _doc(name)
+    return _fingerprint(doc, with_journal=False)
+
+
+def test_fanout_overlaps_under_simulated_latency():
+    """12-wide fan-out with the cloudsim op-latency knob armed: the
+    wavefront genuinely overlaps lanes (peak in-flight > 1) and beats the
+    serial wall clock."""
+    latency = 0.02
+    walls = {}
+    for par in (1, 8):
+        doc, _ = _fanout_doc(f"lat-{par}",
+                             driver={"name": "sim", "op_latency": latency})
+        ex = LocalExecutor(log=lambda m: None, parallelism=par)
+        t0 = time.perf_counter()
+        ex.apply(doc)
+        walls[par] = time.perf_counter() - t0
+        j = load_executor_state(doc).journal
+        if par == 8:
+            assert j["max_in_flight"] >= 2
+            # Speedup accounting landed: total work strictly exceeds the
+            # critical path on a fan-out, and both are journaled.
+            assert (j["total_work_seconds"]
+                    > j["critical_path_seconds"] > 0)
+    assert walls[8] < walls[1]
+    assert (_fingerprint_for("lat-1") == _fingerprint_for("lat-8"))
+
+
+# ----------------------------------------------------- failure mid-wave
+
+def test_mid_wave_failure_keeps_siblings_and_resumes():
+    """A fatal fault on one branch of the wave: in-flight siblings finish
+    and are journaled+saved, the failed module is attributed, and the
+    re-run NOOPs everything already done — completing only the remainder.
+    Final state matches an unfaulted run's modules bit for bit."""
+    driver = {"name": "sim", "fault_plan": {"faults": [
+        {"op": "register_node", "match": {"hostname": "h-2"},
+         "kind": "fatal", "error": "apiserver lost quorum", "times": 1}]}}
+    doc, ckey = _fanout_doc("midwave", n_hosts=6, driver=driver)
+    ex = _quiet(parallelism=4)
+    with pytest.raises(FatalApplyError, match="apiserver lost quorum"):
+        ex.apply(doc)
+
+    j = load_executor_state(doc).journal
+    assert j["status"] == "failed"
+    assert j["failed"]["module"] == "node_bare-metal_c1_h-2"
+    assert j["failed"]["kind"] == "fatal"
+    done = set(j["completed"])
+    assert "cluster-manager" in done and ckey in done
+    assert "node_bare-metal_c1_h-2" not in done
+
+    # Resume: completed modules NOOP; only the remainder applies.
+    plan = ex.apply(doc)
+    for name in done:
+        assert plan.actions[name] is PlanAction.NOOP
+    assert plan.actions["node_bare-metal_c1_h-2"] is PlanAction.CREATE
+    j2 = load_executor_state(doc).journal
+    assert j2["status"] == "ok"
+    assert set(j2["completed"]) == set(j2["order"])
+
+    # The healed state's modules equal an unfaulted run's, bit for bit.
+    ref, _ = _fanout_doc("midwave-ref", n_hosts=6)
+    _quiet(parallelism=4).apply(ref)
+    healed = load_executor_state(doc).modules
+    assert json.dumps(healed, sort_keys=True) == json.dumps(
+        load_executor_state(ref).modules, sort_keys=True)
+
+
+def test_retrying_branch_does_not_stall_or_charge_siblings():
+    """Per-module backoff budgets: one flaking branch retries on its own
+    clock; every sibling completes with zero retries, and the flaker's
+    own budget (not an apply-wide one) governs the deadline."""
+    driver = {"name": "sim", "fault_plan": {"faults": [
+        {"op": "create_resource", "match": {"name": "h-1"},
+         "times": 2, "error": "instance boot failed"}]}}
+    doc, _ = _fanout_doc("flaky", n_hosts=6, driver=driver)
+    sleeps = []
+    ex = LocalExecutor(log=lambda m: None, parallelism=4,
+                       retry=RetryPolicy(max_retries=3, backoff=0.5,
+                                         deadline=1.5),
+                       sleep=sleeps.append)
+    # deadline 1.5 == exactly this module's own 0.5 + 1.0: an apply-wide
+    # budget shared with 5 siblings would not have survived.
+    ex.apply(doc)
+    assert sorted(sleeps) == [0.5, 1.0]
+    j = load_executor_state(doc).journal
+    assert j["retries"] == {"node_bare-metal_c1_h-1": 2}
+    assert j["status"] == "ok" and j["failed"] is None
+    assert j["backoff_total"] == pytest.approx(1.5)
+
+
+# ------------------------------------------------- per-module fault anchors
+
+def test_fault_plan_module_scoped_rules_are_interleaving_safe():
+    """`module` + `at_module_op` anchors fire on a module's OWN op index,
+    not the racy global clock: the same rule fires identically at any
+    parallelism (pinned by firing it under scopes driven in both
+    orders)."""
+    spec = {"faults": [{"op": "create_resource", "module": "mod-b",
+                        "at_module_op": 2, "times": 1,
+                        "error": "second op of b"}]}
+    for order in (("mod-a", "mod-b"), ("mod-b", "mod-a")):
+        sim = CloudSimulator(fault_plan=spec)
+        fired = []
+        for mod in order:
+            with sim.module_scope(mod):
+                sim.create_resource("net", f"{mod}-r1")
+                try:
+                    sim.create_resource("net", f"{mod}-r2")
+                except Exception as e:
+                    fired.append((mod, str(e)))
+        assert [f[0] for f in fired] == ["mod-b"]
+        assert "second op of b" in fired[0][1]
+        # Per-module op counters serialize with the state.
+        revived = CloudSimulator(sim.to_dict())
+        assert revived.module_ops["mod-a"] == 2
+
+
+def test_at_module_op_requires_module_anchor():
+    """An at_module_op rule without a module would fire on whichever
+    module reaches that index first — rejected at plan build."""
+    with pytest.raises(ValueError, match="must name its module"):
+        FaultPlan({"faults": [{"op": "create_resource", "at_module_op": 2}]})
+
+
+def test_effective_workers_clamps_non_parallel_drivers():
+    """Drivers that don't declare the parallel-apply contract (real
+    subprocess provisioners like local-k8s) run serial regardless of the
+    requested width; the simulator keeps it."""
+    class SubprocessDriver:  # no SUPPORTS_PARALLEL_APPLY attr
+        fault_plan = None
+
+    ex = _quiet(parallelism=8)
+    assert ex._effective_workers(SubprocessDriver(), None, 5) == 1
+    assert ex._effective_workers(CloudSimulator(), None, 5) == 8
+    assert ex._effective_workers(CloudSimulator(), 2, 5) == 2
+
+    from triton_kubernetes_tpu.executor.k8s_local import LocalK8sDriver
+
+    assert LocalK8sDriver.SUPPORTS_PARALLEL_APPLY is False
+
+
+def test_worker_module_spans_keep_apply_parent():
+    """Module spans opened on wavefront worker threads still nest under
+    the apply span in the trace export (Logger.under adoption)."""
+    import io
+
+    from triton_kubernetes_tpu.utils.logging import Logger
+    from triton_kubernetes_tpu.utils.trace import TraceCollector
+
+    for par in (1, 4):
+        trace = TraceCollector()
+        logger = Logger(stream=io.StringIO(), trace=trace)
+        doc, _ = _fanout_doc(f"spans-{par}", n_hosts=4)
+        ex = LocalExecutor(logger=logger, parallelism=par, sleep=_no_sleep)
+        ex.apply(doc)
+        paths = {e["args"]["path"] for e in trace.events()
+                 if e["name"].startswith("module.")}
+        assert paths and all(p.startswith("apply/module.") for p in paths)
+
+
+def test_op_latency_knob_is_off_by_default_and_serializes():
+    sim = CloudSimulator()
+    assert "op_latency" not in sim.to_dict()
+    t0 = time.perf_counter()
+    for i in range(50):
+        sim.create_resource("net", f"r{i}")
+    assert time.perf_counter() - t0 < 0.5  # no hidden sleeps
+
+    timed = CloudSimulator(fault_plan=None, op_latency=0.01)
+    t0 = time.perf_counter()
+    timed.create_resource("net", "slow")
+    assert time.perf_counter() - t0 >= 0.01
+    assert timed.to_dict()["op_latency"] == 0.01
+    # Round-trips with the state, and per-op maps resolve with "*".
+    assert CloudSimulator(timed.to_dict()).op_latency == 0.01
+    mapped = CloudSimulator(op_latency={"register_node": 0.5, "*": 0.0})
+    assert mapped._op_latency_s("register_node") == 0.5
+    assert mapped._op_latency_s("create_resource") == 0.0
+
+
+# -------------------------------------------------------------- destroy
+
+def test_destroy_journals_and_saves_per_module():
+    """Destroy parity with apply: a v2 journal of kind=destroy with
+    per-module durations, and the duration histogram observes every
+    module torn down."""
+    metrics.configure()
+    doc, ckey = _fanout_doc("dj", n_hosts=2)
+    ex = _quiet(parallelism=1)
+    ex.apply(doc)
+    targets = [f"node_bare-metal_c1_h-{i}" for i in range(2)] + [ckey]
+    ex.destroy(doc, targets=targets)
+    est = load_executor_state(doc)
+    j = est.journal
+    assert j["version"] == 2 and j["kind"] == "destroy"
+    assert j["status"] == "ok"
+    assert set(j["completed"]) == set(targets)
+    # Dependents-first: the cluster is torn down last.
+    assert j["completed"][-1] == ckey
+    assert j["wave"][ckey] == 1  # waits for both hosts (wave 0)
+    assert set(j["durations"]) == set(targets)
+    hist = metrics.histogram("tk8s_module_destroy_duration_seconds")
+    for t in targets:
+        assert hist.count(module=t) == 1
+    assert metrics.counter("tk8s_destroys_total").value(status="ok") == 1
+    # Manager survived.
+    assert ex.output(doc, "cluster-manager")["manager_url"]
+
+
+def test_killed_destroy_resumes_over_survivors():
+    """A destroy that dies mid-wave persists what it tore down (state is
+    saved per removed module), so the re-run destroys only the
+    survivors — the 'killed destroy cannot resume' gap."""
+    doc, ckey = _fanout_doc("dk", n_hosts=3)
+    ex = _quiet(parallelism=1)
+    ex.apply(doc)
+    # Arm a fatal fault on the SECOND host's deregistration (destroy-path
+    # op), after the first host was fully removed and saved.
+    est = load_executor_state(doc)
+    est.cloud["fault_plan"] = {"faults": [
+        {"op": "deregister_node", "match": {"hostname": "h-1"},
+         "kind": "fatal", "error": "control plane gone", "times": 1}]}
+    from triton_kubernetes_tpu.executor.engine import save_executor_state
+
+    save_executor_state(doc, est)
+
+    with pytest.raises(Exception, match="control plane gone"):
+        ex.destroy(doc)
+    j = load_executor_state(doc).journal
+    assert j["kind"] == "destroy" and j["status"] == "failed"
+    assert j["failed"]["module"] == "node_bare-metal_c1_h-1"
+    # Serial destroy walks reversed topo order (h-2 first): h-2 was torn
+    # down and saved before h-1 faulted.
+    assert "node_bare-metal_c1_h-2" in j["completed"]
+    # The torn-down host is really gone from persisted state; survivors
+    # remain for the resume.
+    survivors = set(load_executor_state(doc).modules)
+    assert "node_bare-metal_c1_h-2" not in survivors
+    assert {"cluster-manager", ckey,
+            "node_bare-metal_c1_h-1"} <= survivors
+
+    ex.destroy(doc)  # fault exhausted: the resume finishes the graph
+    with pytest.raises(KeyError):
+        ex.output(doc, "cluster-manager")
+
+
+def test_parallel_destroy_matches_serial_destroy():
+    """Reverse wavefront at width 8 ends where serial destroy ends: the
+    whole graph gone and the state file deleted."""
+    for par in (1, 8):
+        doc, ckey = _fanout_doc(f"pd-{par}", n_hosts=6)
+        ex = _quiet(parallelism=par)
+        ex.apply(doc)
+        ex.destroy(doc)
+        assert _MEMORY_STATES.get(f"pd-{par}") is None  # state file gone

@@ -108,15 +108,21 @@ def choose_executor(resolver: InputResolver, logger):
     if kind == "local":
         from ..executor.engine import RetryPolicy
 
+        # Wavefront width (terraform's -parallelism analog, default 10
+        # there; 4 here). 1 reproduces the serial apply exactly.
+        workers = (int(cfg.get("parallelism"))
+                   if cfg.is_set("parallelism") else 4)
         return LocalExecutor(log=logger.info, logger=logger,
-                             retry=RetryPolicy.from_config(cfg))
+                             retry=RetryPolicy.from_config(cfg),
+                             parallelism=workers)
     if kind == "terraform":
         from ..executor.terraform import TerraformExecutor
 
-        # The retry/backoff knobs belong to the in-process engine; a real
-        # terraform run manages its own retries. Explicitly-set knobs must
-        # not be silently inert.
-        for knob in ("max_retries", "apply_deadline", "retry_backoff"):
+        # The retry/backoff/parallelism knobs belong to the in-process
+        # engine; a real terraform run manages its own. Explicitly-set
+        # knobs must not be silently inert.
+        for knob in ("max_retries", "apply_deadline", "retry_backoff",
+                     "parallelism"):
             if cfg.is_set(knob):
                 logger.log("warn",
                            f"{knob} has no effect with executor: terraform "
@@ -157,8 +163,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-module retries for transient apply faults "
                         "(default: 3; config key max_retries)")
     p.add_argument("--apply-deadline", type=float, metavar="SECONDS",
-                   help="cap on total retry backoff per apply "
+                   help="cap on total retry backoff per module apply "
                         "(default: 120; config key apply_deadline)")
+    p.add_argument("--parallelism", type=int, metavar="N",
+                   help="max modules applied/destroyed concurrently once "
+                        "their dependencies are satisfied (default: 4; "
+                        "1 = serial; config key parallelism)")
 
     sub = p.add_subparsers(dest="command")
 
@@ -249,6 +259,12 @@ def main(argv: Optional[List[str]] = None,
         config.set("max_retries", args.max_retries)
     if args.apply_deadline is not None:
         config.set("apply_deadline", args.apply_deadline)
+    if args.parallelism is not None:
+        if args.parallelism < 1:
+            print(f"error: --parallelism must be >= 1, got "
+                  f"{args.parallelism}", file=sys.stderr)
+            return 2
+        config.set("parallelism", args.parallelism)
 
     if prompter is None:
         prompter = InteractivePrompter()

@@ -23,10 +23,14 @@ It models, deterministically (no wall clock, no randomness):
 
 from __future__ import annotations
 
+import contextlib
+import copy
 import hashlib
 import os
 import signal as _signal
-from typing import Any, Dict, List, Optional
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
 
 from ..manager import protocol
 from ..utils import metrics
@@ -86,6 +90,30 @@ class FaultPlan:
     ``match`` values substring-match the operation's info fields (type,
     name, cluster, pool, hostname, ...); an absent ``match`` matches every
     call of that op; ``op: "*"`` matches every mutating operation.
+
+    **Per-module anchors (interleaving-safe).** Under the wavefront apply
+    scheduler the *global* mutation clock interleaves differently at every
+    ``--parallelism``, so rules anchored on it (``at_op``) are only
+    deterministic for serial applies. Each rule may instead carry:
+
+    * ``module`` — substring-match against the module key the engine has
+      scoped around the current apply (``CloudSimulator.module_scope``);
+    * ``at_module_op`` — the 1-based index of the operation *within that
+      module's own op sequence* (op rules: fire exactly at that index;
+      preempt rules: fire once the scoped module's counter reaches it).
+
+    A module's own op sequence is fixed by its config, so per-module
+    anchors fire identically at any parallelism — the property the
+    parallel-vs-serial bitwise-equality tests pin. ``at_module_op``
+    requires ``module`` (an anchor that floats to whichever module gets
+    there first would defeat the point; rejected at plan build).
+
+    As with the global clock, a pending preemption (and a
+    graceful-warning reclaim in particular) only fires when its
+    anchoring clock next *advances*: a ``grace_ops`` window that
+    extends past the anchored module's (or, for ``at_op``, the whole
+    apply's) last mutation never fires — budget grace windows inside
+    the ops the run will actually make.
     """
 
     def __init__(self, spec: Optional[Dict[str, Any]] = None):
@@ -95,49 +123,76 @@ class FaultPlan:
             r.setdefault("times", 1)
             r.setdefault("kind", "transient")
             r.setdefault("fired", 0)
+            if "at_module_op" in r and not r.get("module"):
+                # Without a module anchor the per-module op index matches
+                # whichever module reaches it first — exactly the
+                # interleaving-dependence this anchor exists to remove.
+                raise ValueError(
+                    "fault rule with at_module_op must name its module "
+                    f"(got {rule!r})")
             self.rules.append(r)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"faults": [dict(r) for r in self.rules]}
 
     @staticmethod
-    def _matches(rule: Dict[str, Any], op: str, info: Dict[str, Any]) -> bool:
+    def _matches(rule: Dict[str, Any], op: str, info: Dict[str, Any],
+                 module: str, module_op: int) -> bool:
         if rule.get("op") not in ("*", op):
+            return False
+        if "module" in rule and str(rule["module"]) not in module:
+            return False
+        if "at_module_op" in rule and int(rule["at_module_op"]) != module_op:
             return False
         for key, want in (rule.get("match") or {}).items():
             if str(want) not in str(info.get(key, "")):
                 return False
         return True
 
-    def check(self, sim: "CloudSimulator", op: str,
-              info: Dict[str, Any]) -> None:
+    @staticmethod
+    def _preempt_due(rule: Dict[str, Any], sim: "CloudSimulator",
+                     module: str, module_op: int, grace: int = 0) -> bool:
+        """Whether a preempt rule's anchor (+grace window) has passed —
+        the global mutation clock by default, the scoped module's own op
+        counter when the rule carries ``at_module_op``."""
+        if "at_module_op" in rule:
+            if str(rule.get("module", "")) not in module:
+                return False
+            return module_op >= int(rule["at_module_op"]) + grace
+        return sim.ops >= int(rule.get("at_op", 0)) + grace
+
+    def check(self, sim: "CloudSimulator", op: str, info: Dict[str, Any],
+              module: str = "", module_op: int = 0) -> None:
         """Called by the simulator before each mutating operation (the
         mutation clock has already ticked). Fires due preemptions, then
-        raises if an armed fault rule matches this call."""
+        raises if an armed fault rule matches this call. ``module`` /
+        ``module_op`` identify the engine-scoped module issuing the call
+        and its per-module op index (0 when unscoped)."""
         for rule in self.rules:
             if rule.get("op") != "preempt" or rule["fired"]:
                 continue
-            at = int(rule.get("at_op", 0))
             if rule.get("mode") == "graceful-warning":
                 # The GKE contract: SIGTERM lands first, the reclaim
                 # follows after the grace window. Both anchors are
-                # mutation-clock ticks, so the sequence is deterministic
-                # and the warned/fired flags serialize with the state.
-                if not rule.get("warned") and sim.ops >= at:
+                # deterministic clock ticks, so the sequence repeats
+                # exactly and the warned/fired flags serialize.
+                if not rule.get("warned") and self._preempt_due(
+                        rule, sim, module, module_op):
                     rule["warned"] = 1
                     sim.warn_preemption(rule["slice_id"],
                                         pid=rule.get("notify_pid"),
                                         sig=rule.get("signal", "SIGTERM"))
-                if sim.ops >= at + int(rule.get("grace_ops", 0)):
+                if self._preempt_due(rule, sim, module, module_op,
+                                     grace=int(rule.get("grace_ops", 0))):
                     rule["fired"] = 1
                     sim.preempt_slice(rule["slice_id"])
-            elif sim.ops >= at:
+            elif self._preempt_due(rule, sim, module, module_op):
                 rule["fired"] = 1
                 sim.preempt_slice(rule["slice_id"])
         for rule in self.rules:
             if rule.get("op") == "preempt" or rule["fired"] >= rule["times"]:
                 continue
-            if self._matches(rule, op, info):
+            if self._matches(rule, op, info, module, module_op):
                 rule["fired"] += 1
                 metrics.counter("tk8s_cloudsim_faults_total").inc(
                     kind=rule["kind"])
@@ -149,8 +204,16 @@ class FaultPlan:
 
 
 class CloudSimulator:
+    # Declares the driver safe for the engine's wavefront scheduler:
+    # every mutator is atomic under the instance lock and snapshot()
+    # gives a consistent persistable view mid-flight. Drivers doing real
+    # external work (subprocess provisioners) opt out and the engine
+    # clamps them to serial.
+    SUPPORTS_PARALLEL_APPLY = True
+
     def __init__(self, state: Optional[Dict[str, Any]] = None,
-                 fault_plan: Optional[Dict[str, Any]] = None):
+                 fault_plan: Optional[Dict[str, Any]] = None,
+                 op_latency: Optional[Any] = None):
         s = state or {}
         self.resources: Dict[str, Dict[str, Any]] = s.get("resources", {})
         self.managers: Dict[str, Dict[str, Any]] = s.get("managers", {})
@@ -161,6 +224,26 @@ class CloudSimulator:
         # It anchors at_op preemptions and lets tests assert the zero-
         # mutation no-op contract without wrapping the driver.
         self.ops: int = s.get("ops", 0)
+        # Per-module op counters (ticked only inside an engine
+        # ``module_scope``): the interleaving-independent clock that
+        # per-module fault anchors fire on. Serialized with the state so
+        # module-scoped fault sequences survive round-trips like the
+        # global clock does.
+        self.module_ops: Dict[str, int] = s.get("module_ops", {})
+        # One re-entrant lock makes every mutating operation atomic, so
+        # the wavefront apply scheduler can drive modules concurrently:
+        # clock tick + fault check + state mutation are indivisible.
+        self._lock = threading.RLock()
+        self._scope = threading.local()
+        # Opt-in deterministic per-op simulated latency (seconds): a float
+        # applied to every mutating op, or an {op: seconds} map with "*"
+        # as the default. Off (0) unless configured; serialized with the
+        # sim so a reloaded state keeps the same timing model. The sleep
+        # happens OUTSIDE the lock, so concurrent modules overlap their
+        # latency — which is exactly what makes apply concurrency
+        # measurable without a real cloud.
+        self.op_latency: Optional[Any] = (
+            op_latency if op_latency is not None else s.get("op_latency"))
         # Persisted plan state (with decremented fire-counts) wins over the
         # UNCHANGED spec it came from, so fault sequences stay deterministic
         # across the save/load round-trip of the executor state — but a
@@ -175,31 +258,77 @@ class CloudSimulator:
         else:
             self.fault_plan = None
 
+    @contextlib.contextmanager
+    def module_scope(self, module_key: str) -> Iterator[None]:
+        """Attribute this thread's mutations to one module: ticks that
+        module's own op counter and lets fault rules anchor on it
+        (``module`` / ``at_module_op``). The engine wraps each module
+        apply/destroy in this scope; the scope is thread-local, so
+        concurrent modules never see each other's attribution."""
+        prev = getattr(self._scope, "module", "")
+        self._scope.module = module_key
+        try:
+            yield
+        finally:
+            self._scope.module = prev
+
+    def _op_latency_s(self, op: str) -> float:
+        spec = self.op_latency
+        if not spec:
+            return 0.0
+        if isinstance(spec, dict):
+            return float(spec.get(op, spec.get("*", 0.0)))
+        return float(spec)
+
     def _mutate(self, op: str, **info: Any) -> None:
         """Tick the mutation clock and give the fault plan its shot. Every
         mutating operation calls this first, before touching state, so an
         injected failure always leaves the op not-yet-applied (the module
         retries it via its own idempotent create-or-get)."""
-        self.ops += 1
-        metrics.counter("tk8s_cloudsim_ops_total").inc(op=op)
-        if self.fault_plan is not None:
-            self.fault_plan.check(self, op, info)
+        module = getattr(self._scope, "module", "")
+        with self._lock:
+            self.ops += 1
+            module_op = 0
+            if module:
+                module_op = self.module_ops.get(module, 0) + 1
+                self.module_ops[module] = module_op
+            metrics.counter("tk8s_cloudsim_ops_total").inc(op=op)
+            if self.fault_plan is not None:
+                if module:
+                    info = dict(info, module=module)
+                self.fault_plan.check(self, op, info, module=module,
+                                      module_op=module_op)
+        latency = self._op_latency_s(op)
+        if latency > 0:
+            time.sleep(latency)
 
     # ------------------------------------------------------------- serialization
     def to_dict(self) -> Dict[str, Any]:
-        out = {
-            "resources": self.resources,
-            "managers": self.managers,
-            "clusters": self.clusters,
-            "manifests": self.manifests,
-            "serial": self.serial,
-            "ops": self.ops,
-        }
-        if self.fault_plan is not None:
-            out["fault_plan"] = self.fault_plan.to_dict()
-            if self._fault_spec is not None:
-                out["fault_plan_spec"] = self._fault_spec
-        return out
+        with self._lock:
+            out = {
+                "resources": self.resources,
+                "managers": self.managers,
+                "clusters": self.clusters,
+                "manifests": self.manifests,
+                "serial": self.serial,
+                "ops": self.ops,
+            }
+            if self.module_ops:
+                out["module_ops"] = self.module_ops
+            if self.op_latency:
+                out["op_latency"] = self.op_latency
+            if self.fault_plan is not None:
+                out["fault_plan"] = self.fault_plan.to_dict()
+                if self._fault_spec is not None:
+                    out["fault_plan_spec"] = self._fault_spec
+            return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """A deep, point-in-time copy of :meth:`to_dict` taken under the
+        lock — what the engine persists after each completed module while
+        sibling modules may still be mutating the live dicts."""
+        with self._lock:
+            return copy.deepcopy(self.to_dict())
 
     # ---------------------------------------------------------------- resources
     def _rkey(self, rtype: str, name: str) -> str:
@@ -208,18 +337,29 @@ class CloudSimulator:
     def create_resource(self, rtype: str, name: str, **attrs: Any) -> Dict[str, Any]:
         """Idempotent create-or-get of a generic cloud resource."""
         self._mutate("create_resource", type=rtype, name=name)
-        return self._create_resource_record(rtype, name, **attrs)
+        with self._lock:
+            return self._create_resource_record(rtype, name, **attrs)
 
     def _create_resource_record(self, rtype: str, name: str,
                                 **attrs: Any) -> Dict[str, Any]:
         """The create-or-get body, clock-free — for compound ops that have
-        already ticked the mutation clock once for the whole call."""
+        already ticked the mutation clock once for the whole call.
+
+        Generated ids and ips are **content-addressed** (derived from the
+        resource key, not a global creation counter), so the applied state
+        is byte-identical no matter how concurrent modules interleave
+        their creations — the wavefront scheduler's bitwise-parity
+        contract rests on this.
+        """
         key = self._rkey(rtype, name)
         if key not in self.resources:
             self.serial += 1
-            rec = {"type": rtype, "name": name, "id": f"{rtype}-{self.serial:04d}", **attrs}
+            rec = {"type": rtype, "name": name,
+                   "id": f"{rtype}-{_token('id', rtype, name)[:8]}", **attrs}
             if rtype.endswith("instance") or rtype.endswith("machine"):
-                rec.setdefault("ip", f"10.0.{(self.serial >> 8) & 255}.{self.serial & 255}")
+                addr = int(_token("ip", rtype, name)[:6], 16)
+                rec.setdefault("ip", f"10.{(addr >> 16) & 255}."
+                                     f"{(addr >> 8) & 255}.{addr & 255}")
             self.resources[key] = rec
         else:
             self.resources[key].update(attrs)
@@ -230,16 +370,17 @@ class CloudSimulator:
 
     def delete_resource(self, rtype: str, name: str) -> None:
         self._mutate("delete_resource", type=rtype, name=name)
-        self.resources.pop(self._rkey(rtype, name), None)
-        if rtype == "manager":
-            self.managers.pop(name, None)
-        if rtype == "cluster":
-            # "cluster" resources are keyed by cluster *id*, so deleting one
-            # module's registration can never hit a same-named cluster under
-            # another manager/provider.
-            if name in self.clusters:
-                del self.clusters[name]
-                self.manifests.pop(name, None)
+        with self._lock:
+            self.resources.pop(self._rkey(rtype, name), None)
+            if rtype == "manager":
+                self.managers.pop(name, None)
+            if rtype == "cluster":
+                # "cluster" resources are keyed by cluster *id*, so deleting
+                # one module's registration can never hit a same-named
+                # cluster under another manager/provider.
+                if name in self.clusters:
+                    del self.clusters[name]
+                    self.manifests.pop(name, None)
 
     # ------------------------------------------------------- control plane (mgr)
     def bootstrap_manager(self, name: str, url: str) -> Dict[str, str]:
@@ -251,17 +392,20 @@ class CloudSimulator:
         ``~/rancher_api_key``.
         """
         self._mutate("bootstrap_manager", name=name, url=url)
-        if name not in self.managers:
-            self.managers[name] = {
-                "name": name,
-                "url": url,
-                # Shared credential derivation with the real control plane
-                # (manager/protocol.py); empty salt keeps tests deterministic.
-                **protocol.mint_credentials(name),
-                "clusters": [],
-            }
-        self.managers[name]["url"] = url
-        return {k: self.managers[name][k] for k in ("url", "access_key", "secret_key")}
+        with self._lock:
+            if name not in self.managers:
+                self.managers[name] = {
+                    "name": name,
+                    "url": url,
+                    # Shared credential derivation with the real control
+                    # plane (manager/protocol.py); empty salt keeps tests
+                    # deterministic.
+                    **protocol.mint_credentials(name),
+                    "clusters": [],
+                }
+            self.managers[name]["url"] = url
+            return {k: self.managers[name][k]
+                    for k in ("url", "access_key", "secret_key")}
 
     def _find_manager(self, url: str) -> Dict[str, Any]:
         for m in self.managers.values():
@@ -279,14 +423,20 @@ class CloudSimulator:
         """
         self._mutate("create_or_get_cluster", name=cluster_name,
                      url=manager_url)
-        mgr = self._find_manager(manager_url)
-        # Shared semantic core with the real control plane: same idempotency,
-        # same id/token/CA-checksum derivation (manager/protocol.py).
-        cluster = protocol.create_or_get_cluster(
-            self.clusters, mgr["name"], cluster_name, **attrs)
-        if cluster["id"] not in mgr["clusters"]:
-            mgr["clusters"].append(cluster["id"])
-        return cluster
+        with self._lock:
+            mgr = self._find_manager(manager_url)
+            # Shared semantic core with the real control plane: same
+            # idempotency, same id/token/CA-checksum derivation
+            # (manager/protocol.py).
+            cluster = protocol.create_or_get_cluster(
+                self.clusters, mgr["name"], cluster_name, **attrs)
+            if cluster["id"] not in mgr["clusters"]:
+                # Kept sorted, not append-ordered: parallel cluster modules
+                # register in whatever order they finish, and the persisted
+                # state must not depend on that race.
+                mgr["clusters"].append(cluster["id"])
+                mgr["clusters"].sort()
+            return cluster
 
     def register_node(self, registration_token: str, hostname: str,
                       roles: List[str], labels: Optional[Dict[str, str]] = None,
@@ -298,12 +448,13 @@ class CloudSimulator:
         --worker|--etcd|--controlplane``). Token+checksum pinning enforced.
         """
         self._mutate("register_node", hostname=hostname)
-        try:
-            return protocol.register_node(
-                self.clusters, registration_token, hostname, roles,
-                labels, ca_checksum)
-        except protocol.ProtocolError as e:
-            raise CloudSimError(str(e)) from e
+        with self._lock:
+            try:
+                return protocol.register_node(
+                    self.clusters, registration_token, hostname, roles,
+                    labels, ca_checksum)
+            except protocol.ProtocolError as e:
+                raise CloudSimError(str(e)) from e
 
     def deregister_node(self, hostname: str) -> None:
         """Remove a host's registration (and its recorded health) from
@@ -311,8 +462,9 @@ class CloudSimulator:
         Hostnames are unique per state doc (the create-node numbering
         contract), so a plain scan is unambiguous."""
         self._mutate("deregister_node", hostname=hostname)
-        for c in self.clusters.values():
-            c["nodes"].pop(hostname, None)
+        with self._lock:
+            for c in self.clusters.values():
+                c["nodes"].pop(hostname, None)
 
     def cluster_by_id(self, cluster_id: str) -> Dict[str, Any]:
         if cluster_id not in self.clusters:
@@ -326,10 +478,11 @@ class CloudSimulator:
         readiness flip or a failed agent heartbeat reports)."""
         self._mutate("set_node_health", cluster=cluster_id,
                      hostname=hostname)
-        c = self.cluster_by_id(cluster_id)
-        if hostname not in c["nodes"]:
-            raise CloudSimError(f"no node {hostname!r} in {cluster_id!r}")
-        c["nodes"][hostname]["health"] = {"ready": ready, "reason": reason}
+        with self._lock:
+            c = self.cluster_by_id(cluster_id)
+            if hostname not in c["nodes"]:
+                raise CloudSimError(f"no node {hostname!r} in {cluster_id!r}")
+            c["nodes"][hostname]["health"] = {"ready": ready, "reason": reason}
 
     def node_health(self, cluster_id: str) -> Dict[str, Dict[str, Any]]:
         """{node: {ready, reason}} — the consumer side of the health story
@@ -346,15 +499,18 @@ class CloudSimulator:
         nodes come from provider-managed node pools. Re-creates update attrs
         in place (k8s_version bumps etc.), preserving node pools."""
         self._mutate("create_hosted_cluster", type=kind, name=name)
-        key = self._rkey(f"{kind}_cluster", name)
-        if key not in self.resources:
-            # Clock-free inner create: this compound op already ticked once.
-            self._create_resource_record(f"{kind}_cluster", name,
-                                         endpoint=f"https://{name}.{kind}.local",
-                                         node_pools={}, **attrs)
-        else:
-            self.resources[key].update(attrs)
-        return self.resources[key]
+        with self._lock:
+            key = self._rkey(f"{kind}_cluster", name)
+            if key not in self.resources:
+                # Clock-free inner create: this compound op already ticked
+                # once.
+                self._create_resource_record(
+                    f"{kind}_cluster", name,
+                    endpoint=f"https://{name}.{kind}.local",
+                    node_pools={}, **attrs)
+            else:
+                self.resources[key].update(attrs)
+            return self.resources[key]
 
     def create_node_pool(self, kind: str, cluster_name: str, pool_name: str,
                          node_count: int, node_labels: Optional[List[Dict[str, str]]] = None,
@@ -363,16 +519,20 @@ class CloudSimulator:
         (this is where TPU slice/ICI-coordinate labels land)."""
         self._mutate("create_node_pool", type=kind, cluster=cluster_name,
                      pool=pool_name)
-        cluster = self.get_resource(f"{kind}_cluster", cluster_name)
-        if cluster is None:
-            raise CloudSimError(f"no {kind} cluster {cluster_name!r}")
-        nodes = []
-        for i in range(node_count):
-            labels = dict(node_labels[i]) if node_labels and i < len(node_labels) else {}
-            nodes.append({"name": f"{cluster_name}-{pool_name}-{i}", "labels": labels})
-        pool = {"name": pool_name, "node_count": node_count, "nodes": nodes, **attrs}
-        cluster["node_pools"][pool_name] = pool
-        return pool
+        with self._lock:
+            cluster = self.get_resource(f"{kind}_cluster", cluster_name)
+            if cluster is None:
+                raise CloudSimError(f"no {kind} cluster {cluster_name!r}")
+            nodes = []
+            for i in range(node_count):
+                labels = (dict(node_labels[i])
+                          if node_labels and i < len(node_labels) else {})
+                nodes.append({"name": f"{cluster_name}-{pool_name}-{i}",
+                              "labels": labels})
+            pool = {"name": pool_name, "node_count": node_count,
+                    "nodes": nodes, **attrs}
+            cluster["node_pools"][pool_name] = pool
+            return pool
 
     # ---------------------------------------------------------------- manifests
     def apply_manifest(self, cluster_id: str, manifest: Dict[str, Any]) -> None:
@@ -387,24 +547,34 @@ class CloudSimulator:
         from ..topology.validate import validate_manifest
 
         validate_manifest(manifest)
-        objs = self.manifests.setdefault(cluster_id, [])
-        ident = (manifest.get("kind"), manifest.get("metadata", {}).get("name"))
-        for i, existing in enumerate(objs):
-            if (existing.get("kind"), existing.get("metadata", {}).get("name")) == ident:
-                objs[i] = manifest
-                return
-        objs.append(manifest)
+        with self._lock:
+            objs = self.manifests.setdefault(cluster_id, [])
+            ident = (manifest.get("kind"),
+                     manifest.get("metadata", {}).get("name"))
+            for i, existing in enumerate(objs):
+                if (existing.get("kind"),
+                        existing.get("metadata", {}).get("name")) == ident:
+                    objs[i] = manifest
+                    return
+            objs.append(manifest)
+            # Kept sorted by (kind, name), not append-ordered: parallel
+            # modules installing into the same cluster must leave the
+            # same manifest list no matter which finished first.
+            objs.sort(key=lambda m: (str(m.get("kind", "")),
+                                     str(m.get("metadata", {}).get("name", ""))))
 
     def delete_manifest(self, cluster_id: str, kind: str, name: str) -> bool:
         """kubectl-delete analog; returns True if the object existed."""
         self._mutate("delete_manifest", cluster=cluster_id, kind=kind,
                      name=name)
-        objs = self.manifests.get(cluster_id, [])
-        for i, m in enumerate(objs):
-            if (m.get("kind"), m.get("metadata", {}).get("name")) == (kind, name):
-                del objs[i]
-                return True
-        return False
+        with self._lock:
+            objs = self.manifests.get(cluster_id, [])
+            for i, m in enumerate(objs):
+                if (m.get("kind"),
+                        m.get("metadata", {}).get("name")) == (kind, name):
+                    del objs[i]
+                    return True
+            return False
 
     def get_manifests(self, cluster_id: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
         objs = self.manifests.get(cluster_id, [])
@@ -439,9 +609,10 @@ class CloudSimulator:
         actual SIGTERM, not a mock. Like :meth:`preempt_slice`, this IS
         the fault event: no clock tick, no fault-plan re-entry."""
         hit: List[str] = []
-        for _, pool in self._slice_pools(slice_id):
-            pool["preempt_warning"] = True
-            hit.extend(n["name"] for n in pool.get("nodes", []))
+        with self._lock:
+            for _, pool in self._slice_pools(slice_id):
+                pool["preempt_warning"] = True
+                hit.extend(n["name"] for n in pool.get("nodes", []))
         if not hit:
             raise CloudSimError(f"no node pool carries slice {slice_id!r}")
         metrics.counter("tk8s_cloudsim_preempt_warnings_total").inc()
@@ -462,12 +633,13 @@ class CloudSimulator:
         IS the fault), so it never ticks the mutation clock or re-enters
         the fault plan."""
         hit: List[str] = []
-        for _, pool in self._slice_pools(slice_id):
-            pool["preempted"] = True
-            for node in pool.get("nodes", []):
-                node["preempted"] = True
-                node["labels"] = {}
-                hit.append(node["name"])
+        with self._lock:
+            for _, pool in self._slice_pools(slice_id):
+                pool["preempted"] = True
+                for node in pool.get("nodes", []):
+                    node["preempted"] = True
+                    node["labels"] = {}
+                    hit.append(node["name"])
         if not hit:
             raise CloudSimError(f"no node pool carries slice {slice_id!r}")
         metrics.counter("tk8s_cloudsim_preemptions_total").inc()
@@ -478,10 +650,11 @@ class CloudSimulator:
         replacement (kubectl cordon analog) — repair must stop new pods
         landing on a half-dead slice before it tears the pool down."""
         hit: List[str] = []
-        for _, pool in self._slice_pools(slice_id):
-            for node in pool.get("nodes", []):
-                node["cordoned"] = True
-                hit.append(node["name"])
+        with self._lock:
+            for _, pool in self._slice_pools(slice_id):
+                for node in pool.get("nodes", []):
+                    node["cordoned"] = True
+                    hit.append(node["name"])
         return hit
 
     def preempted_slices(self) -> Dict[str, Dict[str, Any]]:

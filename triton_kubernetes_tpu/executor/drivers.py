@@ -36,7 +36,11 @@ def _make_sim(cfg: Dict[str, Any], state: Dict[str, Any]) -> CloudSimulator:
     # deterministic fault injection; once armed, the plan's live state
     # (remaining fire-counts) rides the persisted cloud dict and wins over
     # the config spec, so fault sequences survive state round-trips.
-    return CloudSimulator(state, fault_plan=cfg.get("fault_plan"))
+    # ``op_latency`` (seconds per mutating op, or an {op: seconds} map)
+    # arms the opt-in deterministic latency model — how apply concurrency
+    # is measured without a real cloud.
+    return CloudSimulator(state, fault_plan=cfg.get("fault_plan"),
+                          op_latency=cfg.get("op_latency"))
 
 
 def _make_local_k8s(cfg: Dict[str, Any], state: Dict[str, Any]):

@@ -173,6 +173,11 @@ class LocalK8sDriver(CloudSimulator):
     """CloudSimulator subclass whose Kubernetes-facing surface is real."""
 
     DRIVER_NAME = "local-k8s"
+    # Real kind/k3d/kubectl subprocesses: the in-memory bookkeeping is
+    # lock-protected (inherited), but concurrent cluster provisioning
+    # against one docker daemon is not a supported contract — the engine
+    # clamps applies against this driver to serial.
+    SUPPORTS_PARALLEL_APPLY = False
 
     def __init__(self, state: Optional[Dict[str, Any]] = None,
                  provisioner: str = "", runner: Runner = _run_subprocess,

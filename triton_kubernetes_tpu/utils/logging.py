@@ -13,6 +13,7 @@ No external deps: this is a deliberate small core, not a logging framework.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import sys
 import threading
@@ -98,6 +99,28 @@ class Logger:
     # ----------------------------------------------------------------- spans
     def span(self, name: str, **fields: Any) -> "Span":
         return Span(self, name, fields)
+
+    @contextlib.contextmanager
+    def under(self, span: Optional["Span"]):
+        """Adopt an open ``span`` as this thread's parent for the block.
+
+        Span stacks are thread-local, so work fanned out to worker
+        threads (the engine's wavefront scheduler) would otherwise log
+        and trace its child spans rootless — ``module.x`` instead of
+        ``apply/module.x``. No-op when ``span`` is None or already on
+        this thread's stack (the serial inline path)."""
+        stack = self._spans()
+        if span is None or span in stack:
+            yield
+            return
+        stack.append(span)
+        try:
+            yield
+        finally:
+            if stack and stack[-1] is span:
+                stack.pop()
+            elif span in stack:
+                stack.remove(span)
 
     def _spans(self) -> List["Span"]:
         stack = getattr(self._span_stack, "stack", None)

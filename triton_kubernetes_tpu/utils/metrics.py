@@ -58,6 +58,25 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
     "tk8s_applies_total": (
         "counter", "Whole-graph applies by terminal journal status",
         ("status",), None),
+    "tk8s_destroys_total": (
+        "counter", "Whole-graph/targeted destroys by terminal journal "
+        "status", ("status",), None),
+    "tk8s_module_destroy_duration_seconds": (
+        "histogram", "Wall-clock duration of one module destroy",
+        ("module",), DEFAULT_BUCKETS),
+    "tk8s_apply_in_flight": (
+        "gauge", "Modules currently in flight in the wavefront "
+        "apply/destroy scheduler (bounded by --parallelism)", (), None),
+    "tk8s_apply_waves_total": (
+        "counter", "Dependency waves (DAG depth levels) dispatched by "
+        "the wavefront scheduler", (), None),
+    "tk8s_apply_critical_path_seconds": (
+        "gauge", "Critical-path (longest dependency chain) seconds of "
+        "the most recent apply/destroy — the floor no parallelism can "
+        "beat", ("kind",), None),
+    "tk8s_apply_total_work_seconds": (
+        "gauge", "Sum of per-module durations of the most recent "
+        "apply/destroy — what a serial run would pay", ("kind",), None),
     "tk8s_state_saves_total": (
         "counter", "Executor-state (journal) saves by backend kind",
         ("backend",), None),
