@@ -50,6 +50,12 @@ CPU_ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_CPU_TIMEOUT", "360"))
 # time; the per-attempt cap in main() shrinks later attempts so the CPU
 # fallback budget is always preserved.
 TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
+# Cheap bounded backend-init probe (ROADMAP item 4a): before committing a
+# 480s attempt, a child that does NOTHING but initialize the backend must
+# come up within this budget. A dead tunnel is then classified
+# `tpu_probe:timeout@init` in ~a minute instead of eating every full
+# attempt. 0 disables the probe.
+TPU_PROBE_TIMEOUT = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "60"))
 # Single source of the headline config name (child + stage-3 error line).
 TPU_BENCH_CONFIG = "llama3-bench"
 CPU_BENCH_CONFIG = "llama-test"
@@ -222,6 +228,17 @@ def _child() -> None:
     }), flush=True)
 
 
+def _probe() -> None:
+    """Backend-init probe child: the interpreter + jax import + plugin
+    handshake and nothing else — exactly the `init` phase whose hangs
+    BENCH_r01–r05 paid for at full-attempt price. One JSON line."""
+    import jax
+
+    d = jax.devices()[0]
+    print(json.dumps({"probe_platform": d.platform,
+                      "device": d.device_kind}), flush=True)
+
+
 def _error_class(exc_or_text) -> str:
     """Compress a child failure into a short stable class name."""
     text = str(exc_or_text)
@@ -310,7 +327,36 @@ def main() -> None:
         # A leaked CPU pin (common in test jobs) must not let a CPU child
         # masquerade as the clean TPU headline number.
         tpu_platform = "tpu"
-    for attempt in range(TPU_ATTEMPTS):
+    # Stage 0: the bounded init probe. A backend that cannot even come up
+    # inside the probe budget forfeits every full TPU attempt — minutes of
+    # blind timeout become one attributable `tpu_probe:<class>` entry.
+    tpu_alive = True
+    if TPU_PROBE_TIMEOUT > 0:
+        cap = deadline - time.monotonic() - CPU_ATTEMPT_TIMEOUT - 30
+        probe_timeout = min(TPU_PROBE_TIMEOUT, max(cap, 0.0))
+        if probe_timeout >= 5:
+            print(f"[bench] TPU init probe (timeout {probe_timeout:.0f}s, "
+                  f"platform {tpu_platform})", file=sys.stderr, flush=True)
+            t0 = time.monotonic()
+            result, err, phase = _run_attempt(
+                ["--probe"], {"JAX_PLATFORMS": tpu_platform}, probe_timeout)
+            took = time.monotonic() - t0
+            if result is None or result.get("probe_platform") not in (
+                    "tpu", tpu_platform):
+                err = err or "unexpected_platform"
+                if not err.startswith("timeout@"):
+                    err = f"{err}@{phase}"
+                errors.append(f"tpu_probe:{err}")
+                tpu_alive = False
+                print(f"[bench] probe failed in {took:.0f}s ({err}); "
+                      f"skipping TPU attempts", file=sys.stderr, flush=True)
+            else:
+                print(f"[bench] probe ok in {took:.0f}s "
+                      f"({result.get('device', '?')})",
+                      file=sys.stderr, flush=True)
+        else:
+            errors.append("tpu_probe_skipped_budget_exhausted")
+    for attempt in range(TPU_ATTEMPTS if tpu_alive else 0):
         # Always reserve the CPU-fallback budget: a hung TPU attempt must
         # not starve stage 2, or the round records no measured number.
         cap = deadline - time.monotonic() - CPU_ATTEMPT_TIMEOUT - 30
@@ -372,7 +418,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--probe" in sys.argv:
+        _probe()
+    elif "--child" in sys.argv:
         _child()
     else:
         main()

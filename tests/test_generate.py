@@ -110,6 +110,87 @@ def test_sampling_temperature_and_topk():
     assert 0 <= int(r[0]) < 4
 
 
+def test_top_p_nucleus_pinned():
+    """Pinned top-p semantics: the nucleus is the smallest prob-sorted
+    prefix reaching top_p mass, the top token always survives, and the
+    same key always draws the same token."""
+    # softmax probs ~ [.73, .27, ~0, ~0]: top_p=0.6 -> nucleus == {0}.
+    logits = jnp.asarray([[10.0, 9.0, 0.0, -5.0]], jnp.float32)
+    for k in range(8):
+        t = sample_token(logits, jax.random.PRNGKey(k),
+                         temperature=1.0, top_p=0.6)
+        assert int(t[0]) == 0
+    # top_p=0.95 -> nucleus == {0, 1}: both appear, nothing else ever.
+    seen = {int(sample_token(logits, jax.random.PRNGKey(k),
+                             temperature=1.0, top_p=0.95)[0])
+            for k in range(64)}
+    assert seen == {0, 1}
+    # Determinism: one key, one draw.
+    a = sample_token(logits, jax.random.PRNGKey(3), temperature=1.0,
+                     top_p=0.95)
+    b = sample_token(logits, jax.random.PRNGKey(3), temperature=1.0,
+                     top_p=0.95)
+    assert int(a[0]) == int(b[0])
+    # Composes with top-k (k cuts first) and validates its domain.
+    t = sample_token(logits, jax.random.PRNGKey(0), temperature=2.0,
+                     top_k=1, top_p=0.99)
+    assert int(t[0]) == 0
+    with pytest.raises(ValueError, match="top_p"):
+        sample_token(logits, jax.random.PRNGKey(0), temperature=1.0,
+                     top_p=0.0)
+    # top_p=1.0 is a no-op: identical draws to the unfiltered path.
+    key = jax.random.PRNGKey(5)
+    assert int(sample_token(logits, key, temperature=3.0, top_p=1.0)[0]) \
+        == int(sample_token(logits, key, temperature=3.0)[0])
+
+
+def test_generate_accepts_top_p():
+    cfg, params = _setup()
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    out = generate(params, prompt, cfg, max_new_tokens=4,
+                   key=jax.random.PRNGKey(0), temperature=1.0, top_p=0.9)
+    assert out["tokens"].shape == (1, 4)
+
+
+def test_batched_prefill_right_pad_matches_unbatched():
+    """The ragged-batching contract the paged serving path rides on:
+    RIGHT-padded batched prefill reproduces each sequence's unbatched
+    logits at its own last real token (causally, pad tokens sit at
+    higher positions and cannot reach back)."""
+    cfg, params = _setup()
+    prompts = [[5, 7, 9, 11, 2], [3, 1, 4, 1, 5, 9, 2, 6], [2, 2]]
+    width = 8
+    batch = jnp.asarray(
+        [p + [0] * (width - len(p)) for p in prompts], jnp.int32)
+    cache = init_cache(cfg, len(prompts), width)
+    batched, _ = prefill(params, batch, cfg, cache)  # [B, W, V]
+    for i, p in enumerate(prompts):
+        solo_cache = init_cache(cfg, 1, len(p))
+        solo, _ = prefill(params, jnp.asarray([p], jnp.int32), cfg,
+                          solo_cache)
+        np.testing.assert_allclose(
+            np.asarray(batched[i, len(p) - 1]),
+            np.asarray(solo[0, -1]), atol=1e-4, rtol=1e-4)
+
+
+def test_batched_prefill_left_pad_diverges():
+    """The counterpart pin: LEFT padding is NOT supported — pad tokens
+    land at positions <= the real tokens', enter the causal support, and
+    shift every real position's rotary phase, so parity breaks. This is
+    why the serving engine right-pads (models/paged.py docstring)."""
+    cfg, params = _setup()
+    p = [3, 1, 4, 1, 5, 9, 2, 6]
+    width = 12
+    left = jnp.asarray([[0] * (width - len(p)) + p], jnp.int32)
+    cache = init_cache(cfg, 1, width)
+    batched, _ = prefill(params, left, cfg, cache)
+    solo_cache = init_cache(cfg, 1, len(p))
+    solo, _ = prefill(params, jnp.asarray([p], jnp.int32), cfg, solo_cache)
+    # Last real token is at the last position under left padding.
+    assert not np.allclose(np.asarray(batched[0, -1]),
+                           np.asarray(solo[0, -1]), atol=1e-4)
+
+
 def test_max_len_validation():
     cfg, params = _setup()
     prompt = jnp.zeros((1, 4), jnp.int32)

@@ -1,0 +1,341 @@
+"""Serving engine: allocator, continuous-batching scheduler, HTTP surface.
+
+The load-bearing test is churn determinism (acceptance criteria): under
+a seeded clock with staggered arrivals, ragged prompt lengths, and a
+pool tight enough to force an eviction, every completed sequence must
+match its solo run token for token, and the page pool must drain back to
+its initial occupancy — the serving twin of cloudsim's bitwise
+serial/parallel equality pins.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from triton_kubernetes_tpu.models import get_config, init_params
+from triton_kubernetes_tpu.serve import (
+    BlockAllocator,
+    ManualClock,
+    OutOfBlocksError,
+    PoissonSchedule,
+    Request,
+    ServeEngine,
+    ServeHTTPServer,
+    percentile,
+)
+from triton_kubernetes_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    metrics.configure()
+    yield
+    metrics.configure()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = get_config("llama-test")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def make_engine(model, **over):
+    cfg, params = model
+    kw = dict(block_size=4, num_blocks=40, max_batch=4, max_model_len=64,
+              clock=ManualClock(tick=0.001))
+    kw.update(over)
+    return ServeEngine(params, cfg, **kw)
+
+
+# ----------------------------------------------------------- allocator
+def test_allocator_lowest_first_and_double_free():
+    a = BlockAllocator(8)
+    assert a.capacity == 7 and a.available == 7 and a.in_use == 0
+    got = a.alloc(3)
+    assert got == [1, 2, 3]  # deterministic: lowest-index-first
+    a.free([2])
+    assert a.alloc(1) == [2]  # freed page is reusable, still lowest-first
+    with pytest.raises(OutOfBlocksError):
+        a.alloc(6)
+    with pytest.raises(ValueError, match="not allocated"):
+        a.free([7])
+    with pytest.raises(ValueError, match="trash"):
+        a.free([0])
+    with pytest.raises(ValueError):
+        BlockAllocator(1)
+
+
+# -------------------------------------------------------------- engine
+def solo_run(model, prompt, n, **req_over):
+    eng = make_engine(model)
+    eng.submit(Request("solo", list(prompt), n, **req_over))
+    done = eng.run_until_idle()
+    assert len(done) == 1 and eng.allocator.in_use == 0
+    return done[0].tokens
+
+
+def test_engine_single_request_roundtrip(model):
+    toks = solo_run(model, [5, 7, 9, 11, 2], 6)
+    assert len(toks) == 6
+    # Deterministic: an identical engine reproduces it.
+    assert toks == solo_run(model, [5, 7, 9, 11, 2], 6)
+
+
+def test_engine_eos_stops_early(model):
+    base = solo_run(model, [5, 7, 9, 11, 2], 6)
+    eos = base[2]
+    eng = make_engine(model)
+    eng.submit(Request("r", [5, 7, 9, 11, 2], 6, eos_id=eos))
+    done = eng.run_until_idle()[0]
+    assert done.finish_reason == "eos"
+    assert done.tokens == base[:base.index(eos) + 1]
+
+
+def test_engine_validates_requests(model):
+    eng = make_engine(model)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit(Request("r", [], 4))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(Request("r", [1], 0))
+    # Out-of-vocab ids would be silently clamped by the embed gather —
+    # they must be rejected, not served as a different prompt.
+    with pytest.raises(ValueError, match="vocabulary"):
+        eng.submit(Request("r", [1, 999999], 4))
+    with pytest.raises(ValueError, match="vocabulary"):
+        eng.submit(Request("r", [-1], 4))
+    with pytest.raises(ValueError, match="max_model_len"):
+        eng.submit(Request("r", [1] * 60, 10))
+    eng2 = make_engine(model, num_blocks=4)
+    with pytest.raises(ValueError, match="KV blocks"):
+        eng2.submit(Request("r", [1] * 20, 10))
+
+
+def test_churn_matches_solo_and_pool_drains(model):
+    """Acceptance pin: staggered arrivals + ragged lengths + one
+    eviction-on-full; every completion equals its solo run; the pool
+    returns to initial occupancy."""
+    prompts = [
+        ([5, 7, 9, 11, 2, 4, 6, 8], 16),
+        ([3, 1, 4, 1, 5, 9, 2, 6], 16),
+        ([2, 2, 2], 5),
+        ([9, 8, 7, 6, 5, 4, 3, 2, 1, 0, 1, 2, 3], 7),
+    ]
+    solos = [solo_run(model, p, n) for p, n in prompts]
+
+    # Pool sized so the two 16-token generators collide mid-decode: each
+    # needs 6 pages eventually; 9 allocatable forces an eviction.
+    eng = make_engine(model, num_blocks=10, max_batch=3, max_model_len=32)
+    arrivals = {0: [0], 1: [1, 2], 3: [3]}
+    results = {}
+    step = 0
+    while eng.has_work or step < 5:
+        for idx in arrivals.get(step, []):
+            p, n = prompts[idx]
+            eng.submit(Request(f"r{idx}", p, n))
+        for d in eng.step():
+            results[d.request_id] = d
+        step += 1
+        assert step < 500, "engine failed to drain"
+
+    assert metrics.counter("tk8s_serve_preemptions_total").value() >= 1
+    assert any(d.preemptions > 0 for d in results.values())
+    for i, _ in enumerate(prompts):
+        assert results[f"r{i}"].tokens == solos[i], f"r{i} diverged"
+    assert eng.allocator.in_use == 0, "leaked KV pages"
+    assert eng.allocator.available == eng.allocator.capacity
+
+
+def test_seeded_sampling_independent_of_batch(model):
+    """A sampled (non-greedy) request draws from its own seed+position
+    stream: solo output == churn output even with neighbors decoding."""
+    req = dict(temperature=0.8, top_k=8, top_p=0.9, seed=13)
+    want = solo_run(model, [4, 5, 6, 7], 8, **req)
+    eng = make_engine(model)
+    eng.submit(Request("sampled", [4, 5, 6, 7], 8, **req))
+    eng.submit(Request("noise", [1, 2, 3, 4, 5, 6], 10))
+    done = {d.request_id: d for d in eng.run_until_idle()}
+    assert done["sampled"].tokens == want
+
+
+def test_ttft_tpot_under_manual_clock(model):
+    clock = ManualClock(tick=1.0)  # every clock() call advances 1s
+    eng = make_engine(model, clock=clock)
+    eng.submit(Request("r", [1, 2, 3], 4))
+    done = eng.run_until_idle()[0]
+    assert done.ttft > 0 and done.tpot > 0
+    assert done.finished_at > done.first_token_at > done.submitted_at
+    # Histograms moved.
+    assert metrics.histogram("tk8s_serve_ttft_seconds").count() == 1
+    assert metrics.histogram("tk8s_serve_tpot_seconds").count() == 1
+
+
+def test_sequential_mode_never_batches(model):
+    eng = make_engine(model, sequential=True)
+    for i in range(3):
+        eng.submit(Request(f"r{i}", [1 + i, 2, 3], 4))
+    max_running = 0
+    while eng.has_work:
+        eng.step()
+        max_running = max(max_running, eng.num_running)
+    assert max_running == 1
+
+
+def test_engine_gauges_track_state(model):
+    eng = make_engine(model, max_batch=2)
+    for i in range(4):
+        eng.submit(Request(f"r{i}", [1, 2, 3, 4], 8))
+    eng.step()
+    assert metrics.gauge("tk8s_serve_sequences").value(state="running") == 2
+    assert metrics.gauge("tk8s_serve_sequences").value(state="waiting") == 2
+    assert metrics.gauge("tk8s_serve_kv_blocks_in_use").value() > 0
+    eng.run_until_idle()
+    assert metrics.gauge("tk8s_serve_kv_blocks_in_use").value() == 0
+    assert metrics.counter("tk8s_serve_tokens_total").value(
+        kind="decode") > 0
+    assert metrics.counter("tk8s_serve_tokens_total").value(
+        kind="prefill") == 4 * 4
+
+
+# ---------------------------------------------------------------- HTTP
+def _post(url, payload):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_http_generate_healthz_metrics_stats(model):
+    want = solo_run(model, [5, 7, 9, 11, 2], 6)
+    metrics.configure()  # the assertions below count server traffic only
+    with ServeHTTPServer(make_engine(model)) as srv:
+        out = _post(srv.url, {"tokens": [5, 7, 9, 11, 2],
+                              "max_new_tokens": 6})
+        assert out["tokens"] == want
+        assert out["finish_reason"] == "length"
+        assert out["ttft_s"] > 0
+
+        with urllib.request.urlopen(srv.url + "/healthz") as r:
+            h = json.loads(r.read())
+        assert h["ok"] and h["model"] == "llama-test"
+
+        with urllib.request.urlopen(srv.url + "/stats") as r:
+            stats = json.loads(r.read())
+        assert stats["kv_blocks_in_use"] == 0
+
+        with urllib.request.urlopen(srv.url + "/metrics") as r:
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            text = r.read().decode()
+        # Valid Prometheus text with the serve families present and moved.
+        assert "# TYPE tk8s_serve_ttft_seconds histogram" in text
+        assert 'tk8s_serve_requests_total{outcome="length"} 1' in text
+        assert "tk8s_serve_http_requests_total" in text
+        for line in text.splitlines():
+            assert line.startswith("#") or " " in line
+
+
+def test_http_rejects_bad_requests(model):
+    with ServeHTTPServer(make_engine(model)) as srv:
+        for payload in ({"tokens": "nope"}, {"tokens": [1], "max_new_tokens": 0},
+                        {"tokens": [1] * 60, "max_new_tokens": 10},
+                        {"tokens": [999999]},
+                        # Wrong-typed fields are a 400, not a handler
+                        # crash / connection reset (TypeError path).
+                        {"tokens": [1], "temperature": None},
+                        {"tokens": [1], "max_new_tokens": [5]},
+                        {"tokens": [1], "eos_id": "x"}):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(srv.url, payload)
+            assert err.value.code == 400, payload
+        with pytest.raises(urllib.error.HTTPError) as err:
+            with urllib.request.urlopen(srv.url + "/nope"):
+                pass
+        assert err.value.code == 404
+
+
+def test_http_engine_loop_death_flips_healthz(model):
+    """A crashed scheduler must fail liveness (the Deployment's probe
+    restarts on /healthz) and release blocked clients as 503 — never
+    serve 200 from a zombie."""
+    srv = ServeHTTPServer(make_engine(model))
+    # Sabotage the engine so the loop's step() raises.
+    srv.engine.step = None  # type: ignore[assignment]
+    with srv:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(srv.url, {"tokens": [1, 2, 3], "max_new_tokens": 4})
+        assert err.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as err:
+            with urllib.request.urlopen(srv.url + "/healthz"):
+                pass
+        assert err.value.code == 503
+        body = json.loads(err.value.read())
+        assert body["ok"] is False and body["error"]
+
+
+def test_http_concurrent_requests_batch_together(model):
+    import threading
+
+    with ServeHTTPServer(make_engine(model)) as srv:
+        solos = [solo_run(model, [i + 1, 2, 3, 4], 8) for i in range(4)]
+        results = [None] * 4
+        def hit(i):
+            results[i] = _post(srv.url, {"tokens": [i + 1, 2, 3, 4],
+                                         "max_new_tokens": 8})
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for i in range(4):
+            assert results[i] is not None, f"request {i} hung"
+            assert results[i]["tokens"] == solos[i]
+
+
+# -------------------------------------------------------------- loadgen
+def test_poisson_schedule_seeded_and_sorted():
+    a = PoissonSchedule(rate=100.0, n=16, vocab_size=256, seed=3)
+    b = PoissonSchedule(rate=100.0, n=16, vocab_size=256, seed=3)
+    assert [r.at for r in a] == [r.at for r in b]
+    assert [r.tokens for r in a] == [r.tokens for r in b]
+    ats = [r.at for r in a]
+    assert ats == sorted(ats) and len(a) == 16
+    c = PoissonSchedule(rate=100.0, n=16, vocab_size=256, seed=4)
+    assert [r.at for r in c] != ats
+    with pytest.raises(ValueError):
+        PoissonSchedule(rate=0.0, n=4, vocab_size=16)
+
+
+def test_percentile_nearest_rank():
+    vals = [float(i) for i in range(1, 101)]
+    assert percentile(vals, 50) == 50.0
+    assert percentile(vals, 99) == 99.0
+    assert percentile(vals, 100) == 100.0
+    assert percentile([], 50) == 0.0
+    assert percentile([3.0], 99) == 3.0
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_has_serve_verb():
+    from triton_kubernetes_tpu.cli.main import build_parser
+
+    args = build_parser().parse_args(
+        ["serve", "--model", "llama-test", "--port", "0",
+         "--block-size", "8", "--num-blocks", "32", "--max-batch", "2",
+         "--sequential"])
+    assert args.command == "serve"
+    assert args.model == "llama-test"
+    assert args.block_size == 8 and args.num_blocks == 32
+    assert args.sequential
+
+
+def test_serve_port_matches_topology_pin():
+    """serve/ and topology/ must agree on the serving port without the
+    renderer importing the jax-loaded stack (jobset.RESUME_EXIT_CODE
+    pattern)."""
+    from triton_kubernetes_tpu.serve.server import SERVE_PORT as runtime
+    from triton_kubernetes_tpu.topology.serving import SERVE_PORT as rendered
+
+    assert runtime == rendered

@@ -124,6 +124,54 @@ def test_jobset_resume_exit_code_restarts_not_fails():
     validate_manifest(job)
 
 
+def test_serving_deployment_and_service_render():
+    """The serving workload closes the provisioning loop: Deployment
+    pinned to the labeled TPU pool + the VIP Service in front of it,
+    both passing the same schema validation the simulator applies."""
+    from triton_kubernetes_tpu.topology import (
+        render_serving_deployment, render_serving_service)
+    from triton_kubernetes_tpu.topology.serving import (
+        APP_LABEL, SERVE_PORT, default_serve_command)
+    from triton_kubernetes_tpu.topology.validate import validate_manifest
+
+    spec = SliceSpec.from_accelerator("v5e-8")
+    dep = render_serving_deployment(
+        "llm-serve", spec, "pool0", image="tk8s/jax-tpu-runtime:0.1.0",
+        model="llama3-bench", replicas=3, env={"TK8S_SERVE_DEBUG": "1"})
+    svc = render_serving_service("llm-serve")
+    validate_manifest(dep)
+    validate_manifest(svc)
+
+    assert dep["spec"]["replicas"] == 3
+    pod = dep["spec"]["template"]["spec"]
+    # Pinned to the provisioned pool's labels — serving is the
+    # acceptance test for what provisioning promised.
+    assert pod["nodeSelector"] == selector_for_slice(spec, "pool0")
+    c = pod["containers"][0]
+    assert c["command"] == default_serve_command("llama3-bench")
+    assert "--serve-host" in c["command"] and "0.0.0.0" in c["command"]
+    assert c["resources"]["limits"]["google.com/tpu"] == "8"
+    assert c["ports"][0]["containerPort"] == SERVE_PORT
+    assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    # Service selector routes to exactly the Deployment's pods.
+    assert svc["spec"]["selector"] == {APP_LABEL: "llm-serve"}
+    assert svc["spec"]["selector"].items() <= dep["spec"]["template"][
+        "metadata"]["labels"].items()
+    assert svc["spec"]["ports"][0]["port"] == SERVE_PORT
+
+
+def test_serving_deployment_schema_rejects_selector_mismatch():
+    from triton_kubernetes_tpu.topology import render_serving_deployment
+    from triton_kubernetes_tpu.topology.validate import (
+        ManifestError, validate_manifest)
+
+    dep = render_serving_deployment(
+        "llm", SliceSpec.from_accelerator("v5e-8"), "s0", "img", "m")
+    dep["spec"]["template"]["metadata"]["labels"] = {"other": "x"}
+    with pytest.raises(ManifestError, match="selector"):
+        validate_manifest(dep)
+
+
 def test_peak_flops_table_sane():
     for gen in TPU_GENERATIONS.values():
         assert gen.peak_bf16_tflops > 100

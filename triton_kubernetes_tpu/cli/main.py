@@ -201,6 +201,38 @@ def build_parser() -> argparse.ArgumentParser:
         help="dump the in-process metrics registry (Prometheus text; "
              "--json for the snapshot)")
 
+    serve = sub.add_parser(
+        "serve",
+        help="run the TPU-native inference endpoint: continuous batching "
+             "over a paged KV cache, HTTP /generate + /metrics + /healthz "
+             "(docs/guide/serving.md)")
+    serve.add_argument("--model", default="llama-test", metavar="NAME",
+                       help="model config name (default: llama-test; see "
+                            "models/config.py CONFIGS)")
+    serve.add_argument("--serve-host", default="127.0.0.1", metavar="ADDR",
+                       help="bind address (default: 127.0.0.1; manifests "
+                            "use 0.0.0.0)")
+    serve.add_argument("--port", type=int, default=8000, metavar="N",
+                       help="bind port (default: 8000; 0 = ephemeral)")
+    serve.add_argument("--block-size", type=int, default=16, metavar="N",
+                       help="KV-cache page size in tokens (default: 16)")
+    serve.add_argument("--num-blocks", type=int, default=256, metavar="N",
+                       help="KV-cache pool size in pages, page 0 reserved "
+                            "(default: 256)")
+    serve.add_argument("--max-batch", type=int, default=8, metavar="N",
+                       help="decode slots batched per step (default: 8)")
+    serve.add_argument("--max-model-len", type=int, default=None,
+                       metavar="N",
+                       help="cap on prompt + generated tokens per sequence "
+                            "(default: the model's max_seq_len)")
+    serve.add_argument("--sequential", action="store_true",
+                       help="serve one request at a time (the continuous-"
+                            "batching A/B baseline; scripts/ci/"
+                            "serving_evidence.py)")
+    serve.add_argument("--seed", type=int, default=0, metavar="N",
+                       help="parameter-init seed for the randomly "
+                            "initialized model (default: 0)")
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -242,6 +274,46 @@ def main(argv: Optional[List[str]] = None,
             # Honor the global contract (a file always lands) even though
             # this command opens no spans.
             trace.write(args.trace_out)
+        return 0
+
+    if args.command == "serve":
+        # Workload-stack imports stay lazy: the provisioning verbs must
+        # keep working on machines without jax (pyproject's split).
+        import jax as _jax
+
+        from ..models import get_config, init_params
+        from ..serve import ServeEngine, ServeHTTPServer
+        from ..utils import metrics as _metrics
+
+        try:
+            model_config = get_config(args.model)
+        except KeyError as e:
+            logger.error(str(e), kind="KeyError")
+            return 1
+        _metrics.get_registry().register_catalog()
+        logger.info("initializing model", model=args.model,
+                    backend=_jax.default_backend())
+        engine = ServeEngine(
+            init_params(model_config, _jax.random.PRNGKey(args.seed)),
+            model_config,
+            block_size=args.block_size, num_blocks=args.num_blocks,
+            max_batch=args.max_batch, max_model_len=args.max_model_len,
+            sequential=args.sequential)
+        server = ServeHTTPServer(engine, host=args.serve_host,
+                                 port=args.port)
+        host, port = server.address
+        logger.info("serving", url=f"http://{host}:{port}",
+                    model=args.model, block_size=args.block_size,
+                    num_blocks=args.num_blocks, max_batch=args.max_batch)
+        print(f"serving {args.model} on http://{host}:{port} "
+              f"(POST /generate, GET /metrics, GET /healthz)", flush=True)
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            print("\nstopped", file=sys.stderr)
+        finally:
+            if trace is not None:
+                trace.write(args.trace_out)
         return 0
 
     config = Config(config_file=args.config)

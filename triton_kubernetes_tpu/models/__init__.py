@@ -22,6 +22,12 @@ from .generate import (
     prefill,
     sample_token,
 )
+from .paged import (
+    PagedKVCache,
+    init_paged_cache,
+    paged_decode_step,
+    paged_prefill,
+)
 from . import mixtral
 
 __all__ = [
@@ -38,4 +44,8 @@ __all__ = [
     "decode_step",
     "generate",
     "sample_token",
+    "PagedKVCache",
+    "init_paged_cache",
+    "paged_prefill",
+    "paged_decode_step",
 ]

@@ -54,14 +54,18 @@ def prefill(
     config: ModelConfig,
     cache: KVCache,
     last_logits_only: bool = False,
+    last_position: Optional[jnp.ndarray] = None,  # [B] int32
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the prompt through the stack, filling cache[:, :, :P].
 
     Returns (logits f32, cache) — [B, P, V], or [B, 1, V] when
     ``last_logits_only`` (generation only samples the last position, and
     the full-prompt unembed is B*P*V f32, easily the largest buffer of a
-    long-prompt prefill). Prompt attention is plain causal over the prompt
-    itself (nothing cached yet).
+    long-prompt prefill). ``last_position`` is the ragged generalization:
+    unembed only each sequence's own position (right-padded batches,
+    where the interesting logits sit at ``length - 1``, not ``P - 1``).
+    Prompt attention is plain causal over the prompt itself (nothing
+    cached yet).
     """
     b, p = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
@@ -81,7 +85,10 @@ def prefill(
         return x + y, (ck, cv)
 
     x, (ck, cv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
-    if last_logits_only:
+    if last_position is not None:
+        idx = jnp.reshape(last_position, (-1, 1, 1)).astype(jnp.int32)
+        x = jnp.take_along_axis(x, idx, axis=1)  # [B, 1, D]
+    elif last_logits_only:
         x = x[:, -1:, :]
     logits = llama.unembed(x, params, config)
     return logits, KVCache(k=ck, v=cv, length=jnp.asarray(p, jnp.int32))
@@ -127,14 +134,32 @@ def sample_token(
     key: jax.Array,
     temperature: float = 1.0,
     top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jnp.ndarray:
-    """Greedy when temperature == 0; else temperature (+ optional top-k)."""
+    """Greedy when temperature == 0; else temperature with optional
+    top-k and/or top-p (nucleus) filtering — filters compose: top-k cuts
+    first, then top-p trims the survivors' probability mass."""
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        # Nucleus: keep the smallest prefix of the probability-sorted
+        # vocab whose mass reaches top_p. The test `cum - p < top_p`
+        # (mass *before* each token) always keeps the top token, so the
+        # support is never empty.
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p
+        masked = jnp.where(keep, sorted_logits, -jnp.inf)
+        inverse = jnp.argsort(order, axis=-1)
+        logits = jnp.take_along_axis(masked, inverse, axis=-1)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -146,6 +171,7 @@ def generate(
     key: Optional[jax.Array] = None,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     eos_id: Optional[int] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Prefill + N decode steps; returns {"tokens": [B, N], "done": [B]}.
@@ -172,7 +198,7 @@ def generate(
             f"{config.capacity_factor}")
 
     def sample(logits, done, key):
-        tok = sample_token(logits, key, temperature, top_k)
+        tok = sample_token(logits, key, temperature, top_k, top_p)
         if eos_id is not None:
             tok = jnp.where(done, eos_id, tok)
             done = done | (tok == eos_id)

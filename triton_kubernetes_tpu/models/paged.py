@@ -1,0 +1,159 @@
+"""Paged-KV-cache prefill and decode for the serving engine.
+
+The training-side twin of this file is ``models/generate.py``: same weight
+pytree, same ``llama._qkv`` / ``llama._mlp`` block math, same explicit-
+position attention masking — so greedy decode through pages reproduces the
+contiguous ``decode_step`` loop token for token (pinned in
+tests/test_paged_attention.py). What changes is the cache layout:
+
+* ``generate.KVCache`` is one contiguous ``[L, B, max_len, ...]`` strip —
+  perfect for a fixed batch decoding in lockstep, hopeless for a serving
+  batch where sequences arrive, finish, and differ in length by 100x
+  (every sequence pays ``max_len``, and batch membership is baked into
+  the array).
+* :class:`PagedKVCache` is a static pool of fixed-size pages
+  (``[L, num_blocks, block_size, Hkv, Dh]``) plus per-sequence block
+  tables owned by the scheduler (``serve/``). Admitting, growing, or
+  evicting a sequence mutates *table entries*, never array shapes, so
+  the batched decode step compiles exactly once.
+
+Shape discipline (what "never retraces" means concretely): every jitted
+entrypoint here has operand shapes fixed by engine configuration —
+``(max_batch, blocks_per_seq, block_size, padded_prompt_len)`` — and
+takes real lengths as *data* (int32 operands), never as Python ints.
+
+Page 0 is the shared trash page (``ops.paged_attention.TRASH_PAGE``):
+padded table entries and inactive batch slots scatter/gather there, and
+position masking keeps its garbage out of every real sequence's support.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.paged_attention import ragged_paged_attention, scatter_token
+from ..ops.rotary import rotary_tables
+from .config import ModelConfig
+from . import llama
+from .generate import init_cache, prefill
+
+
+class PagedKVCache(NamedTuple):
+    """The static page pool. Per-sequence block tables live with the
+    scheduler, not here — the pool is just memory."""
+
+    k: jnp.ndarray  # [L, num_blocks, block_size, Hkv, Dh]
+    v: jnp.ndarray  # [L, num_blocks, block_size, Hkv, Dh]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_paged_cache(config: ModelConfig, num_blocks: int,
+                     block_size: int) -> PagedKVCache:
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (page 0 is the reserved trash page), "
+            f"got {num_blocks}")
+    shape = (config.num_layers, num_blocks, block_size,
+             config.num_kv_heads, config.head_dim)
+    # Two distinct buffers, never one aliased zeros array: the engine
+    # donates k and v to its jitted steps, and XLA rejects donating the
+    # same buffer twice.
+    return PagedKVCache(k=jnp.zeros(shape, config.activation_dtype),
+                        v=jnp.zeros(shape, config.activation_dtype))
+
+
+def paged_prefill(
+    params,
+    tokens: jnp.ndarray,  # [1, P] int32, right-padded to the trace width
+    length: jnp.ndarray,  # [] int32 — real prompt tokens (<= P)
+    config: ModelConfig,
+    cache: PagedKVCache,
+    block_table: jnp.ndarray,  # [P // block_size] int32 physical pages
+) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """Run one right-padded prompt and land its K/V in pages.
+
+    Returns (logits [V] f32 at the last *real* token, updated pool).
+
+    Right-padding is the load-bearing choice: with causal masking, pad
+    tokens sit at positions > length-1 and cannot perturb any real
+    position's logits, so the padded batch-of-one forward equals the
+    exact-length forward at ``length - 1`` — the parity contract pinned
+    in tests/test_generate.py (and exactly what left-padding breaks).
+    The prompt's K/V pages then hold real tokens in slots < length and
+    pad garbage above, which every later paged-attention call masks out.
+    """
+    _, p = tokens.shape
+    bs = cache.block_size
+    if p % bs != 0:
+        raise ValueError(
+            f"padded prompt length {p} must be a multiple of the "
+            f"block size {bs} (pad the trace width, not the pages)")
+    t = p // bs
+    if block_table.shape != (t,):
+        raise ValueError(
+            f"block_table must cover the padded prompt: expected shape "
+            f"({t},), got {block_table.shape}")
+    contiguous = init_cache(config, 1, p)
+    # Unembed only the last real position: the full padded-width logits
+    # would be the admission's largest buffer (generate.prefill docstring).
+    logits, contiguous = prefill(params, tokens, config, contiguous,
+                                 last_position=(length - 1)[None])
+    last = logits[0, 0]  # [V]
+    # [L, 1, P, Hkv, Dh] -> [L, T, bs, Hkv, Dh], scattered to this
+    # sequence's pages. Padded table entries (trash) take pad garbage;
+    # partially-filled last pages carry pad garbage above `length` until
+    # decode overwrites those slots one token at a time.
+    ll = config.num_layers
+    k = contiguous.k.reshape(ll, t, bs, *contiguous.k.shape[3:])
+    v = contiguous.v.reshape(ll, t, bs, *contiguous.v.shape[3:])
+    return last, PagedKVCache(k=cache.k.at[:, block_table].set(k),
+                              v=cache.v.at[:, block_table].set(v))
+
+
+def paged_decode_step(
+    params,
+    token: jnp.ndarray,  # [B] int32 — each sequence's latest token
+    config: ModelConfig,
+    cache: PagedKVCache,
+    block_tables: jnp.ndarray,  # [B, T] int32
+    lengths: jnp.ndarray,  # [B] int32 — tokens already written per seq
+) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """One ragged decode step: returns (logits [B, V] f32, updated pool).
+
+    Sequence ``b``'s token lands at position ``lengths[b]`` in its own
+    pages; attention then covers positions ``0..lengths[b]``. Inactive
+    batch slots ride along with an all-trash table and length 0 — their
+    logits are garbage the scheduler discards, their writes hit only the
+    trash page, and their cost is what static shapes buy us.
+    """
+    b = token.shape[0]
+    ad = config.activation_dtype
+    positions = lengths[:, None].astype(jnp.int32)  # [B, 1] — ragged!
+    cos, sin = rotary_tables(
+        config.head_dim, config.max_seq_len, config.rope_theta)
+    x = params["embed"].astype(ad)[token[:, None]]  # [B, 1, D]
+
+    def body(carry, layer_and_pages):
+        x = carry
+        layer, kp, vp = layer_and_pages
+        q, k, v = llama._qkv(x, layer, config, cos, sin, positions)
+        kp, vp = scatter_token(kp, vp, k, v, block_tables, lengths)
+        attn = ragged_paged_attention(
+            q, kp, vp, block_tables, lengths + 1)
+        x = llama.project_out(x, attn, layer, config)
+        y, _ = llama._mlp(x, layer, config)
+        return x + y, (kp, vp)
+
+    x, (kp, vp) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    logits = llama.unembed(x, params, config)[:, 0, :]
+    return logits, PagedKVCache(k=kp, v=vp)

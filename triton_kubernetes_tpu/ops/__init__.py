@@ -12,6 +12,13 @@ from .attention import auto_attention, causal_attention
 from .flash_attention import flash_attention
 from .ring_attention import make_ring_attention, ring_attention_inner
 from .moe import moe_layer, sort_router, top_k_router
+from .paged_attention import (
+    TRASH_PAGE,
+    blocks_for,
+    gather_pages,
+    ragged_paged_attention,
+    scatter_token,
+)
 
 __all__ = [
     "rms_norm",
@@ -25,4 +32,9 @@ __all__ = [
     "moe_layer",
     "sort_router",
     "top_k_router",
+    "TRASH_PAGE",
+    "blocks_for",
+    "gather_pages",
+    "ragged_paged_attention",
+    "scatter_token",
 ]

@@ -1,0 +1,121 @@
+"""Ragged paged-attention decode over a block-paged KV cache.
+
+The serving engine (``serve/``) keeps K/V in fixed-size **pages** drawn
+from one static pool (``[num_blocks, block_size, Hkv, Dh]`` per layer)
+instead of one contiguous ``[B, max_len, ...]`` strip per sequence. A
+per-sequence **block table** maps logical block ``j`` (tokens
+``j*block_size .. (j+1)*block_size-1``) to a physical page, so sequences
+of wildly different lengths share the pool with zero reallocation and the
+decode program never retraces as the batch churns — the shape of every
+operand is fixed by ``(max_batch, blocks_per_seq, block_size)``, not by
+the text.
+
+This module is the op layer of that design, kept at the same altitude as
+``ops/attention.py``:
+
+* :func:`gather_pages` — K or V for a batch of sequences, gathered
+  through their block tables into logical-token order;
+* :func:`ragged_paged_attention` — one decode step of attention for a
+  batch at **heterogeneous** positions (each query at its own
+  ``length-1``), reusing :func:`~.attention.causal_attention`'s explicit
+  position masking so logical slots past a sequence's length — including
+  whole table entries that still point at the shared trash page —
+  contribute *exactly zero* (``exp(NEG_INF - m)`` underflows to 0.0), not
+  approximately zero.
+
+Pool-sharing convention (pinned in tests/test_paged_attention.py):
+**page 0 is the trash page**. Allocators never hand it out; unused block-
+table entries point at it; batched scatters of inactive batch slots land
+in it. Correctness never depends on its contents.
+
+On TPU the gather lowers to HBM loads driven by the (SMEM-resident) block
+table — the shape the "Ragged Paged Attention" kernel literature
+prescribes (PAPERS.md); a Pallas kernel that fuses the gather with the
+flash inner loop can swap in underneath this interface without touching
+callers, exactly like ``ops/flash_attention.py`` under ``auto_attention``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .attention import causal_attention
+
+# Physical page every allocator must reserve: the scatter/gather sink for
+# padded block-table entries and inactive batch slots.
+TRASH_PAGE = 0
+
+
+def blocks_for(length: int, block_size: int) -> int:
+    """Pages needed to hold ``length`` tokens (host-side helper)."""
+    if length <= 0:
+        return 0
+    return -(-length // block_size)
+
+
+def gather_pages(
+    pages: jnp.ndarray,  # [N, bs, Hkv, D] — the physical pool
+    block_tables: jnp.ndarray,  # [B, T] int32 physical page ids
+) -> jnp.ndarray:
+    """K or V in logical token order: [B, T*bs, Hkv, D].
+
+    Row ``b``, token ``t`` is ``pages[block_tables[b, t // bs], t % bs]``.
+    Entries past a sequence's written length (trash-page refs included)
+    gather garbage by design — the caller masks by position.
+    """
+    n, bs, hkv, d = pages.shape
+    b, t = block_tables.shape
+    return pages[block_tables].reshape(b, t * bs, hkv, d)
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [B, 1, Hq, D] — this step's query per sequence
+    k_pages: jnp.ndarray,  # [N, bs, Hkv, D]
+    v_pages: jnp.ndarray,  # [N, bs, Hkv, D]
+    block_tables: jnp.ndarray,  # [B, T] int32
+    lengths: jnp.ndarray,  # [B] int32 — tokens written, incl. this one
+) -> jnp.ndarray:
+    """One decode step of attention for a ragged batch: [B, 1, Hq, D].
+
+    Sequence ``b``'s query sits at position ``lengths[b] - 1`` and attends
+    to every written slot of its own pages (the current token's K/V must
+    already be scattered in — same contract as ``generate.decode_step``,
+    which writes the cache before attending). GQA comes along for free
+    from ``causal_attention``.
+    """
+    b, t = block_tables.shape
+    bs = k_pages.shape[1]
+    k = gather_pages(k_pages, block_tables)
+    v = gather_pages(v_pages, block_tables)
+    # Logical key positions 0..T*bs-1; the causal test q_pos >= k_pos
+    # excludes both future slots and everything past length-1 — garbage
+    # in padded/trash pages never reaches the softmax support.
+    q_positions = (lengths[:, None] - 1).astype(jnp.int32)  # [B, 1]
+    k_positions = jnp.broadcast_to(
+        jnp.arange(t * bs, dtype=jnp.int32), (b, t * bs))
+    return causal_attention(q, k, v, q_positions, k_positions)
+
+
+def scatter_token(
+    k_pages: jnp.ndarray,  # [N, bs, Hkv, D]
+    v_pages: jnp.ndarray,
+    k: jnp.ndarray,  # [B, 1, Hkv, D] — this step's K per sequence
+    v: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, T] int32
+    positions: jnp.ndarray,  # [B] int32 — slot each token lands in
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write one token's K/V per sequence into its page: (k_pages, v_pages).
+
+    Inactive batch slots must carry an all-trash block table (and any
+    position): their writes land in the trash page, colliding only with
+    each other, never with an allocated page.
+    """
+    b = positions.shape[0]
+    bs = k_pages.shape[1]
+    page = block_tables[jnp.arange(b), positions // bs]  # [B]
+    offset = positions % bs  # [B]
+    k_pages = k_pages.at[page, offset].set(k[:, 0])
+    v_pages = v_pages.at[page, offset].set(v[:, 0])
+    return k_pages, v_pages

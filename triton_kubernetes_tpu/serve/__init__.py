@@ -1,0 +1,38 @@
+"""TPU-native serving engine: paged KV cache + continuous batching.
+
+The workload the north star actually demands — "serves heavy traffic
+from millions of users" — lands here. Four layers, mirroring how the
+training stack is cut:
+
+* **ops** (``ops/paged_attention.py``): the ragged paged-attention decode
+  op over a static page pool;
+* **model** (``models/paged.py``): paged prefill/decode through the same
+  layer math as training, token-for-token equal to the contiguous path;
+* **engine** (:mod:`.engine`): the continuous-batching scheduler —
+  admit/decode/evict every step, deterministic under a seeded clock the
+  way cloudsim is;
+* **entrypoint** (:mod:`.server`): ``tk8s serve`` — stdlib HTTP with
+  ``/generate``, ``/healthz``, and Prometheus ``/metrics`` exporting the
+  ``tk8s_serve_*`` families.
+
+:mod:`.loadgen` is the Poisson open-loop load generator that doubles as
+the provisioned cluster's acceptance test (scripts/ci/serving_evidence.py).
+"""
+
+from .blocks import BlockAllocator, OutOfBlocksError
+from .engine import FinishedRequest, ManualClock, Request, ServeEngine
+from .loadgen import PoissonSchedule, percentile
+from .server import SERVE_PORT, ServeHTTPServer
+
+__all__ = [
+    "SERVE_PORT",
+    "ServeHTTPServer",
+    "BlockAllocator",
+    "FinishedRequest",
+    "ManualClock",
+    "OutOfBlocksError",
+    "PoissonSchedule",
+    "Request",
+    "ServeEngine",
+    "percentile",
+]

@@ -1,0 +1,77 @@
+"""Host-side KV-page allocator for the static device pool.
+
+Pure bookkeeping — the device arrays never change shape; this hands out
+*indices* into them. Deterministic by construction (lowest-index-first),
+so a seeded engine run allocates identically every time, which is what
+lets the churn tests assert bitwise-identical schedules the way the
+cloudsim tests do.
+
+Page 0 (``ops.paged_attention.TRASH_PAGE``) is never allocatable: it is
+the shared scatter/gather sink for padded block-table entries and
+inactive batch slots.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Iterable, List
+
+from ..ops.paged_attention import TRASH_PAGE
+
+
+class OutOfBlocksError(RuntimeError):
+    """The pool cannot satisfy an allocation — the scheduler's signal to
+    stop admitting (or start preempting), never a crash."""
+
+
+class BlockAllocator:
+    """Fixed pool of ``num_blocks - 1`` allocatable pages (page 0 reserved).
+
+    ``alloc`` returns the lowest-numbered free pages; ``free`` returns
+    pages to the pool and rejects double-frees and the trash page —
+    leaked or double-freed pages are scheduler bugs the churn test pins
+    via :attr:`in_use` returning to zero.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (page {TRASH_PAGE} is reserved), "
+                f"got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(1, num_blocks))
+        heapq.heapify(self._free)
+        self._allocated: set[int] = set()
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> List[int]:
+        """The ``n`` lowest free page ids; all-or-nothing."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise OutOfBlocksError(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(capacity {self.capacity})")
+        out = [heapq.heappop(self._free) for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, blocks: Iterable[int]) -> None:
+        for b in blocks:
+            if b == TRASH_PAGE:
+                raise ValueError("cannot free the reserved trash page")
+            if b not in self._allocated:
+                raise ValueError(f"block {b} is not allocated (double free?)")
+            self._allocated.discard(b)
+            heapq.heappush(self._free, b)

@@ -1,0 +1,429 @@
+"""Continuous-batching scheduler over the paged KV cache.
+
+One :meth:`ServeEngine.step` is one scheduler tick, vLLM-style:
+
+1. **admit** — pop waiting requests while a decode slot and enough KV
+   pages exist; each admit runs the (right-padded, single-trace) paged
+   prefill and samples the request's first token — TTFT is measured to
+   *here*, not to completion;
+2. **grow/preempt** — every running sequence gets the page its next
+   token needs; when the pool is dry, the latest-admitted sequence is
+   preempted: pages freed, sequence pushed back to the queue front, to
+   be re-prefilled later from prompt + tokens-so-far (recompute, not
+   swap). Output is unaffected — teacher-forced re-prefill of its own
+   greedy/seeded continuation reproduces the same next token;
+3. **decode** — ONE batched ragged decode step for all running
+   sequences (always ``max_batch`` wide; inactive slots ride the trash
+   page), then per-sequence sampling, completion checks, page frees.
+
+Determinism is the design axis, exactly like cloudsim: the clock is
+injectable (:class:`ManualClock` for tests), allocation is
+lowest-index-first, admission is FIFO, preemption is latest-admitted-
+first, and per-request sampling keys are derived from the request's own
+seed and position — never from batch composition. Hence the pinned churn
+test: any interleaving of arrivals/evictions yields each sequence's
+solo-run output, and the pool drains back to its initial occupancy.
+
+Metrics: the ``tk8s_serve_*`` CATALOG families (utils/metrics.py) are
+updated inside ``step`` / request completion, so ``tk8s serve``'s
+``/metrics`` endpoint and the CI evidence artifact read one source.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.generate import sample_token
+from ..models.paged import (
+    init_paged_cache,
+    paged_decode_step,
+    paged_prefill,
+)
+from ..ops.paged_attention import TRASH_PAGE, blocks_for
+from ..utils import metrics
+from .blocks import BlockAllocator, OutOfBlocksError
+
+
+class ManualClock:
+    """Deterministic injectable clock: advances only when told to —
+    the serving twin of cloudsim's mutation clock."""
+
+    def __init__(self, start: float = 0.0, tick: float = 0.0):
+        self.now = float(start)
+        self.tick = float(tick)
+
+    def __call__(self) -> float:
+        t = self.now
+        self.now += self.tick
+        return t
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+@dataclass
+class Request:
+    """One generation request. ``seed`` keys this request's sampling
+    stream independently of batch composition (solo == batched)."""
+
+    request_id: str
+    tokens: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    seed: int = 0
+
+
+@dataclass
+class FinishedRequest:
+    request_id: str
+    prompt_len: int
+    tokens: List[int]  # generated only
+    finish_reason: str  # "eos" | "length"
+    submitted_at: float
+    first_token_at: float
+    finished_at: float
+    preemptions: int = 0
+
+    @property
+    def ttft(self) -> float:
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def tpot(self) -> float:
+        """Mean time per output token after the first."""
+        n = len(self.tokens) - 1
+        if n <= 0:
+            return 0.0
+        return (self.finished_at - self.first_token_at) / n
+
+
+@dataclass
+class _Sequence:
+    """A request plus its scheduling state — lives in the waiting queue
+    (pages == None) or in a decode slot (pages allocated)."""
+
+    request: Request
+    submitted_at: float
+    generated: List[int] = field(default_factory=list)
+    first_token_at: Optional[float] = None
+    preemptions: int = 0
+    pages: List[int] = field(default_factory=list)
+    admit_seq: int = -1  # admission order; preemption evicts the highest
+
+    @property
+    def length(self) -> int:
+        """Tokens written to pages so far. The most recent generated
+        token is sampled-but-unwritten (it is the next decode's input)."""
+        return len(self.request.tokens) + max(0, len(self.generated) - 1)
+
+
+class ServeEngine:
+    """Single-trace continuous batching over one model replica.
+
+    Not thread-safe: one owner (the server's engine loop, or a test)
+    calls ``submit``/``step``. The HTTP layer marshals into that loop.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: ModelConfig,
+        *,
+        block_size: int = 16,
+        num_blocks: int = 64,
+        max_batch: int = 4,
+        max_model_len: Optional[int] = None,
+        sequential: bool = False,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.config = config
+        self.params = params
+        self.block_size = block_size
+        self.max_batch = max_batch
+        self.max_model_len = min(max_model_len or config.max_seq_len,
+                                 config.max_seq_len)
+        self.sequential = sequential
+        self.clock = clock
+        # One table width serves prefill and decode: enough pages for a
+        # full-length sequence, prompt width padded up to whole pages.
+        self.blocks_per_seq = blocks_for(self.max_model_len, block_size)
+        self.prefill_width = self.blocks_per_seq * block_size
+        self.allocator = BlockAllocator(num_blocks)
+        self.cache = init_paged_cache(config, num_blocks, block_size)
+        self.waiting: Deque[_Sequence] = deque()
+        self.slots: List[Optional[_Sequence]] = [None] * max_batch
+        self._admit_counter = 0
+        self._steps = 0
+        cfg = config
+        # The page pool is donated: the scatter writes then alias the
+        # input buffers instead of copying the whole pool every token
+        # (self.cache is unconditionally replaced by the result, so the
+        # consumed operands are never read again).
+        self._prefill = jax.jit(
+            lambda p, toks, length, k, v, table: paged_prefill(
+                p, toks, length, cfg,
+                _cache_like(self.cache, k, v), table),
+            donate_argnums=(3, 4))
+        self._decode = jax.jit(
+            lambda p, tok, k, v, bt, lens: paged_decode_step(
+                p, tok, cfg, _cache_like(self.cache, k, v), bt, lens),
+            donate_argnums=(2, 3))
+
+    # ------------------------------------------------------------ intake
+    def validate_request(self, request: Request) -> None:
+        """Raise ValueError for a request this engine can never serve.
+        Pure (no state change): safe to call from any thread — the HTTP
+        handlers reject bad requests here before marshaling into the
+        engine loop."""
+        n = len(request.tokens)
+        if n < 1:
+            raise ValueError(f"{request.request_id}: empty prompt")
+        bad = next((t for t in request.tokens
+                    if not 0 <= t < self.config.vocab_size), None)
+        if bad is not None:
+            # XLA's gather would silently clamp these — a wrong answer
+            # with a 200, not an error.
+            raise ValueError(
+                f"{request.request_id}: token id {bad} outside the "
+                f"model vocabulary [0, {self.config.vocab_size})")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"{request.request_id}: max_new_tokens must be >= 1")
+        total = n + request.max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"{request.request_id}: prompt ({n}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds max_model_len "
+                f"({self.max_model_len})")
+        if blocks_for(total, self.block_size) > self.allocator.capacity:
+            raise ValueError(
+                f"{request.request_id}: needs "
+                f"{blocks_for(total, self.block_size)} KV blocks, pool "
+                f"capacity is {self.allocator.capacity}")
+
+    def submit(self, request: Request) -> None:
+        self.validate_request(request)
+        self.waiting.append(_Sequence(request, submitted_at=self.clock()))
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    @property
+    def num_running(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    # ----------------------------------------------------------- stepping
+    def step(self) -> List[FinishedRequest]:
+        """One scheduler tick; returns requests that completed in it."""
+        finished: List[FinishedRequest] = []
+        self._admit(finished)
+        self._ensure_growth_pages()
+        if self.num_running:
+            self._decode_once(finished)
+        self._steps += 1
+        self._update_gauges()
+        return finished
+
+    def run_until_idle(self, max_steps: int = 100_000,
+                       ) -> List[FinishedRequest]:
+        out: List[FinishedRequest] = []
+        steps = 0
+        while self.has_work:
+            out.extend(self.step())
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"engine did not drain in {max_steps} steps "
+                    f"(waiting={len(self.waiting)}, "
+                    f"running={self.num_running})")
+        return out
+
+    # ------------------------------------------------------------- admit
+    def _admit(self, finished: List[FinishedRequest]) -> None:
+        while self.waiting:
+            if self.sequential and self.num_running:
+                return
+            slot = next((i for i, s in enumerate(self.slots) if s is None),
+                        None)
+            if slot is None:
+                return
+            seq = self.waiting[0]
+            prompt = list(seq.request.tokens) + list(seq.generated)
+            need = blocks_for(len(prompt), self.block_size)
+            if need > self.allocator.available:
+                return  # pool pressure: wait for frees, keep FIFO order
+            self.waiting.popleft()
+            seq.pages = self.allocator.alloc(need)
+            seq.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            self.slots[slot] = seq
+            self._prefill_sequence(seq, prompt)
+            metrics.counter("tk8s_serve_tokens_total").inc(
+                len(prompt), kind="prefill")
+            if self._maybe_finish(slot, finished):
+                continue
+
+    def _prefill_sequence(self, seq: _Sequence, prompt: List[int]) -> None:
+        padded = prompt + [0] * (self.prefill_width - len(prompt))
+        table = seq.pages + [TRASH_PAGE] * (self.blocks_per_seq
+                                            - len(seq.pages))
+        logits, cache = self._prefill(
+            self.params,
+            jnp.asarray([padded], jnp.int32),
+            jnp.asarray(len(prompt), jnp.int32),
+            self.cache.k, self.cache.v,
+            jnp.asarray(table, jnp.int32))
+        self.cache = cache
+        tok = self._sample(seq, logits[None, :])
+        seq.generated.append(tok)
+        if seq.first_token_at is None:
+            seq.first_token_at = self.clock()
+
+    # ------------------------------------------------- growth/preemption
+    def _ensure_growth_pages(self) -> None:
+        """Every running sequence gets the page its next written token
+        needs, preempting latest-admitted sequences when the pool is dry."""
+        for i in sorted(range(self.max_batch),
+                        key=lambda i: (self.slots[i].admit_seq
+                                       if self.slots[i] else -1)):
+            seq = self.slots[i]
+            if seq is None:
+                continue
+            while blocks_for(seq.length + 1,
+                             self.block_size) > len(seq.pages):
+                try:
+                    seq.pages.extend(self.allocator.alloc(1))
+                except OutOfBlocksError:
+                    victim = max(
+                        (j for j, s in enumerate(self.slots)
+                         if s is not None),
+                        key=lambda j: self.slots[j].admit_seq)
+                    self._preempt(victim)
+                    if victim == i:
+                        break  # preempted ourselves; re-admit later
+
+    def _preempt(self, slot: int) -> None:
+        seq = self.slots[slot]
+        assert seq is not None
+        self.allocator.free(seq.pages)
+        seq.pages = []
+        seq.admit_seq = -1
+        seq.preemptions += 1
+        self.slots[slot] = None
+        self.waiting.appendleft(seq)
+        metrics.counter("tk8s_serve_preemptions_total").inc()
+
+    # ------------------------------------------------------------ decode
+    def _decode_once(self, finished: List[FinishedRequest]) -> None:
+        tokens = [0] * self.max_batch
+        lengths = [0] * self.max_batch
+        tables = [[TRASH_PAGE] * self.blocks_per_seq
+                  for _ in range(self.max_batch)]
+        for i, seq in enumerate(self.slots):
+            if seq is None:
+                continue
+            tokens[i] = seq.generated[-1]
+            lengths[i] = seq.length
+            tables[i][:len(seq.pages)] = seq.pages
+        logits, cache = self._decode(
+            self.params,
+            jnp.asarray(tokens, jnp.int32),
+            self.cache.k, self.cache.v,
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(lengths, jnp.int32))
+        self.cache = cache
+        decoded = 0
+        for i, seq in enumerate(self.slots):
+            if seq is None:
+                continue
+            seq.generated.append(self._sample(seq, logits[i:i + 1]))
+            decoded += 1
+            self._maybe_finish(i, finished)
+        metrics.counter("tk8s_serve_tokens_total").inc(
+            decoded, kind="decode")
+
+    def _sample(self, seq: _Sequence, logits: jnp.ndarray) -> int:
+        """Sample position len(generated) of this request — keyed by the
+        request's own seed and position so the draw is independent of
+        batch composition and survives preemption/re-prefill."""
+        r = seq.request
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(r.seed), len(seq.generated))
+        return int(sample_token(
+            logits, key, r.temperature, r.top_k, r.top_p)[0])
+
+    def _maybe_finish(self, slot: int,
+                      finished: List[FinishedRequest]) -> bool:
+        seq = self.slots[slot]
+        assert seq is not None
+        r = seq.request
+        reason = None
+        if r.eos_id is not None and seq.generated[-1] == r.eos_id:
+            reason = "eos"
+        elif len(seq.generated) >= r.max_new_tokens:
+            reason = "length"
+        if reason is None:
+            return False
+        self.allocator.free(seq.pages)
+        self.slots[slot] = None
+        now = self.clock()
+        done = FinishedRequest(
+            request_id=r.request_id, prompt_len=len(r.tokens),
+            tokens=list(seq.generated), finish_reason=reason,
+            submitted_at=seq.submitted_at,
+            first_token_at=seq.first_token_at or now,
+            finished_at=now, preemptions=seq.preemptions)
+        finished.append(done)
+        metrics.counter("tk8s_serve_requests_total").inc(outcome=reason)
+        metrics.histogram("tk8s_serve_ttft_seconds").observe(done.ttft)
+        if len(done.tokens) > 1:
+            metrics.histogram("tk8s_serve_tpot_seconds").observe(done.tpot)
+        return True
+
+    # ------------------------------------------------------------ metrics
+    def _update_gauges(self) -> None:
+        metrics.gauge("tk8s_serve_queue_depth").set(len(self.waiting))
+        metrics.gauge("tk8s_serve_sequences").set(
+            self.num_running, state="running")
+        metrics.gauge("tk8s_serve_sequences").set(
+            len(self.waiting), state="waiting")
+        metrics.gauge("tk8s_serve_kv_blocks_in_use").set(
+            self.allocator.in_use)
+        metrics.gauge("tk8s_serve_kv_block_utilization").set(
+            self.allocator.in_use / max(1, self.allocator.capacity))
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "model": self.config.name,
+            "block_size": self.block_size,
+            "num_blocks": self.allocator.num_blocks,
+            "kv_blocks_in_use": self.allocator.in_use,
+            "kv_blocks_free": self.allocator.available,
+            "max_batch": self.max_batch,
+            "max_model_len": self.max_model_len,
+            "running": self.num_running,
+            "waiting": len(self.waiting),
+            "steps": self._steps,
+            "sequential": self.sequential,
+        }
+
+
+def _cache_like(template, k, v):
+    """Rebuild the NamedTuple from jit operands (jit flattens pytrees;
+    passing k/v explicitly keeps the signature donation-friendly)."""
+    return type(template)(k=k, v=v)

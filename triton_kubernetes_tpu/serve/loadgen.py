@@ -1,0 +1,64 @@
+"""Seeded Poisson open-loop load for the serving engine.
+
+Open-loop is the honest shape for "millions of users": arrivals come
+from the world on their own schedule, not gated on the server's previous
+response, so queueing shows up as queueing (TTFT growth) instead of
+silently throttling offered load the way a closed loop does. The
+schedule is fully determined by the seed — both A/B arms of
+scripts/ci/serving_evidence.py replay the *identical* request stream.
+
+Dependency-free (``random.Random``, like cloudsim's fault plans): no
+numpy on the provisioning-CLI side of the package.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List, Sequence
+
+
+@dataclass(frozen=True)
+class TimedRequest:
+    """One scheduled arrival: submit at ``at`` seconds from epoch 0."""
+
+    at: float
+    request_id: str
+    tokens: List[int]
+    max_new_tokens: int
+
+
+class PoissonSchedule:
+    """Seeded Poisson arrivals with uniform ragged prompt lengths."""
+
+    def __init__(self, *, rate: float, n: int, vocab_size: int,
+                 prompt_len_range: Sequence[int] = (4, 32),
+                 max_new_tokens: int = 16, seed: int = 0):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0 req/s, got {rate}")
+        rng = random.Random(seed)
+        lo, hi = prompt_len_range
+        t = 0.0
+        self.requests: List[TimedRequest] = []
+        for i in range(n):
+            t += rng.expovariate(rate)
+            plen = rng.randint(lo, hi)
+            self.requests.append(TimedRequest(
+                at=t, request_id=f"req-{i}",
+                tokens=[rng.randrange(vocab_size) for _ in range(plen)],
+                max_new_tokens=max_new_tokens))
+
+    def __iter__(self):
+        return iter(self.requests)
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+
+def percentile(values: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile (p in [0, 100]); 0.0 on empty input."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(1, -(-len(ordered) * p // 100))  # ceil
+    return ordered[int(rank) - 1]

@@ -1,0 +1,322 @@
+"""The ``tk8s serve`` HTTP front end.
+
+Same construction as the manager control plane (manager/server.py):
+stdlib ``ThreadingHTTPServer``, embeddable in tests as a context
+manager, Prometheus ``/metrics`` and ``/healthz`` unauthenticated. What
+is new is the threading shape: :class:`ServeEngine` is single-owner, so
+handler threads never touch it — they validate, enqueue a waiter into
+the engine loop's inbox, and block on its event. One **engine loop**
+thread drains the inbox, calls ``engine.step()`` while work exists, and
+resolves waiters as requests complete. Continuous batching falls out:
+requests that arrive while a step runs are admitted at the next tick
+and decode in the same batch as everything already running.
+
+Wire surface:
+
+========  ============  =========================================
+method    path          body / response
+========  ============  =========================================
+GET       /healthz      ``{"ok": true, "model": ...}``
+GET       /metrics      Prometheus text (tk8s_serve_* et al.)
+GET       /stats        engine scheduler/pool snapshot (JSON)
+POST      /generate     ``{"tokens": [ids...], "max_new_tokens": N,
+                        "temperature"/"top_k"/"top_p"/"eos_id"/"seed"}``
+                        → ``{"tokens": [...], "finish_reason",
+                        "ttft_s", "tpot_s", "preemptions", ...}``
+========  ============  =========================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import urlparse
+
+from ..utils import metrics
+from .engine import FinishedRequest, Request, ServeEngine
+
+# Default port for rendered manifests and the CLI (the serving analog of
+# the manager's API port; /metrics rides the same listener).
+SERVE_PORT = 8000
+
+_ROUTES = ("/healthz", "/metrics", "/stats", "/generate")
+
+
+def _route_label(path: str) -> str:
+    return path if path in _ROUTES else "other"
+
+
+@dataclass
+class _Waiter:
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Optional[FinishedRequest] = None
+    error: Optional[str] = None
+    fatal: bool = False  # loop death (503), not request rejection (400)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tk8s-serve"
+    serve: "ServeHTTPServer"  # injected by ServeHTTPServer
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        if os.environ.get("TK8S_SERVE_DEBUG"):
+            super().log_message(fmt, *args)
+
+    def send_response(self, code: int, message: Optional[str] = None) -> None:
+        self._last_code = code
+        super().send_response(code, message)
+
+    def _counted(self, handler) -> None:
+        self._last_code = 0
+        try:
+            handler()
+        finally:
+            metrics.counter("tk8s_serve_http_requests_total").inc(
+                route=_route_label(urlparse(self.path).path),
+                method=self.command, code=str(self._last_code))
+
+    def _json(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        self._counted(self._get)
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._counted(self._post)
+
+    def _get(self) -> None:
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            # Health is the ENGINE LOOP's, not this handler thread's: a
+            # dead scheduler must flip the liveness probe (the rendered
+            # Deployment restarts on /healthz), not serve 200 forever.
+            err = self.serve.loop_error
+            if err is not None:
+                self._json(503, {"ok": False, "error": err,
+                                 "model": self.serve.engine.config.name})
+                return
+            self._json(200, {"ok": True,
+                             "model": self.serve.engine.config.name})
+        elif path == "/metrics":
+            body = metrics.get_registry().render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/stats":
+            self._json(200, self.serve.engine.stats())
+        else:
+            self._json(404, {"type": "error", "message": "not found"})
+
+    def _post(self) -> None:
+        if urlparse(self.path).path != "/generate":
+            self._json(404, {"type": "error", "message": "not found"})
+            return
+        n = int(self.headers.get("Content-Length") or 0)
+        try:
+            d = json.loads(self.rfile.read(n) if n else b"{}")
+            if not isinstance(d, dict):
+                raise ValueError("body must be a JSON object")
+            tokens = d.get("tokens")
+            if (not isinstance(tokens, list)
+                    or not all(isinstance(t, int) for t in tokens)):
+                raise ValueError("'tokens' must be a list of token ids")
+            eos_id = d.get("eos_id")
+            opts = {
+                "max_new_tokens": int(d.get("max_new_tokens", 16)),
+                "temperature": float(d.get("temperature", 0.0)),
+                "top_k": int(d.get("top_k", 0)),
+                "top_p": float(d.get("top_p", 1.0)),
+                "eos_id": int(eos_id) if eos_id is not None else None,
+                "seed": int(d.get("seed", 0)),
+            }
+        except (ValueError, TypeError) as e:
+            # TypeError too: float(None)/int([]) from a malformed body is
+            # the caller's fault, not a handler crash.
+            self._json(400, {"type": "error", "message": str(e)})
+            return
+        try:
+            done = self.serve.generate(tokens, **opts)
+        except ValueError as e:  # engine validation: caller's fault
+            self._json(400, {"type": "error", "message": str(e)})
+            return
+        except (TimeoutError, RuntimeError) as e:
+            self._json(503, {"type": "error", "message": str(e)})
+            return
+        self._json(200, {
+            "request_id": done.request_id,
+            "tokens": done.tokens,
+            "prompt_len": done.prompt_len,
+            "finish_reason": done.finish_reason,
+            "ttft_s": done.ttft,
+            "tpot_s": done.tpot,
+            "preemptions": done.preemptions,
+        })
+
+
+class ServeHTTPServer:
+    """Embeddable serving endpoint:
+    ``with ServeHTTPServer(engine) as url: ...`` in tests;
+    ``serve_forever`` under ``tk8s serve``."""
+
+    def __init__(self, engine: ServeEngine, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout_s: float = 120.0):
+        self.engine = engine
+        self.request_timeout_s = request_timeout_s
+        self._inbox: "queue.Queue[Tuple[Request, _Waiter]]" = queue.Queue()
+        self._waiters: Dict[str, _Waiter] = {}
+        self._id_lock = threading.Lock()
+        self._next_id = 0
+        self._stop = threading.Event()
+        self._loop_error: Optional[str] = None
+        handler = type("Handler", (_Handler,), {"serve": self})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._engine_thread: Optional[threading.Thread] = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------- handler side
+    def generate(self, tokens, **opts) -> FinishedRequest:
+        with self._id_lock:
+            rid = f"req-{self._next_id}"
+            self._next_id += 1
+        request = Request(request_id=rid, tokens=list(tokens), **{
+            "max_new_tokens": opts.get("max_new_tokens", 16),
+            "temperature": opts.get("temperature", 0.0),
+            "top_k": opts.get("top_k", 0),
+            "top_p": opts.get("top_p", 1.0),
+            "eos_id": opts.get("eos_id"),
+            "seed": opts.get("seed", 0),
+        })
+        # Fail fast off-loop; the loop's own submit re-validates.
+        self.engine.validate_request(request)
+        if self._loop_error is not None:
+            raise RuntimeError(f"engine loop died: {self._loop_error}")
+        waiter = _Waiter()
+        self._inbox.put((request, waiter))
+        if not waiter.event.wait(self.request_timeout_s):
+            if self._loop_error is not None:
+                raise RuntimeError(
+                    f"engine loop died: {self._loop_error}")
+            raise TimeoutError(
+                f"{rid}: no completion within {self.request_timeout_s}s")
+        if waiter.fatal:
+            raise RuntimeError(waiter.error or "engine loop died")
+        if waiter.error is not None:
+            raise ValueError(waiter.error)
+        assert waiter.result is not None
+        return waiter.result
+
+    @property
+    def loop_error(self) -> Optional[str]:
+        """Why the engine loop died, or None while it is healthy."""
+        return self._loop_error
+
+    # ------------------------------------------------------- engine loop
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                # Drain the inbox; block briefly only when idle so
+                # shutdown and new arrivals are both prompt.
+                try:
+                    item = self._inbox.get(
+                        timeout=0.0 if self.engine.has_work else 0.05)
+                except queue.Empty:
+                    item = None
+                while item is not None:
+                    request, waiter = item
+                    try:
+                        self.engine.submit(request)
+                        self._waiters[request.request_id] = waiter
+                    except ValueError as e:
+                        waiter.error = str(e)
+                        waiter.event.set()
+                    try:
+                        item = self._inbox.get_nowait()
+                    except queue.Empty:
+                        item = None
+                if self.engine.has_work:
+                    for done in self.engine.step():
+                        waiter = self._waiters.pop(done.request_id, None)
+                        if waiter is not None:
+                            waiter.result = done
+                            waiter.event.set()
+        except BaseException as e:  # loop death is a liveness event
+            self._loop_error = f"{type(e).__name__}: {e}"
+            # Recorded, not re-raised: /healthz now fails (the manifest's
+            # liveness probe restarts the pod) and every blocked or
+            # future client gets a 503 instead of a silent 200 zombie.
+            self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        """Release every blocked client as 503 instead of a 120s hang:
+        in-flight waiters, then anything still queued in the inbox."""
+        msg = f"engine loop died: {self._loop_error}"
+        for waiter in list(self._waiters.values()):
+            waiter.error, waiter.fatal = msg, True
+            waiter.event.set()
+        self._waiters.clear()
+        while True:
+            try:
+                _, waiter = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            waiter.error, waiter.fatal = msg, True
+            waiter.event.set()
+
+    # ---------------------------------------------------------- lifecycle
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServeHTTPServer":
+        self._engine_thread = threading.Thread(target=self._loop,
+                                               daemon=True)
+        self._engine_thread.start()
+        self._http_thread = threading.Thread(
+            target=lambda: self.httpd.serve_forever(poll_interval=0.05),
+            daemon=True)
+        self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        for t in (self._engine_thread, self._http_thread):
+            if t is not None:
+                t.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        """Foreground mode (``tk8s serve``): engine loop on this thread's
+        watch, HTTP on the caller's thread."""
+        self._engine_thread = threading.Thread(target=self._loop,
+                                               daemon=True)
+        self._engine_thread.start()
+        try:
+            self.httpd.serve_forever()
+        finally:
+            self._stop.set()
+
+    def __enter__(self) -> "ServeHTTPServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

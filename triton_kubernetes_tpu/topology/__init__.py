@@ -26,6 +26,7 @@ from .labels import (
     verify_slice_labels,
 )
 from .jobset import render_headless_service, render_jobset
+from .serving import render_serving_deployment, render_serving_service
 
 __all__ = [
     "GKE_ACCELERATOR_LABEL",
@@ -39,6 +40,8 @@ __all__ = [
     "parse_accelerator",
     "render_headless_service",
     "render_jobset",
+    "render_serving_deployment",
+    "render_serving_service",
     "selector_for_slice",
     "verify_slice_labels",
 ]

@@ -1,0 +1,118 @@
+"""Deployment + Service rendering for the TPU serving workload.
+
+The serving counterpart of ``jobset.py``'s JobSet/headless-service pair:
+where training is a run-to-completion indexed Job spanning a whole
+slice, serving is a long-lived Deployment of single-host replicas behind
+a regular (cluster-IP'd) Service — requests need one stable VIP, not
+per-pod DNS. Replicas pin to the labeled TPU node pool through the same
+``selector_for_slice`` labels the trainer uses, which is the point: a
+provisioned cluster's acceptance test is this workload serving real
+traffic ("Evaluating Kubernetes Performance for GenAI Inference",
+PAPERS.md), so the manifests must exercise the exact labels provisioning
+promised.
+
+Like ``jobset.RESUME_EXIT_CODE``, the serving port is duplicated here
+rather than imported from ``serve/`` — rendering must never import the
+jax-loaded workload stack (pinned equal in tests/test_topology.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .labels import selector_for_slice
+from .slices import SliceSpec
+
+# serve.server.SERVE_PORT duplicate (see module docstring).
+SERVE_PORT = 8000
+
+APP_LABEL = "serve.tk8s.io/name"
+MODEL_LABEL = "serve.tk8s.io/model"
+
+
+def default_serve_command(model: str, port: int = SERVE_PORT) -> List[str]:
+    """The container command the image contract expects: the CLI's
+    ``serve`` verb, bound to all interfaces for the pod network."""
+    return ["triton-kubernetes-tpu", "serve", "--model", model,
+            "--serve-host", "0.0.0.0", "--port", str(port)]
+
+
+def render_serving_deployment(
+    name: str,
+    spec: SliceSpec,
+    slice_id: str,
+    image: str,
+    model: str,
+    replicas: int = 1,
+    namespace: str = "default",
+    env: Optional[Dict[str, str]] = None,
+    command: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """A Deployment of serving replicas on one labeled TPU pool.
+
+    Each replica is a single-host engine owning ``spec.chips_per_host``
+    chips (serving scales out in replicas behind the Service, not in
+    slice-wide collectives), so the natural pool is a single-host slice
+    shape like v5e-8; multi-host specs still render — each pod takes one
+    host's chips.
+    """
+    labels = {APP_LABEL: name, MODEL_LABEL: model}
+    container = {
+        "name": "server",
+        "image": image,
+        "command": command or default_serve_command(model),
+        "env": [{"name": k, "value": v} for k, v in sorted(
+            (env or {}).items())],
+        "ports": [{"containerPort": SERVE_PORT, "name": "http"}],
+        "resources": {"limits": {"google.com/tpu": str(spec.chips_per_host)}},
+        # One endpoint serves liveness and readiness: the engine loop
+        # answers /healthz as long as it can schedule at all.
+        "readinessProbe": {
+            "httpGet": {"path": "/healthz", "port": SERVE_PORT},
+            "periodSeconds": 5,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": SERVE_PORT},
+            "initialDelaySeconds": 30,
+            "periodSeconds": 10,
+        },
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": dict(labels)},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {APP_LABEL: name}},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {
+                    "nodeSelector": selector_for_slice(spec, slice_id),
+                    "containers": [container],
+                },
+            },
+        },
+    }
+
+
+def render_serving_service(
+    name: str,
+    namespace: str = "default",
+    service_type: str = "ClusterIP",
+) -> Dict[str, Any]:
+    """The VIP in front of the serving replicas. ``/metrics`` rides the
+    same port, so a Prometheus scrape of the Service endpoints covers
+    every replica with no extra wiring."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": {APP_LABEL: name}},
+        "spec": {
+            "type": service_type,
+            "selector": {APP_LABEL: name},
+            "ports": [{"name": "http", "port": SERVE_PORT,
+                       "targetPort": SERVE_PORT}],
+        },
+    }

@@ -151,6 +151,37 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
     "tk8s_train_checkpoint_fallback_restores_total": (
         "counter", "Restores that quarantined a bad step and fell back "
         "to an earlier verified one", (), None),
+    # ------------------------------------------- serve/engine.py + server
+    "tk8s_serve_requests_total": (
+        "counter", "Serving requests completed, by outcome "
+        "(eos/length/error)", ("outcome",), None),
+    "tk8s_serve_tokens_total": (
+        "counter", "Tokens processed by the serving engine, by phase "
+        "(prefill tokens ingested vs decode tokens generated)",
+        ("kind",), None),
+    "tk8s_serve_ttft_seconds": (
+        "histogram", "Time to first token: request submission to the "
+        "first sampled token (prefill completion)", (), DEFAULT_BUCKETS),
+    "tk8s_serve_tpot_seconds": (
+        "histogram", "Time per output token after the first, averaged "
+        "per request at completion", (), DEFAULT_BUCKETS),
+    "tk8s_serve_queue_depth": (
+        "gauge", "Requests waiting for a decode slot / KV pages", (), None),
+    "tk8s_serve_sequences": (
+        "gauge", "Sequences by scheduler state (running = in a decode "
+        "slot, waiting = queued or preempted)", ("state",), None),
+    "tk8s_serve_kv_blocks_in_use": (
+        "gauge", "KV-cache pages currently allocated to sequences", (),
+        None),
+    "tk8s_serve_kv_block_utilization": (
+        "gauge", "Allocated fraction of the allocatable KV page pool "
+        "(0..1); sustained ~1.0 means admission is page-bound", (), None),
+    "tk8s_serve_preemptions_total": (
+        "counter", "Running sequences evicted to free KV pages "
+        "(recompute-on-readmit)", (), None),
+    "tk8s_serve_http_requests_total": (
+        "counter", "Serving HTTP requests by route, method, and "
+        "response code", ("route", "method", "code"), None),
     # --------------------------------- train/resilience.py (anomaly guard)
     "tk8s_train_anomaly_rollbacks_total": (
         "counter", "Loss-anomaly rollbacks taken by the guarded "
