@@ -84,10 +84,19 @@ def _child() -> None:
     from triton_kubernetes_tpu.topology.slices import peak_bf16_tflops_for_kind
     from triton_kubernetes_tpu.train import (
         flops_per_token, init_state, make_optimizer, make_train_step, mfu)
+    from triton_kubernetes_tpu.train import precision as _precision
     from triton_kubernetes_tpu.train.data import synthetic_batches
 
     def log(msg: str) -> None:
         print(f"[bench-child] {msg}", file=sys.stderr, flush=True)
+
+    def emit_partial(**data) -> None:
+        # Machine-readable progress on stderr: a child the parent kills
+        # mid-attempt has already banked every number it measured — the
+        # parent merges these markers into the final JSON (tagged
+        # partial) instead of discarding the attempt (ROADMAP 4a).
+        print(f"[bench-child] partial={json.dumps(data)}",
+              file=sys.stderr, flush=True)
 
     cache_dir = os.environ.get("TK8S_COMPILE_CACHE_DIR", "")
     if cache_dir:
@@ -120,10 +129,11 @@ def _child() -> None:
     state = init_state(config, mesh, opt)
     # Resolve attention explicitly so kernel forfeits (dense-einsum
     # fallbacks) are visible in the published metrics, not just as
-    # warnings on stderr.
+    # warnings on stderr. The config rides along: llama3-bench pins
+    # attention="flash", which is what puts the kernel in the HLO.
     from triton_kubernetes_tpu.train.trainer import _resolve_attention
 
-    attn = _resolve_attention(None, mesh)
+    attn = _resolve_attention(None, mesh, config)
     step = make_train_step(config, mesh, opt, attention_fn=attn)
 
     gen = synthetic_batches(config.vocab_size, batch_size, seq_len)
@@ -153,17 +163,34 @@ def _child() -> None:
         flash_in_hlo = "tpu_custom_call" in hlo or "mosaic" in hlo.lower()
     except Exception as e:
         log(f"kernel-evidence inspection failed: {type(e).__name__}: {e}")
+    emit_partial(lower_seconds=round(lower_seconds, 2),
+                 flash_kernel_in_hlo=flash_in_hlo)
     log(f"phase=compile (lower took {lower_seconds:.1f}s)")
     t0 = time.perf_counter()
     step = lowered.compile()
     compile_seconds = time.perf_counter() - t0
+    from triton_kubernetes_tpu.train.trainer import memory_stats
+
+    mem = memory_stats(step)
+    mem_fields = {} if mem is None else {
+        "temp_bytes": mem.temp_bytes, "peak_bytes": mem.peak_bytes}
+    emit_partial(compile_seconds=round(compile_seconds, 2), **mem_fields)
     log(f"phase=steps (compile took {compile_seconds:.1f}s)")
+
+    def on_window(name: str, n: int, dt: float) -> None:
+        # Provisional rate includes fixed dispatch overhead the two-point
+        # subtraction would cancel — a floor, not the headline number.
+        emit_partial(**{
+            f"{name}_window_seconds": round(dt, 2),
+            "provisional_tokens_per_sec": round(
+                batch_size * seq_len * n / max(dt, 1e-9), 1)})
+
     # One host sync per timed window (measure's default): the short and
     # long windows then carry the SAME sync count, so the two-point
     # subtraction cancels the fetch overhead instead of embedding it.
     tps, last_loss, state = measure_tokens_per_sec(
         step, state, batches, batch_size * seq_len, warmup, n_short, n_long,
-        config_name=config.name)
+        config_name=config.name, on_window=on_window)
 
     # Loop-overlap evidence from the metrics registry: syncs took must be
     # per-window, not per-step (the pipelined-loop contract).
@@ -210,6 +237,13 @@ def _child() -> None:
         "loss": round(last_loss, 4),
         "attention_forfeits": list(getattr(attn, "forfeits", [])),
         "flash_kernel_in_hlo": flash_in_hlo,
+        # The numerics the number was measured under (train/precision.py
+        # policy names; llama3-bench pins attention="flash" so the TPU
+        # HLO must carry the kernel) + the compiled step's memory split.
+        "attention": config.attention,
+        "precision": _precision.policy_of(config),
+        "remat": _precision.remat_policy_of(config),
+        **mem_fields,
         # Compile-vs-step split (persistent cache makes the warm-attempt
         # compile collapse toward zero) + loop-overlap evidence.
         "lower_seconds": round(lower_seconds, 2),
@@ -268,10 +302,30 @@ def _last_phase(stderr: str) -> str:
     return phase or "init"
 
 
+def _parse_partials(stderr: str) -> dict:
+    """Merge every ``[bench-child] partial={...}`` marker the child got
+    out before dying. Later markers override earlier keys, so the result
+    is the most-advanced snapshot: a child killed at phase=steps still
+    contributes its lower/compile split and any finished timing windows
+    instead of the whole attempt being discarded (ROADMAP 4a)."""
+    merged: dict = {}
+    for line in stderr.splitlines():
+        payload = line.partition("partial=")[2]
+        if not line.startswith("[bench-child]") or not payload:
+            continue
+        try:
+            data = json.loads(payload)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(data, dict):
+            merged.update(data)
+    return merged
+
+
 def _run_attempt(extra_args: list, env_overrides: dict,
-                 timeout: float) -> tuple[dict | None, str, str]:
+                 timeout: float) -> tuple[dict | None, str, str, dict]:
     """Run the child once. Returns (parsed json line | None, error class,
-    last observed child phase)."""
+    last observed child phase, merged partial-progress markers)."""
     env = dict(os.environ)
     env.update(env_overrides)
     # Every attempt (and every round) reuses one persistent XLA cache:
@@ -296,22 +350,23 @@ def _run_attempt(extra_args: list, env_overrides: dict,
         stdout, stderr = fout.read(), ferr.read()
     sys.stderr.write(stderr[-4000:])
     phase = _last_phase(stderr)
+    partial = _parse_partials(stderr)
     if rc is None:
         # Attributable timeout: which phase was the child in when the
         # budget ran out? (timeout@compile means "grow the cache budget",
         # timeout@init means "died before the first marker — tunnel/
         # import hang" — different fixes.)
-        return None, f"timeout@{phase}", phase
+        return None, f"timeout@{phase}", phase, partial
     if rc != 0:
-        return None, _error_class(stderr[-4000:]), phase
+        return None, _error_class(stderr[-4000:]), phase, partial
     for line in reversed(stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line), "", phase
+                return json.loads(line), "", phase, partial
             except json.JSONDecodeError:
                 continue
-    return None, "no_json_output", phase
+    return None, "no_json_output", phase, partial
 
 
 def main() -> None:
@@ -338,7 +393,7 @@ def main() -> None:
             print(f"[bench] TPU init probe (timeout {probe_timeout:.0f}s, "
                   f"platform {tpu_platform})", file=sys.stderr, flush=True)
             t0 = time.monotonic()
-            result, err, phase = _run_attempt(
+            result, err, phase, _ = _run_attempt(
                 ["--probe"], {"JAX_PLATFORMS": tpu_platform}, probe_timeout)
             took = time.monotonic() - t0
             if result is None or result.get("probe_platform") not in (
@@ -356,6 +411,11 @@ def main() -> None:
                       file=sys.stderr, flush=True)
         else:
             errors.append("tpu_probe_skipped_budget_exhausted")
+    # The most-advanced partial snapshot across failed TPU attempts: a
+    # child killed after its lower/compile split (or mid-measurement)
+    # still contributes those numbers to the round's JSON, tagged
+    # ``partial: true``, instead of being discarded.
+    tpu_partial: dict = {}
     for attempt in range(TPU_ATTEMPTS if tpu_alive else 0):
         # Always reserve the CPU-fallback budget: a hung TPU attempt must
         # not starve stage 2, or the round records no measured number.
@@ -367,7 +427,7 @@ def main() -> None:
         print(f"[bench] TPU attempt {attempt + 1}/{TPU_ATTEMPTS} "
               f"(timeout {timeout:.0f}s, platform {tpu_platform})",
               file=sys.stderr, flush=True)
-        result, err, phase = _run_attempt(
+        result, err, phase, partial = _run_attempt(
             [], {"JAX_PLATFORMS": tpu_platform}, timeout)
         if result is not None and result.get("platform") in (
                 "tpu", tpu_platform):
@@ -382,6 +442,10 @@ def main() -> None:
         if not err.startswith("timeout@"):
             err = f"{err}@{phase}"
         errors.append(f"tpu_attempt_{attempt + 1}:{err}")
+        if len(partial) > len(tpu_partial.get("measured", {})):
+            tpu_partial = {"partial": True,
+                           "attempt": f"tpu_attempt_{attempt + 1}:{err}",
+                           "measured": partial}
         if attempt + 1 < TPU_ATTEMPTS:
             # Longer backoff helps a flapping tunnel more than a fast
             # retry (observed recovery times are minutes, not seconds).
@@ -391,21 +455,30 @@ def main() -> None:
     remaining = deadline - time.monotonic()
     if remaining > 30:
         print("[bench] falling back to CPU", file=sys.stderr, flush=True)
-        result, err, phase = _run_attempt(
+        result, err, phase, partial = _run_attempt(
             ["--platform=cpu"], {}, min(CPU_ATTEMPT_TIMEOUT, remaining))
         if result is not None:
             result["error"] = "tpu_unreachable_cpu_fallback"
             result["tpu_errors"] = errors
+            if tpu_partial:
+                result["tpu_partial"] = tpu_partial
             print(json.dumps(result), flush=True)
             return
         if not err.startswith("timeout@"):
             err = f"{err}@{phase}"
         errors.append(f"cpu:{err}")
+        # A failed fallback banks its progress too — the `attempt` tag
+        # keeps the snapshot's origin attributable in the stage-3 JSON.
+        if len(partial) > len(tpu_partial.get("measured", {})):
+            tpu_partial = {"partial": True, "attempt": f"cpu:{err}",
+                           "measured": partial}
     else:
         errors.append("cpu_skipped_budget_exhausted")
 
-    # Stage 3: nothing measured — still exactly one JSON line, no traceback.
-    print(json.dumps({
+    # Stage 3: nothing measured — still exactly one JSON line, no
+    # traceback; partial TPU progress (lower/compile split, finished
+    # timing windows) rides along rather than being discarded.
+    line = {
         "metric": f"{TPU_BENCH_CONFIG}_train_tokens_per_sec_per_chip",
         "value": 0.0,
         "unit": "tokens/s/chip",
@@ -413,7 +486,10 @@ def main() -> None:
         # Headline class = the first already-classified failure.
         "error": errors[0].split(":", 1)[-1] if errors else "unknown",
         "error_detail": errors,
-    }), flush=True)
+    }
+    if tpu_partial:
+        line["tpu_partial"] = tpu_partial
+    print(json.dumps(line), flush=True)
     sys.exit(1)
 
 

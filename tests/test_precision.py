@@ -1,0 +1,243 @@
+"""Precision policies + rematerialization knobs (ISSUE 7 tentpole).
+
+train/precision.py: f32 master params/optimizer state with bf16
+compute/activations, pinned against the f32 baseline (loss trajectory
+within tolerance, every gradient leaf finite); models/llama.py
+remat_block: the none/dots/full memory<->FLOPs trade measured through
+``compiled.memory_analysis()``, with the math invariant across policies.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.models import get_config
+from triton_kubernetes_tpu.models.config import ModelConfig
+from triton_kubernetes_tpu.parallel import MeshConfig, create_mesh
+from triton_kubernetes_tpu.train import (
+    POLICIES,
+    aot_compile_step,
+    apply_policy,
+    get_policy,
+    grads_all_finite,
+    init_state,
+    make_optimizer,
+    make_train_step,
+    memory_stats,
+    policy_of,
+)
+from triton_kubernetes_tpu.train.data import synthetic_batches
+from triton_kubernetes_tpu.utils import metrics as metrics_mod
+
+
+def _mesh_opt():
+    mesh = create_mesh(MeshConfig(fsdp=4, tensor=2))
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    return mesh, opt
+
+
+def _batches(n, batch=8, seq=64, vocab=256):
+    gen = synthetic_batches(vocab, batch, seq)
+    return [{"tokens": jnp.asarray(next(gen)["tokens"])} for _ in range(n)]
+
+
+# ------------------------------------------------------------ policy module
+
+def test_policy_registry_and_lookup():
+    assert set(POLICIES) == {"f32", "bf16"}
+    p = get_policy("bf16")
+    assert p.param_dtype == "float32"  # master state NEVER leaves f32
+    assert p.compute_dtype == "bfloat16"
+    assert get_policy(p) is p
+    assert "bf16" in p.describe() and "float32" in p.describe()
+    with pytest.raises(KeyError, match="fp8"):
+        get_policy("fp8")
+
+
+def test_apply_policy_rewrites_config_dtypes():
+    cfg = get_config("llama-test")  # ships f32 compute
+    out = apply_policy(cfg, "bf16")
+    assert out.dtype == "bfloat16" and out.param_dtype == "float32"
+    assert policy_of(out) == "bf16"
+    # Identity forms: None / "auto" / already-matching policy.
+    assert apply_policy(cfg, None) is cfg
+    assert apply_policy(cfg, "auto") is cfg
+    assert apply_policy(out, "bf16") is out
+    assert policy_of(cfg) == "f32"
+    assert policy_of(get_config("llama-test", param_dtype="float16")) == \
+        "custom"
+
+
+def test_config_validates_remat_and_attention():
+    with pytest.raises(ValueError, match="remat_policy"):
+        get_config("llama-test", remat_policy="half")
+    with pytest.raises(ValueError, match="attention"):
+        get_config("llama-test", attention="ring")
+    # "none" is a real policy now (the A/B baseline arm).
+    assert get_config("llama-test", remat_policy="none").remat_policy == \
+        "none"
+
+
+def test_grads_all_finite_flags_nan():
+    good = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2), jnp.bfloat16)}
+    assert bool(grads_all_finite(good))
+    bad = {"a": jnp.array([1.0, jnp.nan, 2.0]), "b": good["b"]}
+    assert not bool(grads_all_finite(bad))
+    assert not bool(grads_all_finite({"a": jnp.array([jnp.inf])}))
+
+
+# ------------------------------------------- bf16 vs f32 training contracts
+
+def test_bf16_loss_trajectory_tracks_f32(cpu_mesh_devices):
+    """The tentpole numerics contract: bf16 compute over f32 master state
+    follows the f32 loss trajectory within a pinned tolerance (measured
+    headroom ~20x: max per-step delta ~2e-3 on this config)."""
+    mesh, opt = _mesh_opt()
+    batches = _batches(8)
+    cfg = get_config("llama-test", max_seq_len=64)
+
+    def traj(config):
+        state = init_state(config, mesh, opt)
+        step = make_train_step(config, mesh, opt)
+        losses = []
+        for b in batches:
+            state, m = step(state, b)
+            losses.append(float(m["loss"]))
+        return losses
+
+    f32 = traj(apply_policy(cfg, "f32"))
+    bf16 = traj(apply_policy(cfg, "bf16"))
+    assert all(np.isfinite(bf16))
+    np.testing.assert_allclose(bf16, f32, atol=0.05)
+
+
+def test_bf16_master_state_and_grads_stay_f32(cpu_mesh_devices):
+    """Under the bf16 policy the *storage* stays f32 — params, Adam
+    moments, and the grads the optimizer consumes — while activations
+    flow bf16; and every gradient leaf is finite (bf16 keeps the f32
+    exponent range, so no loss scaling is needed or used)."""
+    from triton_kubernetes_tpu.models import llama
+    from triton_kubernetes_tpu.train.trainer import loss_fn
+
+    mesh, opt = _mesh_opt()
+    cfg = apply_policy(get_config("llama-test"), "bf16")
+    state = init_state(cfg, mesh, opt)
+    for leaf in jax.tree.leaves(state.params):
+        assert leaf.dtype == jnp.float32
+    mu = state.opt_state[1][0].mu
+    for leaf in jax.tree.leaves(mu):
+        assert leaf.dtype == jnp.float32
+
+    batch = _batches(1, batch=4, seq=16)[0]
+    hidden, _ = llama.forward_hidden(state.params, batch["tokens"][:, :-1],
+                                     cfg)
+    assert hidden.dtype == jnp.bfloat16  # activations really are bf16
+
+    grads = jax.grad(lambda p: loss_fn(p, batch["tokens"], cfg)[0])(
+        state.params)
+    assert bool(grads_all_finite(grads))
+    for leaf in jax.tree.leaves(grads):
+        assert leaf.dtype == jnp.float32  # cotangents inherit master dtype
+
+
+def test_make_train_step_precision_param(cpu_mesh_devices):
+    """``make_train_step(precision=...)`` is the one-knob form: it builds
+    the SAME program as pre-applying the policy to the config (lowered
+    HLO text compared — no double compile+execute needed)."""
+    mesh, opt = _mesh_opt()
+    cfg = get_config("llama-test")
+    batch = _batches(1, batch=8, seq=32)[0]
+
+    state = init_state(apply_policy(cfg, "bf16"), mesh, opt)
+    via_param = make_train_step(cfg, mesh, opt, precision="bf16")
+    via_config = make_train_step(apply_policy(cfg, "bf16"), mesh, opt)
+    assert via_param.lower(state, batch).as_text() == \
+        via_config.lower(state, batch).as_text()
+
+
+# --------------------------------------------------- remat policy contracts
+
+def test_remat_policy_does_not_change_the_math(cpu_mesh_devices):
+    """Rematerialization trades FLOPs for memory and must move NOTHING
+    else: every policy's first-step loss and grad norm match the
+    remat=False reference to float tolerance. One reference, three
+    policies — state re-inits identically per arm (the step donates)."""
+    mesh, opt = _mesh_opt()
+    batch = _batches(1, batch=8, seq=32)[0]
+
+    ref_cfg = get_config("llama-test", remat=False)
+    state = init_state(ref_cfg, mesh, opt)
+    _, ref = make_train_step(ref_cfg, mesh, opt)(state, batch)
+
+    # "none" needs no arm: remat_block returns the body unchanged there
+    # (test_remat_policy_none_equals_remat_off_program), so its program
+    # IS the reference program.
+    for policy in ("dots", "full"):
+        cfg = get_config("llama-test", remat=True, remat_policy=policy)
+        state = init_state(cfg, mesh, opt)
+        _, got = make_train_step(cfg, mesh, opt)(state, batch)
+        np.testing.assert_allclose(float(got["loss"]), float(ref["loss"]),
+                                   rtol=1e-6, err_msg=policy)
+        np.testing.assert_allclose(float(got["grad_norm"]),
+                                   float(ref["grad_norm"]), rtol=1e-5,
+                                   err_msg=policy)
+
+
+def test_remat_reduces_measured_temp_bytes(cpu_mesh_devices):
+    """The memory side of the trade, proven by ``memory_analysis()`` on
+    the compiled step (not claimed): full cuts temp bytes >= 25% vs none
+    — the same gate the CI evidence script holds (measured locally: ~86%
+    on this shape; the evidence artifact also covers the dots arm and
+    the full<dots<none ordering)."""
+    mesh, opt = _mesh_opt()
+    gen = synthetic_batches(256, 16, 128)
+    batch = {"tokens": jnp.asarray(next(gen)["tokens"])}
+    temp = {}
+    for policy in ("none", "full"):
+        cfg = get_config("llama-test", num_layers=8, max_seq_len=128,
+                         remat=True, remat_policy=policy)
+        state = init_state(cfg, mesh, opt)
+        old = metrics_mod.get_registry()
+        reg = metrics_mod.configure()
+        try:
+            compiled, _ = aot_compile_step(
+                make_train_step(cfg, mesh, opt), state, batch,
+                config_name=f"remat-{policy}")
+            mem = memory_stats(compiled)
+            assert mem is not None and mem.temp_bytes > 0
+            assert mem.peak_bytes >= mem.temp_bytes
+            # aot_compile_step published the same numbers to the gauge.
+            gauge = metrics_mod.gauge("tk8s_train_memory_bytes")
+            assert gauge.value(config=f"remat-{policy}", kind="temp") == \
+                mem.temp_bytes
+            assert gauge.value(config=f"remat-{policy}", kind="peak") == \
+                mem.peak_bytes
+        finally:
+            metrics_mod.configure(old)
+        del reg
+        temp[policy] = mem.temp_bytes
+    assert temp["full"] <= 0.75 * temp["none"], temp
+
+
+def test_remat_policy_none_equals_remat_off_program():
+    """remat_policy="none" and remat=False build the identical body —
+    one knob, not two half-overlapping ones."""
+    from triton_kubernetes_tpu.models.llama import remat_block
+
+    body = lambda c, l: (c, l)
+    cfg_off = get_config("llama-test", remat=False)
+    cfg_none = get_config("llama-test", remat=True, remat_policy="none")
+    assert remat_block(body, cfg_off) is body
+    assert remat_block(body, cfg_none) is body
+    cfg_full = get_config("llama-test", remat=True, remat_policy="full")
+    assert remat_block(body, cfg_full) is not body
+
+
+def test_precision_config_is_a_real_modelconfig():
+    """apply_policy round-trips through the frozen dataclass validation
+    (a typo'd dtype fails loudly at policy definition, not at trace)."""
+    cfg = apply_policy(get_config("llama-test"), "bf16")
+    assert isinstance(cfg, ModelConfig)
+    assert cfg.activation_dtype == jnp.bfloat16
+    assert cfg.weight_dtype == jnp.float32

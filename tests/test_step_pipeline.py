@@ -71,15 +71,26 @@ def test_prefetch_fake_clock_wait_accounting():
 def test_prefetch_threaded_overlap_wait_near_zero():
     """When the producer runs ahead (finite source, fully drained into
     the queue before the consumer asks), the consumer's measured input
-    wait is ~0 — host input fully overlaps 'compute'."""
+    wait is ~0 — host input fully overlaps 'compute'.
+
+    Deflaked (PR 6 observed this fail only under concurrent machine
+    load): the producer fill is waited-for and *attributed* separately —
+    a starved box fails with its own message instead of corrupting the
+    wait measurement — and the slack covers scheduler noise. The
+    contract under test is the accounting ("a pre-staged buffer charges
+    no producer stall to the consumer"), not machine speed; real stalls
+    cost a production each and are covered by the slow-producer test."""
     batches = _host_batches(4)
     pf = DevicePrefetch(iter(batches), buffer_size=4)
-    deadline = time.time() + 5.0
-    while pf._queue.qsize() < 4 and time.time() < deadline:
+    deadline = time.time() + 30.0
+    while pf._queue.qsize() < 4:
+        if time.time() > deadline:
+            pytest.fail("prefetch producer starved for 30s — machine "
+                        "overload, not a DevicePrefetch defect")
         time.sleep(0.005)  # let the producer thread run ahead
     out = list(pf)
     assert len(out) == 4
-    assert pf.wait_seconds < 0.25  # µs-scale in practice; CI-safe slack
+    assert pf.wait_seconds < 2.0  # µs-scale in practice; load-safe slack
 
 
 def test_prefetch_threaded_slow_producer_wait_is_visible():
@@ -363,10 +374,81 @@ def test_bench_timeout_before_first_marker_is_timeout_at_init(monkeypatch):
         raise subprocess.TimeoutExpired(argv, kwargs.get("timeout", 0))
 
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
-    result, err, phase = bench._run_attempt([], {}, timeout=1.0)
+    result, err, phase, partial = bench._run_attempt([], {}, timeout=1.0)
     assert result is None
     assert err == "timeout@init"
     assert phase == "init"
+    assert partial == {}  # died before any marker: nothing to salvage
+
+
+def test_bench_parse_partials_merges_markers():
+    """Partial markers merge newest-wins, ignore malformed payloads, and
+    ignore non-child lines — the salvage path for a timed-out attempt."""
+    import bench
+
+    err = ("[bench-child] phase=lower\n"
+           '[bench-child] partial={"lower_seconds": 12.5, '
+           '"flash_kernel_in_hlo": true}\n'
+           'noise partial={"lower_seconds": 999}\n'
+           "[bench-child] partial=not-json\n"
+           '[bench-child] partial={"compile_seconds": 3.0, '
+           '"lower_seconds": 12.5}\n')
+    assert bench._parse_partials(err) == {
+        "lower_seconds": 12.5, "flash_kernel_in_hlo": True,
+        "compile_seconds": 3.0}
+    assert bench._parse_partials("no markers") == {}
+
+
+def test_bench_timed_out_child_salvages_partials(monkeypatch):
+    """ROADMAP 4a: a child killed AFTER emitting its lower/compile split
+    (and a finished timing window) contributes those numbers through
+    ``_run_attempt`` instead of the attempt being discarded."""
+    import subprocess
+
+    import bench
+
+    def fake_run(argv, stdout=None, stderr=None, **kwargs):
+        stderr.write(
+            "[bench-child] phase=lower\n"
+            '[bench-child] partial={"lower_seconds": 30.1}\n'
+            "[bench-child] phase=compile (lower took 30.1s)\n"
+            '[bench-child] partial={"compile_seconds": 210.0, '
+            '"temp_bytes": 1024}\n'
+            "[bench-child] phase=steps (compile took 210.0s)\n"
+            '[bench-child] partial={"warmup_window_seconds": 9.0, '
+            '"provisional_tokens_per_sec": 4096.0}\n')
+        raise subprocess.TimeoutExpired(argv, kwargs.get("timeout", 0))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    result, err, phase, partial = bench._run_attempt([], {}, timeout=1.0)
+    assert result is None
+    assert err == "timeout@steps" and phase == "steps"
+    assert partial == {
+        "lower_seconds": 30.1, "compile_seconds": 210.0,
+        "temp_bytes": 1024, "warmup_window_seconds": 9.0,
+        "provisional_tokens_per_sec": 4096.0}
+
+
+def test_measure_on_window_reports_each_window(cpu_mesh_devices,
+                                               fresh_registry):
+    """measure_tokens_per_sec announces every finished window (name,
+    steps, seconds) — what the bench child turns into partial markers so
+    a killed measurement still reports the windows it completed."""
+    import jax.numpy as jnp
+
+    from triton_kubernetes_tpu.train.measure import measure_tokens_per_sec
+
+    cfg, mesh, opt, step = _tiny_setup()
+    state = init_state(cfg, mesh, opt)
+    batch = {"tokens": jnp.asarray(_host_batches(1)[0]["tokens"])}
+    seen = []
+    tps, loss, state = measure_tokens_per_sec(
+        step, state, [batch], tokens_per_step=4 * 32,
+        warmup=1, n_short=2, n_long=4, config_name="llama-test",
+        on_window=lambda name, n, dt: seen.append((name, n, dt > 0)))
+    assert tps > 0
+    assert seen == [("warmup", 1, True), ("short", 2, True),
+                    ("long", 4, True)]
 
 
 def test_bench_compile_cache_dir_env_override(monkeypatch):

@@ -227,6 +227,75 @@ def test_flash_kernel_survives_kv_heads_below_tensor(cpu_mesh_devices,
                                rtol=1e-4, atol=1e-4)
 
 
+def test_config_attention_flash_matches_dense(cpu_mesh_devices):
+    """The flash-in-HLO wiring (ISSUE 7): config.attention="flash" forces
+    the Pallas kernel through the REAL resolution path — no monkeypatch —
+    running interpret-mode off TPU, shard_map-wrapped on the multi-device
+    mesh, numerically matching the dense einsum step. This is the exact
+    config mechanism llama3-bench ships with, so the benched HLO carries
+    the kernel on any TPU lowering."""
+    cfg_flash = get_config("llama-test", attention="flash")
+    cfg_dense = get_config("llama-test", attention="dense")
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=2, decay_steps=100)
+    batch = next(synthetic_batches(cfg_flash.vocab_size, 4, 32))
+    tokens = jnp.asarray(batch["tokens"])
+
+    from triton_kubernetes_tpu.train import trainer
+
+    attn = trainer._resolve_attention(None, mesh, cfg_flash)
+    assert attn is not None  # "flash" must not resolve to the dense path
+    state = init_state(cfg_flash, mesh, opt)
+    step = make_train_step(cfg_flash, mesh, opt)
+    state, metrics = step(state, {"tokens": tokens})
+    flash_loss = float(metrics["loss"])
+
+    assert trainer._resolve_attention(None, mesh, cfg_dense) is None
+    # The dense baseline is honored on EVERY mesh shape — including a
+    # sharded seq axis, which the auto heuristic would hand to ring.
+    seq_mesh = create_mesh(MeshConfig(fsdp=2, seq=2, tensor=2))
+    assert trainer._resolve_attention(None, seq_mesh, cfg_dense) is None
+    assert trainer._resolve_attention(None, seq_mesh) is not None  # ring
+    state2 = init_state(cfg_dense, mesh, opt)
+    step2 = make_train_step(cfg_dense, mesh, opt)
+    state2, metrics2 = step2(state2, {"tokens": tokens})
+    np.testing.assert_allclose(flash_loss, float(metrics2["loss"]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_config_attention_flash_model_level_parity():
+    """models.llama honors config.attention directly (bench, generate,
+    eval — no trainer in the loop): forward under "flash" equals the
+    dense forward at standard positions, and a caller passing EXPLICIT
+    positions (ragged prefill) keeps the dense einsum — the forced kernel
+    ignores its positions operand and would silently mis-mask."""
+    from triton_kubernetes_tpu.models import llama
+
+    cfg = get_config("llama-test", attention="flash")
+    cfg_dense = get_config("llama-test", attention="dense")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        next(synthetic_batches(cfg.vocab_size, 2, 32))["tokens"][:, :-1])
+
+    out_flash, _ = llama.forward(params, tokens, cfg)
+    out_dense, _ = llama.forward(params, tokens, cfg_dense)
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_dense),
+                               rtol=2e-4, atol=2e-4)
+
+    # Ragged positions: config-forced flash must NOT apply.
+    pos = jnp.broadcast_to(jnp.arange(5, 5 + 32, dtype=jnp.int32), (2, 32))
+    got, _ = llama.forward(params, tokens, cfg, positions=pos)
+    want, _ = llama.forward(params, tokens, cfg_dense, positions=pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_bench_config_pins_flash_attention():
+    """The headline config must force the kernel into its own HLO —
+    "auto" left it to mesh heuristics, which is how BENCH_r01-r05 shipped
+    flash_kernel_in_hlo: false."""
+    assert get_config("llama3-bench").attention == "flash"
+
+
 def test_flash_forfeit_is_loud(cpu_mesh_devices, monkeypatch):
     """When no exact sharding exists (hq not divisible by tensor), the dense
     fallback must warn and record the reason — never silently eat ~2x."""

@@ -129,6 +129,31 @@ def test_anomaly_and_emergency_flags_clean_run(cpu_mesh_devices, tmp_path,
     assert train[-1]["step"] == 4 and np.isfinite(train[-1]["loss"])
 
 
+def test_precision_and_remat_flags(cpu_mesh_devices, capsys):
+    """--precision bf16 + --remat-policy thread end to end in ONE run:
+    the policy log line records the applied dtypes, --remat-policy dots
+    re-arms remat even though llama-test ships remat=False (no
+    --model-opt incantation needed), the compile log carries the
+    measured memory split, and the run trains to a finite loss under
+    bf16."""
+    rc, err = _run(capsys, [
+        "--model", "llama-test", "--steps", "2", "--batch-size", "4",
+        "--seq-len", "32", "--fsdp", "4", "--tensor", "2",
+        "--precision", "bf16", "--remat-policy", "dots",
+        "--log-every", "1", "--json-logs"])
+    assert rc == 0
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    pol = [l for l in lines if l["msg"] == "precision policy"][0]
+    assert pol["policy"] == "bf16"
+    assert pol["compute_dtype"] == "bfloat16"
+    assert pol["param_dtype"] == "float32"
+    assert pol["remat"] == "dots"  # re-armed over the config's remat=False
+    compiled = [l for l in lines if l["msg"] == "train step compiled"][0]
+    assert compiled.get("temp_mib", 0) > 0  # memory_analysis published
+    train = [l for l in lines if l["msg"] == "train"]
+    assert train and np.isfinite(train[-1]["loss"])
+
+
 def test_bad_batch_divisibility(cpu_mesh_devices, capsys):
     rc, _ = _run(capsys, [
         "--model", "llama-test", "--steps", "1", "--batch-size", "3",

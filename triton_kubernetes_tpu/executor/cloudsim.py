@@ -30,7 +30,7 @@ import os
 import signal as _signal
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from ..manager import protocol
 from ..utils import metrics
@@ -213,8 +213,14 @@ class CloudSimulator:
 
     def __init__(self, state: Optional[Dict[str, Any]] = None,
                  fault_plan: Optional[Dict[str, Any]] = None,
-                 op_latency: Optional[Any] = None):
+                 op_latency: Optional[Any] = None,
+                 sleep: Callable[[float], None] = time.sleep):
         s = state or {}
+        # Injectable sleeper (the executor/serve-engine pattern): tests
+        # assert latency *accounting* against a recorder instead of
+        # wall-clock thresholds that flake under concurrent machine load.
+        # Not serialized — a timing implementation, not timing model.
+        self._sleep = sleep
         self.resources: Dict[str, Dict[str, Any]] = s.get("resources", {})
         self.managers: Dict[str, Dict[str, Any]] = s.get("managers", {})
         self.clusters: Dict[str, Dict[str, Any]] = s.get("clusters", {})
@@ -300,7 +306,7 @@ class CloudSimulator:
                                       module_op=module_op)
         latency = self._op_latency_s(op)
         if latency > 0:
-            time.sleep(latency)
+            self._sleep(latency)
 
     # ------------------------------------------------------------- serialization
     def to_dict(self) -> Dict[str, Any]:

@@ -42,14 +42,33 @@ class ModelConfig:
     remat: bool = True  # checkpoint each block: trade FLOPs for HBM
     # "full": recompute the whole block in backward (max HBM savings);
     # "dots": save MXU outputs, recompute only elementwise (norms, rotary,
-    # silu) — near-zero recompute FLOPs, still drops fused temporaries.
+    # silu) — near-zero recompute FLOPs, still drops fused temporaries;
+    # "none": save everything (fastest step, largest live activations) —
+    # equivalent to remat=False, selectable so the A/B is one knob.
     remat_policy: str = "full"
+    # Attention kernel selection (models.llama.resolve_attention):
+    # "auto"  — model default is the dense einsum; the trainer upgrades to
+    #           the best kernel for its mesh (flash on TPU, ring when the
+    #           seq axis is sharded);
+    # "dense" — force the einsum everywhere, any mesh (A/B baseline);
+    # "flash" — force the Pallas blockwise kernel: the benched HLO carries
+    #           the Mosaic custom-call on TPU, and off-TPU the same kernel
+    #           runs in Pallas interpret mode (CPU parity tests). A
+    #           sharded seq axis still resolves to ring attention — the
+    #           same blockwise online-softmax recurrence, distributed;
+    # "flash-interpret" — interpret mode on every backend (tests only).
+    attention: str = "auto"
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("none", "full", "dots"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got "
+                f"remat_policy must be 'none', 'full', or 'dots', got "
                 f"{self.remat_policy!r}")
+        if self.attention not in ("auto", "dense", "flash",
+                                  "flash-interpret"):
+            raise ValueError(
+                f"attention must be 'auto', 'dense', 'flash', or "
+                f"'flash-interpret', got {self.attention!r}")
         if self.moe_dispatch not in ("auto", "dense", "sort"):
             raise ValueError(
                 f"moe_dispatch must be 'auto', 'dense', or 'sort', got "
@@ -131,10 +150,17 @@ MIXTRAL_8X7B = _register(ModelConfig(
 # flag had silently defaulted off here (BENCH_r05). Parity vs the dense
 # head is pinned in tests/test_train.py::test_fused_ce_matches_logits_path
 # and the op-level grads test.
+# attention="flash": the benched HLO must CONTAIN the Pallas kernel —
+# bench.py's flash_kernel_in_hlo flag exists to catch a silent dense
+# fallback, and "auto" left the choice to the trainer's mesh heuristics.
+# Forced here, any TPU lowering of this config carries the Mosaic
+# custom-call; off-TPU the same kernel runs interpret-mode, parity-pinned
+# in tests/test_train.py::test_config_attention_flash_matches_dense.
 LLAMA3_BENCH = _register(ModelConfig(
     name="llama3-bench", vocab_size=32_768, embed_dim=1024, num_layers=24,
     num_heads=8, num_kv_heads=4, head_dim=128, mlp_dim=4096,
-    max_seq_len=2048, remat_policy="dots", fused_ce=True))
+    max_seq_len=2048, remat_policy="dots", fused_ce=True,
+    attention="flash"))
 
 # ---- CPU-mesh test miniatures (dims divisible by 2-way tp/sp/fsdp) ----
 LLAMA_TEST = _register(ModelConfig(

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import causal_attention
+from ..ops.attention import auto_attention, causal_attention
 from ..ops.moe import moe_layer
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rotary, rotary_tables
@@ -37,6 +37,46 @@ AttentionFn = Callable[
 
 def _dense_attention(q, k, v, positions):
     return causal_attention(q, k, v, positions, positions)
+
+
+def resolve_attention(config: ModelConfig,
+                      platform: Optional[str] = None,
+                      ) -> Optional[AttentionFn]:
+    """``config.attention`` -> attention fn, or None for the dense einsum.
+
+    "flash" forces the Pallas blockwise kernel; off-TPU it runs in Pallas
+    interpret mode so the SAME code path is testable (and parity-pinned)
+    on CPU. "auto" returns the platform's best full-sequence kernel
+    (flash on TPU, dense elsewhere) — mesh-aware upgrades (ring attention,
+    shard_map wrapping) stay in ``train.trainer._resolve_attention``,
+    which builds on this. Forced kernels assume standard positions
+    (0..S-1); ``forward_hidden`` falls back to the dense einsum when a
+    caller passes explicit positions (ragged prefill, packed sequences).
+    """
+    mode = config.attention
+    if mode == "dense":
+        return None
+    if mode in ("flash", "flash-interpret"):
+        from ..ops.flash_attention import flash_attention
+
+        platform = platform or jax.default_backend()
+        interpret = mode == "flash-interpret" or platform != "tpu"
+        return lambda q, k, v, positions: flash_attention(
+            q, k, v, interpret=interpret)
+    return auto_attention(platform) if platform is not None else None
+
+
+def remat_block(body: Callable, config: ModelConfig) -> Callable:
+    """Apply the configured rematerialization policy to a block body —
+    the single source of the remat knob for the sequential stack and the
+    pipeline stages (train/pipeline.py). "none" (or remat=False) saves
+    everything; "full" recomputes the whole block in backward; "dots"
+    saves MXU outputs and recomputes only elementwise ops."""
+    if not config.remat or config.remat_policy == "none":
+        return body
+    policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+              if config.remat_policy == "dots" else None)
+    return jax.checkpoint(body, policy=policy)
 
 
 def init_params(config: ModelConfig, key: jax.Array) -> Params:
@@ -195,8 +235,14 @@ def forward_hidden(
     states [B, S, D] before the final norm, moe aux loss scalar). The
     fused-CE path (ops/fused_ce.py) consumes this so [B, S, V] logits are
     never materialized."""
-    attention_fn = attention_fn or _dense_attention
     b, s = tokens.shape
+    if attention_fn is None:
+        # Config-forced kernels only apply at standard positions: a forced
+        # flash kernel ignores its positions operand, so callers with
+        # explicit positions (ragged prefill) keep the dense einsum.
+        if positions is None:
+            attention_fn = resolve_attention(config)
+        attention_fn = attention_fn or _dense_attention
     ad = config.activation_dtype
     if positions is None:
         positions = jnp.broadcast_to(
@@ -211,10 +257,7 @@ def forward_hidden(
             carry, layer, config, cos, sin, positions, attention_fn)
         return out, aux
 
-    if config.remat:
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if config.remat_policy == "dots" else None)
-        body = jax.checkpoint(body, policy=policy)
+    body = remat_block(body, config)
     if config.scan_layers:
         x, auxs = lax.scan(body, x, params["layers"])
         aux_total = auxs.sum()

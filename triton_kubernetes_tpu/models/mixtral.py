@@ -11,9 +11,12 @@ lowering of the dispatch/combine einsums in ``ops/moe.py``.
 from __future__ import annotations
 
 from .config import ModelConfig, get_config
-from .llama import forward, init_params, logical_axes
+from .llama import (
+    forward, forward_hidden, init_params, logical_axes, remat_block,
+    resolve_attention)
 
-__all__ = ["forward", "init_params", "logical_axes", "config_8x7b", "ModelConfig"]
+__all__ = ["forward", "forward_hidden", "init_params", "logical_axes",
+           "remat_block", "resolve_attention", "config_8x7b", "ModelConfig"]
 
 
 def config_8x7b(**overrides) -> ModelConfig:

@@ -39,6 +39,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 spells it TPUCompilerParams; the fields used here (only
+# dimension_semantics) are identical. Without this shim every kernel —
+# including interpret mode, which is how the CPU parity suite runs —
+# dies at trace time on older jax.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 
 # Block sweep on v5e (llama3-bench, seq 2048, 2026-07-30, tok/s):
@@ -184,7 +191,7 @@ def _flash_forward_flat(qt, kt, vt, hq, hkv, sq, sk,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
@@ -299,7 +306,7 @@ def _flash_backward(qt, kt, vt, out_flat, lse, g,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bhq, sq_p, d), qt.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt, dot, lse, dvec)
@@ -328,7 +335,7 @@ def _flash_backward(qt, kt, vt, out_flat, lse, g,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt, dot, lse, dvec)

@@ -72,7 +72,9 @@ def ring_attention_inner(
     computation trips the sdy verifier under grad). Without ``positions``
     the classic derivation from the axis index is used (top-level callers).
     """
-    n = lax.axis_size(axis_name)
+    from ..utils.jaxcompat import axis_size
+
+    n = axis_size(axis_name)
     b, s_loc, hq, d = q.shape
     hkv = k.shape[2]
     g = hq // hkv
@@ -139,13 +141,15 @@ def make_ring_attention(
     else:
         kwargs["mesh"] = mesh
 
-    sm_nopos = jax.shard_map(
+    from ..utils.jaxcompat import shard_map as _shard_map
+
+    sm_nopos = _shard_map(
         lambda q, k, v: ring_attention_inner(q, k, v, seq_axis),
         in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
     # Positions-operand variant: positions are [B, S] standard ranges; the
     # local [B_loc, S_loc] shard's first row is every row's positions. Used
     # under the pipeline, where axis-index-free bodies are required.
-    sm_pos = jax.shard_map(
+    sm_pos = _shard_map(
         lambda q, k, v, p: ring_attention_inner(
             q, k, v, seq_axis, positions=p[0]),
         in_specs=(spec, spec, spec, pos_spec), out_specs=spec, **kwargs)

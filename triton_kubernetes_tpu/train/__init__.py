@@ -23,14 +23,25 @@ from .resilience import (
     ResilienceReport,
     run_resilient,
 )
+from .precision import (
+    POLICIES,
+    PrecisionPolicy,
+    apply_policy,
+    get_policy,
+    grads_all_finite,
+    policy_of,
+    remat_policy_of,
+)
 from .trainer import (
     CompileTimings,
+    MemoryStats,
     TrainState,
     aot_compile_step,
     enable_compile_cache,
     init_state,
     make_optimizer,
     make_train_step,
+    memory_stats,
 )
 
 __all__ = [
@@ -58,4 +69,13 @@ __all__ = [
     "CompileTimings",
     "aot_compile_step",
     "enable_compile_cache",
+    "MemoryStats",
+    "memory_stats",
+    "POLICIES",
+    "PrecisionPolicy",
+    "apply_policy",
+    "get_policy",
+    "grads_all_finite",
+    "policy_of",
+    "remat_policy_of",
 ]

@@ -81,6 +81,21 @@ def _build_parser() -> argparse.ArgumentParser:
                         "--model-opt fused_ce=true --model-opt "
                         "remat_policy=dots); values coerce like YAML "
                         "scalars")
+    p.add_argument("--precision", choices=["auto", "f32", "bf16"],
+                   default="auto",
+                   help="precision policy (train/precision.py): f32 "
+                        "master params + optimizer state always; bf16 "
+                        "casts compute/activations (softmax and CE "
+                        "accumulation stay f32); auto keeps the model "
+                        "config's own dtypes")
+    p.add_argument("--remat-policy",
+                   choices=["none", "dots", "full"], default="",
+                   help="rematerialization of the transformer block: "
+                        "none saves every activation (fastest step, "
+                        "largest memory), dots saves MXU outputs and "
+                        "recomputes elementwise ops, full recomputes "
+                        "whole blocks (max memory savings); default: "
+                        "the model config's policy")
     p.add_argument("--profile-dir", default="",
                    help="capture a jax.profiler trace of steady-state "
                         "steps into this directory (view with "
@@ -172,7 +187,19 @@ def main(argv=None) -> int:
         cache = enable_compile_cache(args.compile_cache_dir)
         log.log("info", "persistent compile cache",
                 dir=cache or "(unsupported by this jax)")
+    if args.remat_policy:
+        # The dedicated flag wins outright: it both selects the policy
+        # and arms/disarms remat itself, so a stale --model-opt
+        # remat=false cannot silently turn "dots"/"full" into a no-op.
+        overrides["remat_policy"] = args.remat_policy
+        overrides["remat"] = args.remat_policy != "none"
     config = get_config(args.model, **overrides)
+    from .precision import apply_policy, policy_of, remat_policy_of
+
+    config = apply_policy(config, args.precision)
+    log.log("info", "precision policy", policy=policy_of(config),
+            compute_dtype=config.dtype, param_dtype=config.param_dtype,
+            remat=remat_policy_of(config))
     seq_len = args.seq_len or config.max_seq_len
     mesh_cfg = MeshConfig(
         data=args.data, stage=args.stage, fsdp=args.fsdp, seq=args.seq,
@@ -314,10 +341,18 @@ def main(argv=None) -> int:
         else:
             step_fn, timings = aot_compile_step(
                 step_fn, state, first, config_name=config.name)
+            from .trainer import memory_stats
+
+            mem = memory_stats(step_fn)
+            mem_fields = {}
+            if mem is not None:
+                mem_fields = dict(
+                    temp_mib=round(mem.temp_bytes / 2**20, 1),
+                    peak_mib=round(mem.peak_bytes / 2**20, 1))
             log.log("info", "train step compiled",
                     lower_s=round(timings.lower_seconds, 3),
                     compile_s=round(timings.compile_seconds, 3),
-                    cache_dir=timings.cache_dir or "")
+                    cache_dir=timings.cache_dir or "", **mem_fields)
             first_iter = itertools.chain([first], first_iter)
     holder = {"it": first_iter, "pf": first_pf}
 

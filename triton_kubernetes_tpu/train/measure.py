@@ -26,19 +26,23 @@ def measure_tokens_per_sec(step, state, batches: List[Dict[str, Any]],
                            n_short: int, n_long: int,
                            sync_every: int = 0,
                            config_name: str = "",
+                           on_window=None,
                            ) -> Tuple[float, float, Any]:
     """Returns (tokens/sec, last loss, final state). ``n_long`` must
     exceed ``n_short`` (the timed window is their difference).
     ``sync_every`` sets the host-sync cadence inside each window; 0 syncs
     once at the window end (the historical behavior — the whole window is
-    in flight)."""
+    in flight). ``on_window(name, steps, seconds)`` fires as each window
+    completes (warmup/short/long) — bench.py's partial-progress markers,
+    so a measurement killed mid-run still reports the windows it
+    finished."""
     from .pipeline import run_pipelined
 
     if n_long <= n_short:
         raise ValueError(
             f"n_long ({n_long}) must exceed n_short ({n_short})")
 
-    def run(n):
+    def run(name, n):
         nonlocal state
         t0 = time.perf_counter()
         loss = float("nan")
@@ -48,10 +52,13 @@ def measure_tokens_per_sec(step, state, batches: List[Dict[str, Any]],
                 sync_every=sync_every or n,
                 tokens_per_step=tokens_per_step, config_name=config_name)
             loss = report.losses[-1]  # fetched at the window's sync point
-        return time.perf_counter() - t0, loss
+        dt = time.perf_counter() - t0
+        if on_window is not None and n:
+            on_window(name, n, dt)
+        return dt, loss
 
-    run(warmup)
-    t_short, _ = run(n_short)
-    t_long, loss = run(n_long)
+    run("warmup", warmup)
+    t_short, _ = run("short", n_short)
+    t_long, loss = run("long", n_long)
     dt = max(t_long - t_short, 1e-9)
     return tokens_per_step * (n_long - n_short) / dt, loss, state

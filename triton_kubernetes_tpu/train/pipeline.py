@@ -79,6 +79,10 @@ def pipeline_forward(
     full-batch forward — pipelined MoE training uses microbatch-local
     routing/capacity by design.
     """
+    # No config-forced kernel resolution here: a raw (un-shard_mapped)
+    # Pallas call under the stage map's GSPMD-managed axes would silently
+    # all-gather and replicate. Kernel selection for the pipeline lives
+    # in trainer._resolve_attention, which builds the nested shard_map.
     attention_fn = attention_fn or llama._dense_attention
     b, s = tokens.shape
     if b % microbatches:
@@ -105,10 +109,7 @@ def pipeline_forward(
                 carry, layer, config, cos, sin, pos, attention_fn)
             return out, aux
 
-        if config.remat:
-            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                      if config.remat_policy == "dots" else None)
-            body = jax.checkpoint(body, policy=policy)
+        body = llama.remat_block(body, config)
         x, auxs = lax.scan(body, x, layers_s)
         return x, auxs.sum()
 
@@ -146,8 +147,10 @@ def pipeline_forward(
                 jax.tree.map(lambda l: l[0], layers_s), x[0], pos[0])
             return out[None], aux[None]
 
+        from ..utils.jaxcompat import shard_map as _shard_map
+
         stage_specs = jax.tree.map(lambda _: P(AXIS_STAGE), stage_layers)
-        stage_map = jax.shard_map(
+        stage_map = _shard_map(
             _one_stage, mesh=mesh,
             in_specs=(stage_specs, P(AXIS_STAGE), P(AXIS_STAGE)),
             out_specs=(P(AXIS_STAGE), P(AXIS_STAGE)),

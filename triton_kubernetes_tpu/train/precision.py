@@ -1,0 +1,121 @@
+"""Mixed-precision policies for the training step.
+
+The TPU recipe ("Fine-Tuning and Serving Gemma on Cloud TPU", PAPERS.md)
+is bf16 compute over f32 master state: matmul operands and activations in
+bfloat16 so the MXU runs at full rate and live activation bytes halve,
+while everything that accumulates — master params, Adam moments, the
+attention softmax, RMS-norm reductions, the CE logsumexp — stays float32.
+The model layer already enforces the reduction side (ops/attention.py
+casts logits to f32 before softmax, the flash kernel accumulates in f32
+VMEM scratch, ops/fused_ce.py runs its online logsumexp and the logit
+cotangent in f32, ops/norms.py reduces in f32); what a policy chooses is
+the *storage and matmul operand* dtypes, i.e. exactly the
+``param_dtype``/``dtype`` pair of :class:`..models.config.ModelConfig`.
+
+A policy is therefore applied by rewriting the config
+(:func:`apply_policy`) before the step is built — no tracing-time dtype
+threading, no chance of a half-applied policy: the one config object the
+model reads is the policy. ``jax.grad`` cotangents inherit the f32 leaf
+dtype of the master params, so the optimizer update runs in f32 without
+any explicit upcast, and bf16's f32-sized exponent range means no loss
+scaling is needed (unlike fp16).
+
+Parity contracts live in tests/test_precision.py: the bf16 loss
+trajectory tracks f32 within a pinned tolerance and every gradient leaf
+stays finite. The CI A/B (scripts/ci/precision_remat_evidence.py)
+re-proves both on every push through the pipelined loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Storage/compute dtype pair for one training step.
+
+    ``param_dtype`` is what the master params and (by zeros_like
+    inheritance) the optimizer moments are stored in; ``compute_dtype``
+    is what weights are cast to at their point of use and what
+    activations flow in. Softmax/norm/CE reductions are f32 by
+    construction in the ops layer regardless of policy.
+    """
+
+    name: str
+    param_dtype: str
+    compute_dtype: str
+
+    def describe(self) -> str:
+        return (f"{self.name}: params/opt {self.param_dtype}, "
+                f"compute/activations {self.compute_dtype}, "
+                f"reductions float32")
+
+
+POLICIES: Dict[str, PrecisionPolicy] = {
+    # Everything f32: the numerics baseline the bf16 trajectory is
+    # pinned against, and the debugging escape hatch.
+    "f32": PrecisionPolicy("f32", "float32", "float32"),
+    # The production TPU recipe: f32 master state, bf16 matmuls.
+    "bf16": PrecisionPolicy("bf16", "float32", "bfloat16"),
+}
+
+
+def get_policy(policy: Union[str, PrecisionPolicy]) -> PrecisionPolicy:
+    if isinstance(policy, PrecisionPolicy):
+        return policy
+    try:
+        return POLICIES[policy]
+    except KeyError:
+        raise KeyError(
+            f"unknown precision policy {policy!r}; know "
+            f"{sorted(POLICIES)}") from None
+
+
+def apply_policy(config: ModelConfig,
+                 policy: Union[str, PrecisionPolicy, None],
+                 ) -> ModelConfig:
+    """Config with the policy's dtypes applied; None/"auto" is identity
+    (the config's own dtypes — llama3-bench ships bf16, the test
+    miniatures f32 — stay authoritative unless a policy overrides)."""
+    if policy is None or policy == "auto":
+        return config
+    p = get_policy(policy)
+    if (config.dtype == p.compute_dtype
+            and config.param_dtype == p.param_dtype):
+        return config
+    return replace(config, dtype=p.compute_dtype, param_dtype=p.param_dtype)
+
+
+def policy_of(config: ModelConfig) -> str:
+    """Classify a config's dtype pair back to a policy name ("custom"
+    when no named policy matches) — for logs and bench JSON."""
+    for name, p in POLICIES.items():
+        if (config.dtype == p.compute_dtype
+                and config.param_dtype == p.param_dtype):
+            return name
+    return "custom"
+
+
+def remat_policy_of(config: ModelConfig) -> str:
+    """The effective rematerialization policy name ("none" when remat is
+    disabled either way) — the single normalization bench.py and the
+    trainer log share, matching models.llama.remat_block's gating."""
+    return "none" if not config.remat else config.remat_policy
+
+
+def grads_all_finite(grads: Any) -> jnp.ndarray:
+    """Scalar bool: every leaf of the gradient tree is NaN/Inf-free.
+    Jit-safe (a device scalar, no host sync) — the grads-finite contract
+    the precision tests and the CI evidence script assert."""
+    leaves = jax.tree.leaves(grads)
+    ok = jnp.bool_(True)
+    for leaf in leaves:
+        ok = ok & jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
+    return ok

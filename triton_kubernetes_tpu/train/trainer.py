@@ -37,10 +37,13 @@ from ..parallel.sharding import DEFAULT_RULES, spec_tree_from_logical
 from .pipeline import pipeline_degree, pipeline_forward
 
 
-def _resolve_attention(attention_fn, mesh: Mesh):
+def _resolve_attention(attention_fn, mesh: Mesh, config=None):
     """None -> the best kernel for the mesh: ring attention when the seq
     axis is sharded, the Pallas flash kernel on multi-device TPU meshes,
-    dense einsum otherwise.
+    dense einsum otherwise. ``config.attention`` overrides the heuristic
+    ("flash" forces the Pallas kernel — interpret-mode off TPU — and
+    "dense" forces the einsum); a sharded seq axis still takes ring
+    attention, which IS the blockwise flash recurrence.
 
     On a multi-device mesh the pallas call must be wrapped in shard_map —
     GSPMD cannot partition a Mosaic custom-call, so an unwrapped kernel
@@ -54,6 +57,13 @@ def _resolve_attention(attention_fn, mesh: Mesh):
     """
     if attention_fn is not None:
         return attention_fn
+    mode = getattr(config, "attention", "auto") if config is not None \
+        else "auto"
+    if mode == "dense":
+        # Forced einsum baseline, honored on EVERY mesh — including a
+        # sharded seq axis, where GSPMD partitions the einsum correctly
+        # (via all-gathers; slow is the point of a baseline arm).
+        return None
     pp = pipeline_degree(mesh) > 1
     if mesh.shape[AXIS_SEQ] > 1:
         # Sequence-sharded: ring attention IS the flash path (blockwise
@@ -87,7 +97,15 @@ def _resolve_attention(attention_fn, mesh: Mesh):
 
         ring_attn.forfeits = []  # ring IS the kernel path; nothing forfeited
         return ring_attn
-    flash = auto_attention(mesh.devices.flat[0].platform)
+    platform = mesh.devices.flat[0].platform
+    if mode in ("flash", "flash-interpret"):
+        flash = llama.resolve_attention(config, platform)
+    else:
+        # "auto" dispatches through the trainer-global auto_attention on
+        # purpose (not llama.resolve_attention): tests and the flagship
+        # AOT harness monkeypatch trainer.auto_attention to substitute
+        # the interpret-mode kernel, and the heuristic is trainer-owned.
+        flash = auto_attention(platform)
     if flash is None or mesh.size == 1:
         return flash
     spec = P((AXIS_DATA, AXIS_FSDP), None, AXIS_TENSOR, None)
@@ -97,7 +115,9 @@ def _resolve_attention(attention_fn, mesh: Mesh):
         sm_kwargs["axis_names"] = {AXIS_DATA, AXIS_FSDP, AXIS_TENSOR}
     else:
         sm_kwargs["mesh"] = mesh
-    kernel = jax.shard_map(
+    from ..utils.jaxcompat import shard_map as _shard_map
+
+    kernel = _shard_map(
         lambda q, k, v: flash(q, k, v, None), **sm_kwargs)
     tensor = mesh.shape[AXIS_TENSOR]
 
@@ -247,16 +267,24 @@ def make_train_step(
     attention_fn=None,
     rules=None,
     microbatches: int = 0,
+    precision=None,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict]]:
     """Returns jitted (state, batch) -> (state, metrics); donates state.
 
     On a mesh with ``stage`` > 1 the forward runs the GPipe schedule in
     ``train.pipeline``; ``microbatches`` defaults to the stage count (set it
-    higher to shrink the pipeline bubble).
+    higher to shrink the pipeline bubble). ``precision`` names a
+    :mod:`.precision` policy ("f32"/"bf16"; None/"auto" keeps the
+    config's own dtypes) applied to the config before the step is built —
+    the state from ``init_state`` must have been built against the same
+    policy-applied config.
     """
+    from .precision import apply_policy
+
+    config = apply_policy(config, precision)
     b_sharding = NamedSharding(mesh, batch_spec())
     num_stages = pipeline_degree(mesh)
-    attention_fn = _resolve_attention(attention_fn, mesh)
+    attention_fn = _resolve_attention(attention_fn, mesh, config)
     microbatches = microbatches or num_stages
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
@@ -316,6 +344,41 @@ def enable_compile_cache(cache_dir: str) -> Optional[str]:
     return cache_dir
 
 
+@dataclass(frozen=True)
+class MemoryStats:
+    """Per-device byte accounting of one compiled step, straight from
+    XLA's ``compiled.memory_analysis()``. ``temp_bytes`` is the number a
+    rematerialization policy moves (live activations + collective
+    buffers); ``argument_bytes`` is what a precision policy's storage
+    dtypes move; ``peak_bytes`` is the fit-in-HBM total (donated args
+    alias their outputs, so un-aliased output bytes are the residual)."""
+
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    alias_bytes: int
+
+    @property
+    def peak_bytes(self) -> int:
+        return (self.argument_bytes + self.temp_bytes
+                + max(self.output_bytes - self.alias_bytes, 0))
+
+
+def memory_stats(compiled: Any) -> Optional[MemoryStats]:
+    """MemoryStats of an AOT-compiled step, or None when this backend /
+    jax build exposes no analysis (the knob is evidence, not load-bearing:
+    a missing analysis must never fail a training run)."""
+    try:
+        ma = compiled.memory_analysis()
+        return MemoryStats(
+            argument_bytes=int(ma.argument_size_in_bytes),
+            output_bytes=int(ma.output_size_in_bytes),
+            temp_bytes=int(ma.temp_size_in_bytes),
+            alias_bytes=int(ma.alias_size_in_bytes))
+    except Exception:
+        return None
+
+
 def aot_compile_step(
     step_fn: Callable,
     state: Any,
@@ -325,9 +388,11 @@ def aot_compile_step(
 ) -> Tuple[Callable, CompileTimings]:
     """Explicit ``jit(...).lower().compile()`` of a train step, with the
     lower-vs-compile wall-clock split measured and published through the
-    ``tk8s_train_compile_seconds`` gauge. The returned executable keeps
-    the jitted step's donation (state updates in place in HBM) and runs
-    with zero retracing risk — the loop can't silently recompile."""
+    ``tk8s_train_compile_seconds`` gauge, and the compiled program's
+    memory analysis through ``tk8s_train_memory_bytes`` (the evidence
+    remat/precision A/Bs read). The returned executable keeps the jitted
+    step's donation (state updates in place in HBM) and runs with zero
+    retracing risk — the loop can't silently recompile."""
     from ..utils import metrics as _metrics
 
     t0 = clock()
@@ -345,13 +410,23 @@ def aot_compile_step(
     gauge = _metrics.gauge("tk8s_train_compile_seconds")
     gauge.set(timings.lower_seconds, config=config_name, phase="lower")
     gauge.set(timings.compile_seconds, config=config_name, phase="compile")
+    mem = memory_stats(compiled)
+    if mem is not None:
+        mem_gauge = _metrics.gauge("tk8s_train_memory_bytes")
+        for kind in ("argument", "output", "temp", "alias"):
+            mem_gauge.set(getattr(mem, f"{kind}_bytes"),
+                          config=config_name, kind=kind)
+        mem_gauge.set(mem.peak_bytes, config=config_name, kind="peak")
     return compiled, timings
 
 
 def make_eval_step(config: ModelConfig, mesh: Mesh, attention_fn=None,
-                   microbatches: int = 0):
+                   microbatches: int = 0, precision=None):
+    from .precision import apply_policy
+
+    config = apply_policy(config, precision)
     b_sharding = NamedSharding(mesh, batch_spec())
-    attention_fn = _resolve_attention(attention_fn, mesh)
+    attention_fn = _resolve_attention(attention_fn, mesh, config)
     num_stages = pipeline_degree(mesh)
     microbatches = microbatches or num_stages
 

@@ -1,0 +1,66 @@
+"""Version shims for the jax APIs the speed path depends on.
+
+The kernels and shard_map wrappers target current jax (``jax.shard_map``,
+``pltpu.CompilerParams``); CI and dev containers sometimes pin jax < 0.5,
+where the same features live under older names
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``/``auto``).
+Before this shim every kernel-path test on such an environment died at
+trace time with an AttributeError — the flash kernel and ring attention
+were unrunnable, which is exactly the silent-forfeit failure mode the
+bench's ``flash_kernel_in_hlo`` flag exists to catch. One adapter, used
+by every shard_map call site, keeps the modern call signature everywhere
+and translates only when the modern API is missing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def axis_size(axis_name: Any) -> int:
+    """``jax.lax.axis_size`` (jax >= 0.5), or the classic pmap-era
+    ``psum(1, axis)`` — which constant-folds to a static int inside a
+    manual computation — on older jax."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f: Callable, *, mesh: Optional[Any] = None,
+              in_specs: Any, out_specs: Any,
+              check_vma: Optional[bool] = None,
+              axis_names: Optional[Any] = None) -> Callable:
+    """``jax.shard_map`` with graceful degradation to the pre-0.5 API.
+
+    Modern jax: a direct passthrough (including the partial-manual
+    ``axis_names`` form against the ambient mesh). Old jax: the
+    full-manual form is translated to
+    ``jax.experimental.shard_map.shard_map`` (``check_vma`` becomes
+    ``check_rep``); partial-manual forms raise NotImplementedError naming
+    the jax floor — the old ``auto=`` spelling has been observed to abort
+    the whole process (a C++ crash, not an exception) on these programs,
+    so a pipeline-nested kernel on old jax must be a clean, catchable
+    error instead.
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs: dict = dict(in_specs=in_specs, out_specs=out_specs)
+        if mesh is not None:
+            kwargs["mesh"] = mesh
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(f, **kwargs)
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if axis_names is not None or mesh is None:
+        raise NotImplementedError(
+            "partial-manual shard_map (axis_names) requires jax.shard_map "
+            f"(jax >= 0.5); this jax is {jax.__version__}")
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _shard_map(f, **kwargs)

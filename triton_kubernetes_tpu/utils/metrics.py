@@ -132,6 +132,12 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "gauge", "AOT compile-time split of the train step by phase "
         "(lower / compile); near-zero compile on a warm persistent "
         "cache", ("config", "phase"), None),
+    "tk8s_train_memory_bytes": (
+        "gauge", "Per-device byte accounting of the AOT-compiled train "
+        "step from XLA's memory_analysis(), by kind (argument/output/"
+        "temp/alias/peak); temp is what a remat policy moves, argument "
+        "what a precision policy's storage dtypes move",
+        ("config", "kind"), None),
     # --------------------------------- train/checkpoint.py (integrity)
     "tk8s_train_checkpoint_save_duration_seconds": (
         "histogram", "Wall clock from checkpoint-save dispatch to "
