@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Produce the multi-host scale-out evidence artifact: a 1-process vs
+2-process data-parallel A/B through the pipelined loop, plus a goodput
+run (kill -> emergency checkpoint -> verified restore -> continue),
+journaled to docs/ci-evidence/scaleout-<tag>.json.
+
+Phases:
+
+1. **ab** — the same workload (same model, same global batch, same
+   seed) trained by one process and by two `jax.distributed` processes
+   (DCN data-parallel hybrid mesh, fused single-all-reduce gradient
+   sync, per-process input sharding). Each worker is pinned to its own
+   CPU core and paced by the deterministic `--device-ms-per-row` floor
+   — the train-loop analogue of cloudsim's `op_latency` knob: it models
+   the accelerator each CPU process stands in for, so the A/B measures
+   whether the scale-out plumbing (gloo all-reduce, coordination,
+   per-process staging) converts added hosts into aggregate throughput,
+   instead of measuring how two co-located CPU workers share one
+   machine's FP ports (on SMT-shared vCPUs that ceiling is ~1.4x no
+   matter how good the harness is — see docs/guide/performance.md
+   §Multi-host scale-out). Real compute still runs and real losses are
+   compared per step. Gates: aggregate steady tokens/s >= 1.6x, and
+   per-step loss parity within LOSS_ATOL.
+2. **goodput** — a 2-process run is SIGTERMed slice-wide mid-training
+   (the GKE preemption warning), every worker emergency-checkpoints and
+   exits 75, a relaunch restores the newest *verified* step and
+   finishes. The gate: the cycle completes, recovery resumed from the
+   emergency step, useful-steps/s *including* the recovery window is
+   reported — goodput, the honest metric — and the post-resume
+   per-step losses bitwise-match an uninterrupted reference run of the
+   identical workload (deterministic stream replay across the kill).
+
+Environments that cannot host cross-process CPU collectives skip
+LOUDLY: the journal records the typed reason and the script exits 0,
+per the harness contract (never abort, never masquerade as a failure).
+
+Usage: JAX_PLATFORMS=cpu python scripts/ci/scaleout_evidence.py [tag]
+"""
+
+import json
+import os
+import shutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+AB_STEPS = 16
+GOODPUT_STEPS = 12
+SPEEDUP_GATE = 1.6
+LOSS_ATOL = 5e-5  # measured ~2e-6 f32; pinned with margin for BLAS drift
+MODEL = ["--model", "llama-test", "--batch-size", "32", "--seq-len", "64",
+         "--prefetch", "2", "--device-ms-per-row", "25"]
+WORKLOAD = MODEL + ["--sync-every", "4", "--log-every", "4"]
+
+
+def main(argv):
+    tag = argv[1] if len(argv) > 1 else "local"
+    repo = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir))
+    out_path = os.path.join(repo, "docs", "ci-evidence",
+                            f"scaleout-{tag}.json")
+    workdir = os.path.join(repo, "docs", "ci-evidence",
+                           f".scaleout-work-{tag}")
+    shutil.rmtree(workdir, ignore_errors=True)  # stale runs poison evidence
+
+    from triton_kubernetes_tpu.parallel.multihost import (
+        launch_trainers, run_goodput, support_report)
+
+    journal = {"tag": tag, "workload": WORKLOAD, "ab_steps": AB_STEPS,
+               "speedup_gate": SPEEDUP_GATE, "loss_atol": LOSS_ATOL,
+               "support": support_report()}
+
+    def emit(status):
+        journal["status"] = status
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(journal, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if not journal["support"]["ok"]:
+        # The typed, loud skip: the artifact says exactly why.
+        emit(f"skipped:{journal['support']['reason']}")
+        shutil.rmtree(workdir, ignore_errors=True)
+        print(f"wrote {out_path} (SKIPPED: {journal['support']['detail']})")
+        return 0
+
+    def gate(ok, label, msg):
+        """A failed gate still writes the journal — the measured
+        numbers that explain the failure ARE the evidence."""
+        if not ok:
+            emit(f"failed:{label}")
+            raise SystemExit(f"gate {label!r} failed "
+                             f"(journal: {out_path}): {msg}")
+
+    def arm(n, steps, phase):
+        run_dir = os.path.join(workdir, f"{phase}-n{n}")
+        rep = launch_trainers(
+            WORKLOAD + ["--steps", str(steps), "--compile-cache-dir",
+                        os.path.join(workdir, f"cache-n{n}")],
+            n_processes=n, run_dir=run_dir, tag=f"scaleout-{tag}-{phase}-{n}",
+            timeout=300)
+        if not rep.ok or rep.report is None:
+            tails = "\n".join(f"worker {w.process_id} rc={w.returncode}:\n"
+                              f"{w.tail}" for w in rep.workers)
+            raise SystemExit(f"{phase} arm n={n} failed "
+                             f"(rcs={rep.returncodes}):\n{tails}")
+        return rep.report
+
+    # 1. The A/B. A short warm run per arm first, so the measured run
+    # reads the persistent compile cache and the steady window reflects
+    # training, not jit.
+    arm(1, 2, "warm")
+    arm(2, 2, "warm")
+    r1 = arm(1, AB_STEPS, "ab")
+    r2 = arm(2, AB_STEPS, "ab")
+    journal["ab"] = {"one_process": r1, "two_process": r2}
+    gate(r1["n_processes"] == 1 and r2["n_processes"] == 2,
+         "process-span", (r1["n_processes"], r2["n_processes"]))
+    gate(r2["dcn_sync"] == "fused", "fused-sync", r2["dcn_sync"])
+    gate(len(r1["losses"]) == len(r2["losses"]) == AB_STEPS,
+         "step-count", (len(r1["losses"]), len(r2["losses"])))
+    # Derived AFTER the step-count gate: max()/zip() over empty or
+    # unequal loss lists would raise (or silently truncate) here and
+    # skip the journal the gate path guarantees.
+    speedup = r2["steady_tokens_per_sec"] / r1["steady_tokens_per_sec"]
+    loss_diff = max(abs(a - b) for a, b in zip(r1["losses"], r2["losses"]))
+    journal["ab"]["aggregate_speedup"] = round(speedup, 3)
+    journal["ab"]["max_per_step_loss_diff"] = loss_diff
+    gate(loss_diff <= LOSS_ATOL, "loss-parity",
+         f"per-step losses diverged: max diff {loss_diff} > {LOSS_ATOL}")
+    gate(speedup >= SPEEDUP_GATE, "speedup",
+         f"2-process aggregate steady tokens/s only {speedup:.2f}x the "
+         f"1-process run (gate {SPEEDUP_GATE}x): "
+         f"{r2['steady_tokens_per_sec']} vs {r1['steady_tokens_per_sec']}")
+
+    # 2. Goodput: one slice-wide kill -> emergency save -> verified
+    # restore -> continue, clocked end to end.
+    gp = run_goodput(
+        MODEL + ["--sync-every", "2", "--log-every", "2",
+                 "--checkpoint-dir", os.path.join(workdir, "ckpt"),
+                 "--emergency-dir", os.path.join(workdir, "emergency"),
+                 "--checkpoint-every", "4",
+                 "--compile-cache-dir", os.path.join(workdir, "cache-n2")],
+        n_processes=2, run_dir=os.path.join(workdir, "goodput"),
+        target_steps=GOODPUT_STEPS, tag=f"scaleout-{tag}-gp", timeout=300)
+    journal["goodput"] = gp.to_json()
+    gate(gp.useful_steps == GOODPUT_STEPS, "goodput-complete", gp)
+    gate(gp.emergency_step is not None, "goodput-emergency-save", gp)
+    gate(gp.resumed_step == gp.emergency_step, "goodput-resume-point",
+         f"recovery resumed from {gp.resumed_step}, but the emergency "
+         f"checkpoint was at {gp.emergency_step}")
+    gate(0 < gp.goodput_steps_per_sec < gp.raw_steps_per_sec,
+         "goodput-rate", gp)
+
+    # 3. Trajectory parity across the kill: the resumed run must land on
+    # the SAME per-step losses as an uninterrupted reference of the
+    # identical workload (deterministic stream replay), bitwise — a
+    # resume that replays the data stream at the wrong offset passes
+    # the step-count gates but diverges here.
+    ref = launch_trainers(
+        MODEL + ["--sync-every", "2", "--log-every", "2",
+                 "--checkpoint-dir", os.path.join(workdir, "ckpt-ref"),
+                 "--emergency-dir", os.path.join(workdir, "emergency-ref"),
+                 "--checkpoint-every", "4",
+                 "--compile-cache-dir", os.path.join(workdir, "cache-n2"),
+                 "--steps", str(GOODPUT_STEPS)],
+        n_processes=2, run_dir=os.path.join(workdir, "goodput-ref"),
+        tag=f"scaleout-{tag}-gpref", timeout=300)
+    gate(ref.ok and ref.report is not None, "goodput-ref",
+         [w.tail for w in ref.workers])
+    ref_losses = ref.report["losses"]
+    journal["goodput"]["reference_losses"] = ref_losses
+    resumed_losses = gp.phases[1]["losses"]
+    gate(ref_losses[gp.resumed_step:] == resumed_losses,
+         "goodput-trajectory",
+         f"resumed losses diverge from the uninterrupted reference at "
+         f"steps {gp.resumed_step}..{GOODPUT_STEPS}: "
+         f"{resumed_losses} vs {ref_losses[gp.resumed_step:]}")
+
+    emit("ok")
+    shutil.rmtree(workdir, ignore_errors=True)  # the journal IS the artifact
+    print(f"wrote {out_path} (A/B {speedup:.2f}x aggregate >= "
+          f"{SPEEDUP_GATE}x, loss diff {loss_diff:.2e}; goodput "
+          f"{gp.goodput_steps_per_sec:.3f} useful-steps/s over a "
+          f"kill@{gp.emergency_step} -> restore -> finish cycle)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

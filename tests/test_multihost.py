@@ -1,0 +1,440 @@
+"""Multi-host scale-out: hybrid mesh placement, distributed-env parsing,
+fused DCN gradient sync, per-process sharding, and the local launcher.
+
+The in-process tests run on the 8-virtual-device CPU mesh (conftest);
+the launcher test spawns REAL ``jax.distributed`` worker processes and
+skips loudly (typed reason) on environments without cross-process CPU
+collectives — the same contract the harness itself honors.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.parallel import multihost
+from triton_kubernetes_tpu.parallel.mesh import MeshConfig
+from triton_kubernetes_tpu.parallel.multihost import (
+    EXIT_UNSUPPORTED, MeshPlacementError, MultiHostUnavailable,
+    SyncedPreemptionGuard, create_hybrid_mesh, pick_coordinator_port,
+    process_batch_bounds, process_major_devices, support_report)
+from triton_kubernetes_tpu.train.__main__ import (
+    COORDINATOR_PORT, DistributedEnvError, parse_distributed_env)
+
+
+class FakeDevice:
+    """Just enough device surface for placement logic (no backend)."""
+
+    def __init__(self, device_id, process_index):
+        self.id = device_id
+        self.process_index = process_index
+
+    def __repr__(self):
+        return f"dev(p{self.process_index}/d{self.id})"
+
+
+def fake_devices(n_proc, per_proc):
+    return [FakeDevice(p * per_proc + i, p)
+            for p in range(n_proc) for i in range(per_proc)]
+
+
+# ------------------------------------------------- coordinator port pin
+
+def test_coordinator_port_pinned_to_jobset():
+    """train/__main__ duplicates the JobSet coordinator port jax-free
+    (the SERVE_PORT pattern); the two constants must never drift."""
+    from triton_kubernetes_tpu.topology.jobset import (
+        COORDINATOR_PORT as JOBSET_PORT)
+
+    assert COORDINATOR_PORT == JOBSET_PORT
+
+
+def test_exit_unsupported_is_distinct():
+    from triton_kubernetes_tpu.train.resilience import EXIT_RESUME
+
+    assert EXIT_UNSUPPORTED not in (0, 2, 4, EXIT_RESUME)
+
+
+# --------------------------------------------- distributed-env parsing
+
+def test_parse_env_absent_is_none():
+    assert parse_distributed_env({}) is None
+    assert parse_distributed_env({"JAX_COORDINATOR_ADDRESS": "  "}) is None
+
+
+def test_parse_env_jobset_vars():
+    env = {"JAX_COORDINATOR_ADDRESS": f"run-0.run.ns.svc:{COORDINATOR_PORT}",
+           "TPU_WORKER_ID": "3", "NUM_TPU_WORKERS": "4"}
+    d = parse_distributed_env(env)
+    assert d.coordinator == f"run-0.run.ns.svc:{COORDINATOR_PORT}"
+    assert d.process_id == 3
+    assert d.num_processes == 4
+
+
+def test_parse_env_completion_index_fallback():
+    env = {"JAX_COORDINATOR_ADDRESS": "h:1234", "JOB_COMPLETION_INDEX": "1",
+           "NUM_TPU_WORKERS": "2"}
+    assert parse_distributed_env(env).process_id == 1
+    # TPU_WORKER_ID wins over the downward-API index when both exist.
+    env["TPU_WORKER_ID"] = "0"
+    assert parse_distributed_env(env).process_id == 0
+
+
+def test_parse_env_auto_discover_world_size():
+    env = {"JAX_COORDINATOR_ADDRESS": "h:1234"}
+    d = parse_distributed_env(env)
+    assert d.process_id == 0 and d.num_processes is None
+    env["NUM_TPU_WORKERS"] = "0"  # explicit "let jax discover"
+    assert parse_distributed_env(env).num_processes is None
+
+
+@pytest.mark.parametrize("env", [
+    {"JAX_COORDINATOR_ADDRESS": "no-port"},
+    {"JAX_COORDINATOR_ADDRESS": "h:port"},
+    {"JAX_COORDINATOR_ADDRESS": "h:1", "TPU_WORKER_ID": "x"},
+    {"JAX_COORDINATOR_ADDRESS": "h:1", "TPU_WORKER_ID": "-1"},
+    {"JAX_COORDINATOR_ADDRESS": "h:1", "NUM_TPU_WORKERS": "nope"},
+    {"JAX_COORDINATOR_ADDRESS": "h:1", "NUM_TPU_WORKERS": "-2"},
+    {"JAX_COORDINATOR_ADDRESS": "h:1", "TPU_WORKER_ID": "2",
+     "NUM_TPU_WORKERS": "2"},
+])
+def test_parse_env_malformed_raises_clean(env):
+    with pytest.raises(DistributedEnvError):
+        parse_distributed_env(env)
+
+
+def test_trainer_malformed_env_is_rc2_not_a_hang(monkeypatch):
+    """A bad JobSet env must come back as one clean config-error exit
+    BEFORE jax.distributed.initialize can hang on it."""
+    from triton_kubernetes_tpu.train.__main__ import main
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "coordinator-sans-port")
+    assert main(["--distributed", "auto", "--steps", "1"]) == 2
+
+
+def test_trainer_unsupported_env_skips_loudly(monkeypatch):
+    """An environment without cross-process collectives exits
+    EXIT_UNSUPPORTED (typed, loud skip) — never an abort."""
+    from triton_kubernetes_tpu.train.__main__ import main
+
+    def unavailable():
+        raise MultiHostUnavailable(
+            "no gloo here", multihost.REASON_NO_CPU_COLLECTIVES)
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:9")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(multihost, "enable_cpu_collectives", unavailable)
+    assert main(["--distributed", "on", "--steps", "1"]) == EXIT_UNSUPPORTED
+
+
+# ------------------------------------------------------- mesh placement
+
+def test_process_major_device_order():
+    devs = fake_devices(2, 4)
+    shuffled = [devs[i] for i in (5, 0, 7, 2, 1, 6, 3, 4)]
+    assert process_major_devices(shuffled) == devs
+
+
+def test_uneven_per_process_devices_rejected():
+    devs = fake_devices(2, 2) + [FakeDevice(99, 1)]
+    with pytest.raises(MeshPlacementError, match="uneven"):
+        process_major_devices(devs)
+
+
+def test_dcn_axis_must_land_on_process_boundaries():
+    with pytest.raises(MeshPlacementError, match="process boundaries"):
+        create_hybrid_mesh(MeshConfig(data=3, fsdp=-1),
+                           devices=fake_devices(2, 3))
+
+
+def test_stage_axis_counts_toward_the_dcn_boundary():
+    # data x stage together form the DCN block: stage=3 over 2 processes
+    # cannot land on process boundaries any more than data=3 can.
+    with pytest.raises(MeshPlacementError, match="process boundaries"):
+        create_hybrid_mesh(MeshConfig(data=1, stage=3, fsdp=-1),
+                           devices=fake_devices(2, 3))
+
+
+def test_single_process_hybrid_degrades_to_create_mesh(cpu_mesh_devices):
+    from triton_kubernetes_tpu.parallel import create_mesh
+
+    cfg = MeshConfig(data=2, fsdp=-1)
+    hybrid = create_hybrid_mesh(cfg)
+    plain = create_mesh(cfg)
+    assert hybrid.axis_names == plain.axis_names
+    assert (np.asarray(hybrid.devices) == np.asarray(plain.devices)).all()
+
+
+def test_process_batch_bounds():
+    assert process_batch_bounds(8, 0, 2) == (0, 4)
+    assert process_batch_bounds(8, 1, 2) == (4, 8)
+    assert process_batch_bounds(6, 0, 1) == (0, 6)
+    with pytest.raises(MeshPlacementError, match="divide"):
+        process_batch_bounds(7, 0, 2)
+    with pytest.raises(MeshPlacementError, match="out of range"):
+        process_batch_bounds(8, 2, 2)
+
+
+def test_pick_coordinator_port_is_deterministic_and_offset():
+    p1 = pick_coordinator_port("tag-a")
+    assert p1 == pick_coordinator_port("tag-a")  # free port: stable
+    assert p1 != COORDINATOR_PORT
+    assert pick_coordinator_port("tag-b") != p1
+
+
+# ------------------------------------------------------ support report
+
+def test_support_report_shape():
+    rep = support_report()
+    assert set(rep) == {"ok", "reason", "detail"}
+    if not rep["ok"]:
+        assert rep["reason"] in (multihost.REASON_NO_DISTRIBUTED,
+                                 multihost.REASON_NO_CPU_COLLECTIVES)
+
+
+# ------------------------------------------------- fused DCN gradient sync
+
+def test_fused_dcn_needs_pure_data_parallel(cpu_mesh_devices):
+    from triton_kubernetes_tpu.models import get_config
+    from triton_kubernetes_tpu.parallel import create_mesh
+    from triton_kubernetes_tpu.train import make_optimizer
+
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2),
+                       devices=cpu_mesh_devices[:4])
+    assert not multihost.supports_fused_dcn(mesh)
+    with pytest.raises(MeshPlacementError, match="pure data-parallel"):
+        multihost.make_fused_dcn_step(
+            get_config("llama-test"), mesh,
+            make_optimizer(learning_rate=1e-2, warmup_steps=1,
+                           decay_steps=10))
+
+
+def test_fused_dcn_step_matches_xla_step(cpu_mesh_devices):
+    """The one-all-reduce DDP step must track the GSPMD-partitioned step
+    on the same pure data-parallel mesh — same batch split, same
+    trajectory (mean-of-per-shard-means == global mean; float
+    reassociation only)."""
+    import jax.numpy as jnp
+
+    from triton_kubernetes_tpu.models import get_config
+    from triton_kubernetes_tpu.parallel import create_mesh
+    from triton_kubernetes_tpu.train import (
+        init_state, make_optimizer, make_train_step)
+    from triton_kubernetes_tpu.train.data import synthetic_batches
+
+    cfg = get_config("llama-test")
+    mesh = create_mesh(MeshConfig(data=2, fsdp=1),
+                       devices=cpu_mesh_devices[:2])
+    assert multihost.supports_fused_dcn(mesh)
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=1, decay_steps=10)
+    batches = [
+        {"tokens": jnp.asarray(b["tokens"])} for b, _ in
+        zip(synthetic_batches(cfg.vocab_size, 8, 32), range(3))]
+
+    fused = multihost.make_fused_dcn_step(cfg, mesh, opt)
+    state_f = init_state(cfg, mesh, opt)
+    xla = make_train_step(cfg, mesh, opt)
+    state_x = init_state(cfg, mesh, opt)
+    for b in batches:
+        state_f, m_f = fused(state_f, dict(b))
+        state_x, m_x = xla(state_x, dict(b))
+        np.testing.assert_allclose(
+            float(m_f["loss"]), float(m_x["loss"]), rtol=0, atol=1e-5)
+    assert int(state_f.step) == int(state_x.step) == 3
+    # Params stay in lockstep too, not just the scalar loss.
+    import jax
+
+    leaves_f = jax.tree.leaves(state_f.params)
+    leaves_x = jax.tree.leaves(state_x.params)
+    for a, b in zip(leaves_f, leaves_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+# --------------------------------------------- per-process data sharding
+
+def test_batch_placer_single_process_matches_device_put(cpu_mesh_devices):
+    import jax
+    from jax.sharding import NamedSharding
+
+    from triton_kubernetes_tpu.parallel import create_mesh
+    from triton_kubernetes_tpu.train.trainer import batch_spec
+
+    mesh = create_mesh(MeshConfig(data=2, fsdp=1),
+                       devices=cpu_mesh_devices[:2])
+    place = multihost.make_batch_placer(mesh, batch_spec())
+    host = {"tokens": np.arange(8 * 4, dtype=np.int32).reshape(8, 4)}
+    placed = place(host)
+    direct = jax.device_put(
+        host["tokens"], NamedSharding(mesh, batch_spec()))
+    assert placed["tokens"].sharding.is_equivalent_to(direct.sharding, 2)
+    np.testing.assert_array_equal(
+        np.asarray(placed["tokens"]), host["tokens"])
+
+
+def test_local_batch_rows_follows_the_sharding(cpu_mesh_devices):
+    from triton_kubernetes_tpu.parallel import create_mesh
+    from triton_kubernetes_tpu.train.trainer import batch_spec
+
+    # Single-process every device is local, so whatever axes shard the
+    # batch, this process owns ALL rows — the floor must not shrink.
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2),
+                       devices=cpu_mesh_devices[:4])
+    assert multihost.local_batch_rows(mesh, batch_spec(), 8) == 8
+    mesh = create_mesh(MeshConfig(stage=2, tensor=2),
+                       devices=cpu_mesh_devices[:4])
+    assert multihost.local_batch_rows(mesh, batch_spec(), 8) == 8
+
+
+def test_prefetch_place_hook_and_exclusivity():
+    from triton_kubernetes_tpu.train.data import DevicePrefetch
+
+    calls = []
+
+    def place(b):
+        calls.append(b)
+        return b
+
+    pf = DevicePrefetch(iter([{"x": np.ones(2)}]), place=place,
+                        threaded=False)
+    assert next(iter(pf)) == {"x": pytest.approx(np.ones(2))}
+    assert len(calls) == 1
+    with pytest.raises(ValueError, match="not both"):
+        DevicePrefetch(iter([]), sharding=object(), place=place)
+
+
+def test_local_full_value_roundtrip(cpu_mesh_devices):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(cpu_mesh_devices), ("all",))
+    arr = jax.device_put(np.arange(16.0).reshape(8, 2),
+                         NamedSharding(mesh, P("all", None)))
+    np.testing.assert_array_equal(
+        multihost.local_full_value(arr), np.arange(16.0).reshape(8, 2))
+
+
+# --------------------------------------------------- preemption agreement
+
+def test_synced_guard_single_process_delegates():
+    g = SyncedPreemptionGuard(signals=(), check_every=3)
+    assert not g.requested
+    g.trip()
+    assert g.requested  # single-process: no collective, direct read
+    with pytest.raises(ValueError, match="check_every"):
+        SyncedPreemptionGuard(signals=(), check_every=0)
+
+
+# ------------------------------------------------------- local launcher
+
+def test_worker_env_matches_jobset_contract():
+    env = multihost.worker_env(1, 4, 9999, devices_per_process=2)
+    assert env["JAX_COORDINATOR_ADDRESS"] == "127.0.0.1:9999"
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["NUM_TPU_WORKERS"] == "4"
+    assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+    # The parsed form round-trips into the trainer's identity.
+    d = parse_distributed_env(env)
+    assert (d.process_id, d.num_processes) == (1, 4)
+
+
+def test_launch_trainers_two_process_data_parallel(tmp_path):
+    """The real trainer as two local jax.distributed workers: hybrid
+    data=2 mesh, fused DCN sync, rank-tagged logs, one coordinated
+    report. Skips loudly (typed reason) where unsupported."""
+    try:
+        multihost.require_multihost()
+    except MultiHostUnavailable as e:
+        pytest.skip(f"multi-host unavailable: {e.reason}")
+
+    rep = multihost.launch_trainers(
+        ["--model", "llama-test", "--batch-size", "8", "--seq-len", "32",
+         "--steps", "4", "--sync-every", "2", "--log-every", "2"],
+        n_processes=2, run_dir=str(tmp_path), tag="t-multihost",
+        timeout=240)
+    assert rep.ok, [w.tail for w in rep.workers]
+    assert rep.report is not None
+    assert rep.report["n_processes"] == 2
+    assert rep.report["dcn_sync"] == "fused"
+    assert rep.report["steps"] == 4
+    assert len(rep.report["losses"]) == 4
+    assert all(np.isfinite(rep.report["losses"]))
+    assert rep.report["tokens_per_sec"] > 0
+    assert rep.report["mesh"].startswith("mesh(data=2")
+    # Rank-tagged worker logs are the per-process record.
+    for w in rep.workers:
+        assert os.path.exists(w.log_path)
+        body = open(w.log_path).read()
+        assert f"process={w.process_id}" in body or w.process_id == 0
+
+
+def test_launch_trainers_fail_fast_on_early_worker_death(tmp_path):
+    """A worker that dies at startup (injected via TK8S_TEST_CRASH_RANK)
+    must reap the whole fleet in seconds — the survivor is blocked in
+    jax.distributed.initialize waiting for the dead peer, and burning
+    the full timeout there would hide the real cause behind rc -9."""
+    try:
+        multihost.require_multihost()
+    except MultiHostUnavailable as e:
+        pytest.skip(f"multi-host unavailable: {e.reason}")
+
+    timeout = 240.0
+    rep = multihost.launch_trainers(
+        ["--model", "llama-test", "--batch-size", "8", "--seq-len", "32",
+         "--steps", "4", "--sync-every", "2"],
+        n_processes=2, run_dir=str(tmp_path), tag="t-failfast",
+        timeout=timeout, env_extra={"TK8S_TEST_CRASH_RANK": "1"})
+    assert not rep.ok
+    # Rank 1 carries the injected failure rc; rank 0 was reaped
+    # (SIGKILL) instead of waiting out the timeout.
+    assert rep.returncodes[1] == 3, [w.tail for w in rep.workers]
+    assert rep.returncodes[0] != 0
+    assert rep.wall_seconds < timeout / 2
+    assert "injected startup crash" in open(rep.workers[1].log_path).read()
+
+
+# ------------------------------------------------- measure report schema
+
+def test_measure_throughput_report_fields():
+    from triton_kubernetes_tpu.train.measure import (
+        ThroughputReport, measure_throughput)
+
+    def step(state, batch):
+        return state + 1, {"loss": np.float32(state)}
+
+    rep, state = measure_throughput(
+        step, 0, [{"tokens": np.zeros((2, 5), np.int32)}],
+        tokens_per_step=8, warmup=1, n_short=1, n_long=3)
+    assert isinstance(rep, ThroughputReport)
+    assert rep.steps_timed == 2
+    assert rep.n_processes == 1
+    assert rep.steps_per_sec > 0 and rep.tokens_per_sec > 0
+    assert rep.tokens_per_sec == pytest.approx(8 * rep.steps_per_sec)
+    assert state == 5  # warmup + long window all stepped
+
+
+# ----------------------------------------------------- rank-tag metrics
+
+def test_metrics_default_labels_rank_tag():
+    from triton_kubernetes_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.set_default_labels(process_id="3")
+    c = reg.counter("tk8s_train_tokens_total")
+    c.inc(5, config="m")  # process_id filled from the registry default
+    series = reg.snapshot()["tk8s_train_tokens_total"]["series"]
+    assert series == [{"labels": {"config": "m", "process_id": "3"},
+                       "value": 5}]
+    # Explicit labels still win over the default.
+    c.inc(1, config="m", process_id="9")
+    assert len(reg.snapshot()["tk8s_train_tokens_total"]["series"]) == 2
+
+
+def test_logger_bind_rank_tag(capsys):
+    from triton_kubernetes_tpu.utils.logging import Logger
+
+    log = Logger(json_mode=True)
+    log.bind(process=7)
+    log.log("info", "hello", step=1)
+    rec = json.loads(capsys.readouterr().err.strip())
+    assert rec["process"] == 7 and rec["step"] == 1

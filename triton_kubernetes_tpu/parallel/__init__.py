@@ -7,6 +7,11 @@ this package provides is the standard JAX SPMD stack: a named
 ``jax.sharding.Mesh`` over the slice's ICI torus, logical-axis→mesh-axis
 rules, and ``NamedSharding`` helpers that the bundled models/trainer use to
 lay out params and activations so collectives ride ICI.
+
+Multi-host scale-out lives in :mod:`.multihost` (imported lazily by
+callers — it is only needed once ``jax.distributed`` is in play): hybrid
+DCN×ICI meshes, per-process input sharding, coordinated checkpointing,
+and the local multi-process launcher/goodput harness.
 """
 
 from .mesh import (

@@ -7,7 +7,13 @@ program, ``jax.distributed`` initializes from the env the JobSet injects
 the whole slice executes one SPMD program over the requested mesh.
 
 Single-process runs (laptop smoke, one-host slice) skip distributed init
-automatically. Data comes from the native sharded token pipeline when
+automatically. Under ``jax.distributed`` the trainer is process-aware end
+to end: the mesh is the hybrid DCN×ICI placement (data-parallel across
+processes, ICI axes within — parallel/multihost.py), each host stages
+only its own batch rows, checkpoint save/restore is coordinated
+single-writer-per-shard, the preemption stop is a cross-process
+agreement, and logs/metrics are rank-tagged. Data comes from the native
+sharded token pipeline when
 ``--data-dir`` is given (falls back to the pure-Python reader), else from
 the synthetic Markov generator, so the entrypoint always has something to
 train on — the BASELINE "cluster-up then train" gates assume that.
@@ -25,8 +31,88 @@ from __future__ import annotations
 
 import argparse
 import itertools
+import json
 import os
 import sys
+import time
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+# The jax.distributed coordinator port every worker dials (worker 0
+# listens). Duplicated jax-free from topology/jobset.py COORDINATOR_PORT
+# (the same duplication-pinned pattern as SERVE_PORT: rendering must not
+# import the jax-loaded train package, the trainer must not import the
+# rendering layer at runtime); pinned equal in tests/test_multihost.py.
+COORDINATOR_PORT = 8476
+
+
+class DistributedEnvError(ValueError):
+    """The JobSet-injected distributed variables are malformed (a
+    non-integer worker id, an out-of-range rank, a coordinator address
+    with no port). Raised BEFORE ``jax.distributed.initialize`` so the
+    operator gets one clean line instead of a distributed-runtime hang
+    or traceback."""
+
+
+@dataclass(frozen=True)
+class DistributedEnv:
+    """Parsed multi-process identity (topology/jobset.py injects these;
+    the local launcher in parallel/multihost.py injects the same)."""
+
+    coordinator: str               # host:port of worker 0
+    process_id: int                # this worker's rank
+    num_processes: Optional[int]   # None = let jax discover
+
+
+def parse_distributed_env(
+        environ: Optional[Mapping[str, str]] = None,
+) -> Optional[DistributedEnv]:
+    """Distributed identity from the environment, or None when no
+    coordinator is advertised (single-process run, or auto-detect).
+
+    ``JAX_COORDINATOR_ADDRESS`` selects JobSet mode; the worker id comes
+    from ``TPU_WORKER_ID`` falling back to ``JOB_COMPLETION_INDEX``
+    (the indexed-Job downward-API path) falling back to 0; world size
+    from ``NUM_TPU_WORKERS`` (0/unset = let jax discover). Malformed
+    values raise :class:`DistributedEnvError` — never a downstream hang.
+    """
+    env = os.environ if environ is None else environ
+    coord = (env.get("JAX_COORDINATOR_ADDRESS") or "").strip()
+    if not coord:
+        return None
+    _, sep, port = coord.rpartition(":")
+    if not sep or not port.isdigit():
+        raise DistributedEnvError(
+            f"JAX_COORDINATOR_ADDRESS={coord!r} must be host:port "
+            f"(the JobSet injects e.g. name-0.name.ns.svc:"
+            f"{COORDINATOR_PORT})")
+    wid_raw = (env.get("TPU_WORKER_ID") or "").strip() or (
+        env.get("JOB_COMPLETION_INDEX") or "").strip() or "0"
+    try:
+        wid = int(wid_raw)
+    except ValueError:
+        raise DistributedEnvError(
+            f"TPU_WORKER_ID/JOB_COMPLETION_INDEX={wid_raw!r} is not an "
+            f"integer") from None
+    if wid < 0:
+        raise DistributedEnvError(f"TPU_WORKER_ID={wid} must be >= 0")
+    num_raw = (env.get("NUM_TPU_WORKERS") or "").strip()
+    num: Optional[int] = None
+    if num_raw and num_raw != "0":
+        try:
+            num = int(num_raw)
+        except ValueError:
+            raise DistributedEnvError(
+                f"NUM_TPU_WORKERS={num_raw!r} is not an integer") from None
+        if num < 1:
+            raise DistributedEnvError(
+                f"NUM_TPU_WORKERS={num} must be >= 1")
+        if wid >= num:
+            raise DistributedEnvError(
+                f"TPU_WORKER_ID={wid} out of range for "
+                f"NUM_TPU_WORKERS={num}")
+    return DistributedEnv(coordinator=coord, process_id=wid,
+                          num_processes=num)
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -44,7 +130,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--learning-rate", type=float, default=3e-4)
     p.add_argument("--warmup-steps", type=int, default=100)
     # Mesh axes: -1 absorbs remaining devices (at most one axis).
-    p.add_argument("--data", type=int, default=1)
+    p.add_argument("--data", type=int, default=0,
+                   help="data-parallel (DCN) axis; 0 = auto: 1 single-"
+                        "process, the process count under "
+                        "jax.distributed (one DCN shard per host)")
     p.add_argument("--stage", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=-1)
     p.add_argument("--seq", type=int, default=1)
@@ -114,8 +203,33 @@ def _build_parser() -> argparse.ArgumentParser:
                         "recompilation")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--json-logs", action="store_true")
+    p.add_argument("--device-ms-per-row", type=float, default=0.0,
+                   help="deterministic per-step device-time floor: this "
+                        "many milliseconds per LOCAL batch row, slept "
+                        "off (remainder only — real compute overlaps "
+                        "it) before each dispatch. The train-loop "
+                        "analogue of cloudsim's op_latency knob: models "
+                        "the accelerator each CPU process stands in "
+                        "for, so scale-out concurrency is measurable "
+                        "without a cloud. 0 = off")
+    p.add_argument("--report-json", default="",
+                   help="write a machine-readable run report (per-step "
+                        "losses, steps/s, aggregate tokens/s, process "
+                        "count, preemption outcome) to this path; "
+                        "process 0 writes, other ranks skip — the "
+                        "scale-out harness and CI evidence read it")
     p.add_argument("--distributed", choices=["auto", "on", "off"],
                    default="auto")
+    p.add_argument("--dcn-sync", choices=["auto", "fused", "xla"],
+                   default="auto",
+                   help="cross-process gradient exchange: 'fused' builds "
+                        "the step as one bucketed all-reduce per step "
+                        "(parallel/multihost.make_fused_dcn_step — the "
+                        "DCN-friendly DDP layout; needs a pure "
+                        "data-parallel mesh), 'xla' lets GSPMD insert "
+                        "per-parameter psums (the ICI-friendly layout), "
+                        "'auto' picks fused whenever the mesh supports "
+                        "it under multi-process runs")
     p.add_argument("--dry-run", action="store_true",
                    help="build everything, run one step, exit")
     return p
@@ -123,25 +237,81 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _maybe_init_distributed(mode: str, log) -> None:
     """JobSet workers carry JAX_COORDINATOR_ADDRESS + TPU_WORKER_ID
-    (topology/jobset.py:53-70); initialize jax.distributed from them."""
+    (topology/jobset.py:53-70); initialize jax.distributed from them.
+
+    On CPU platforms the gloo collectives implementation is selected
+    FIRST — on jax 0.4.x that is a config update (the env var is not
+    read), and without it every cross-process CPU program dies at
+    compile time. Raises :class:`DistributedEnvError` on malformed env
+    and :class:`..parallel.multihost.MultiHostUnavailable` (typed
+    reason) when the environment cannot host cross-process collectives;
+    ``main`` turns the latter into EXIT_UNSUPPORTED — a loud skip, never
+    an abort."""
     import jax
 
-    coord = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
-    if mode == "off" or (mode == "auto" and not coord):
+    if mode == "off":
         return
-    if not coord:
+    denv = parse_distributed_env()
+    if mode == "auto" and denv is None:
+        return
+    # The gloo selection must consider the CONFIG as well as the env
+    # var (conftest/sitecustomize set the config; a bare CPU box may
+    # set neither). Explicit cpu -> gloo is mandatory (typed skip when
+    # this jax cannot); unset/auto -> best-effort, so a TPU pod whose
+    # jaxlib lacks gloo still initializes instead of skipping.
+    platforms = (os.environ.get("JAX_PLATFORMS") or "").strip() or (
+        getattr(jax.config, "jax_platforms", None) or "")
+    if "cpu" in platforms or not platforms:
+        from ..parallel.multihost import (
+            MultiHostUnavailable, enable_cpu_collectives)
+
+        try:
+            enable_cpu_collectives()
+        except MultiHostUnavailable as e:
+            if "cpu" in platforms:
+                raise
+            # Auto-detect platform: a TPU pod does not need gloo, but
+            # if the backend resolves to CPU this run will crash in
+            # XLA instead of skipping — say so NOW, with the fix.
+            log.log("warn", "no CPU collectives in this jax; if the "
+                    "backend resolves to CPU this run will fail — set "
+                    "JAX_PLATFORMS=cpu for the typed skip",
+                    reason=e.reason)
+    if denv is None:
         # --distributed on without the JobSet env: let jax auto-detect
         # (it knows the GKE TPU pod metadata).
         log.log("info", "jax.distributed init (auto-detect)")
         jax.distributed.initialize()
         return
-    worker = int(os.environ.get(
-        "TPU_WORKER_ID", os.environ.get("JOB_COMPLETION_INDEX", "0")))
-    num = int(os.environ.get("NUM_TPU_WORKERS", "0")) or None
     log.log("info", "jax.distributed init",
-            coordinator=coord, process_id=worker, num_processes=num)
+            coordinator=denv.coordinator, process_id=denv.process_id,
+            num_processes=denv.num_processes)
     jax.distributed.initialize(
-        coordinator_address=coord, num_processes=num, process_id=worker)
+        coordinator_address=denv.coordinator,
+        num_processes=denv.num_processes, process_id=denv.process_id)
+
+
+def _distributed_shutdown(n_processes: int) -> None:
+    """Synchronized teardown on every clean exit path: rank 0 hosts the
+    coordination service, so the barrier keeps it alive until every rank
+    is done, and the explicit shutdown stops each client's error-poll
+    thread — otherwise the first-exiting rank's teardown makes its peers
+    abort with a fatal 'leader task died' from inside the coordination
+    client, turning a clean rc into a crash."""
+    if n_processes <= 1:
+        return
+    import jax
+
+    from ..parallel.multihost import barrier
+
+    try:
+        barrier("tk8s-exit")
+    except Exception:
+        pass  # a peer crashed: exiting loudly is all that is left
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
 
 
 def _batches(args, config, batch_size: int, seq_len: int):
@@ -162,7 +332,31 @@ def main(argv=None) -> int:
     from ..utils.logging import Logger
 
     log = Logger(json_mode=args.json_logs)
-    _maybe_init_distributed(args.distributed, log)
+    crash_rank = os.environ.get("TK8S_TEST_CRASH_RANK")
+    if crash_rank is not None and crash_rank == os.environ.get("TPU_WORKER_ID"):
+        # Deterministic startup-death injection (tests only): models a
+        # worker lost to an import error or port race BEFORE it joins the
+        # coordination service, so what its peers experience is the
+        # launcher's fail-fast reap, not a burned timeout.
+        log.log("error", "TK8S_TEST_CRASH_RANK: injected startup crash",
+                rank=crash_rank)
+        return 3
+    try:
+        _maybe_init_distributed(args.distributed, log)
+    except DistributedEnvError as e:
+        log.log("error", "malformed distributed environment", error=str(e))
+        return 2
+    except Exception as e:
+        from ..parallel.multihost import EXIT_UNSUPPORTED, MultiHostUnavailable
+
+        if not isinstance(e, MultiHostUnavailable):
+            raise
+        # Loud, typed skip — the harness contract: an environment that
+        # cannot host cross-process collectives must say so and step
+        # aside, never abort or masquerade as a training failure.
+        log.log("error", "multi-process harness unavailable; skipping",
+                reason=e.reason, error=str(e))
+        return EXIT_UNSUPPORTED
 
     import jax
 
@@ -201,21 +395,49 @@ def main(argv=None) -> int:
             compute_dtype=config.dtype, param_dtype=config.param_dtype,
             remat=remat_policy_of(config))
     seq_len = args.seq_len or config.max_seq_len
+    n_processes = jax.process_count()
     mesh_cfg = MeshConfig(
-        data=args.data, stage=args.stage, fsdp=args.fsdp, seq=args.seq,
+        # 0 = auto: one DCN shard per process multi-process (filled in
+        # by default_mesh_config), a plain data=1 mesh single-process.
+        data=args.data or (0 if n_processes > 1 else 1),
+        stage=args.stage, fsdp=args.fsdp, seq=args.seq,
         expert=args.expert, tensor=args.tensor)
-    mesh = create_mesh(mesh_cfg)
+    if n_processes > 1:
+        # Hybrid DCN×ICI placement: the data axis spans processes (one
+        # DCN shard per host by default), ICI axes stay within each
+        # host's devices. Rank-tag every log line and tk8s_train_*
+        # metric series so N workers' telemetry stays attributable.
+        from ..parallel import multihost
+        from ..utils import metrics as _metrics_mod
+
+        log.bind(process=jax.process_index())
+        _metrics_mod.set_default_labels(
+            process_id=str(jax.process_index()))
+        mesh_cfg = multihost.default_mesh_config(mesh_cfg)
+        try:
+            mesh = multihost.create_hybrid_mesh(mesh_cfg)
+        except multihost.MeshPlacementError as e:
+            # The same contract as every sibling config error: one
+            # clean line, rc 2, synchronized teardown — never a raw
+            # traceback that skips the exit barrier.
+            log.log("error", "hybrid mesh placement rejected",
+                    error=str(e))
+            _distributed_shutdown(n_processes)
+            return 2
+    else:
+        mesh = create_mesh(mesh_cfg)
     n_devices = mesh.size
     batch_shards = max(mesh.shape["data"] * mesh.shape["fsdp"], 1)
     batch_size = args.batch_size or 4 * batch_shards
     log.log("info", "trainer starting", model=config.name,
             mesh=describe_mesh(mesh), devices=n_devices,
-            process=jax.process_index(), batch=batch_size,
+            processes=n_processes, batch=batch_size,
             seq_len=seq_len, steps=args.steps)
 
     if batch_size % batch_shards:
         log.log("error", "global batch must divide the data*fsdp axes",
                 batch=batch_size, shards=batch_shards)
+        _distributed_shutdown(n_processes)
         return 2
     stages = mesh.shape["stage"]
     if stages > 1:
@@ -228,6 +450,7 @@ def main(argv=None) -> int:
                     "batch/microbatches must divide the data*fsdp axes "
                     "under pipeline stages",
                     batch=batch_size, microbatches=m, shards=batch_shards)
+            _distributed_shutdown(n_processes)
             return 2
 
     attention_fn = None
@@ -242,9 +465,48 @@ def main(argv=None) -> int:
         learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
         decay_steps=max(args.steps, args.warmup_steps + 1))
     state = init_state(config, mesh, opt)
-    step_fn = make_train_step(
-        config, mesh, opt, attention_fn=attention_fn,
-        microbatches=args.microbatches)
+    # Gradient-exchange layout: under multi-process runs a pure
+    # data-parallel mesh takes the fused DCN sync — local grads, ONE
+    # bucketed all-reduce per step — instead of GSPMD's per-parameter
+    # psums, whose per-collective DCN latency serializes the step
+    # (parallel/multihost.make_fused_dcn_step). Sharded-param meshes and
+    # single-process runs keep the XLA-partitioned step.
+    dcn_sync = "xla"
+    if args.dcn_sync != "xla" and n_processes > 1:
+        from ..parallel.multihost import (
+            make_fused_dcn_step, supports_fused_dcn)
+
+        # Everything the fused step cannot honor blocks it — silently
+        # dropping a requested feature (ring attention, gradient
+        # accumulation) would change the run's memory/compute profile
+        # with nothing in the logs; an explicit --dcn-sync fused that
+        # meets a blocker is a loud rc-2, same as every config error.
+        blockers = []
+        if attention_fn is not None:
+            blockers.append("--ring-attention (the fused step computes "
+                            "dense attention)")
+        if args.microbatches > 1:
+            blockers.append("--microbatches > 1 (the fused step takes "
+                            "one backward per step)")
+        if not supports_fused_dcn(mesh):
+            blockers.append("a non-pure-data-parallel mesh (every "
+                            "non-data axis must be 1)")
+        if not blockers:
+            dcn_sync = "fused"
+        elif args.dcn_sync == "fused":
+            log.log("error",
+                    "fused DCN sync unavailable: " + "; ".join(blockers),
+                    mesh=describe_mesh(mesh))
+            _distributed_shutdown(n_processes)
+            return 2
+    if dcn_sync == "fused":
+        step_fn = make_fused_dcn_step(config, mesh, opt)
+    else:
+        step_fn = make_train_step(
+            config, mesh, opt, attention_fn=attention_fn,
+            microbatches=args.microbatches)
+    if n_processes > 1:
+        log.log("info", "dcn gradient sync", mode=dcn_sync)
 
     from .checkpoint import CheckpointManager
     from .resilience import (
@@ -254,13 +516,26 @@ def main(argv=None) -> int:
     ckpt = None
     em_ckpt = None
     if args.checkpoint_dir:
-        ckpt = CheckpointManager(args.checkpoint_dir)
+        ckpt = CheckpointManager(args.checkpoint_dir,
+                                 single_controller=n_processes > 1)
     if args.emergency_dir and (
             ckpt is None
             or os.path.abspath(args.emergency_dir) != ckpt.directory):
         # Path-normalized: two orbax managers on one directory would race
         # each other's GC/finalize and double-list every resume candidate.
-        em_ckpt = CheckpointManager(args.emergency_dir)
+        em_ckpt = CheckpointManager(args.emergency_dir,
+                                    single_controller=n_processes > 1)
+    if n_processes > 1:
+        # Single-writer-per-shard coordination: process 0 writes (the DCN
+        # axis carries only replicated state, so rank 0 holds every
+        # byte), every rank barriers on the commit, restores re-place
+        # leaves from process-local data (parallel/multihost.py).
+        from ..parallel.multihost import CoordinatedCheckpoint
+
+        if ckpt is not None:
+            ckpt = CoordinatedCheckpoint(ckpt)
+        if em_ckpt is not None:
+            em_ckpt = CoordinatedCheckpoint(em_ckpt)
     start_is_checkpointed = False
     if args.resume and (ckpt is not None or em_ckpt is not None):
         # The newest *verified* step wins, scheduled or emergency — a torn
@@ -292,6 +567,27 @@ def main(argv=None) -> int:
 
     start_step = int(state.step)
     tokens_per_step = batch_size * seq_len
+    # --device-ms-per-row: the floor scales with the rows THIS process
+    # owns, so halving the per-host shard halves the modeled device
+    # time — exactly how a real accelerator behaves under data-parallel
+    # scale-out. Ownership comes from the batch sharding (NOT
+    # batch/n_processes: on a stage-spanning DCN mesh every host
+    # computes the full batch and the floor must not shrink).
+    if n_processes > 1:
+        from ..parallel import multihost
+        from .trainer import batch_spec
+
+        local_rows = multihost.local_batch_rows(
+            mesh, batch_spec(), batch_size)
+    else:
+        local_rows = batch_size
+    step_floor = args.device_ms_per_row * local_rows / 1e3
+    # The tokens COUNTER ticks by this rank's shard, so summing the
+    # rank-tagged series over process_id is the true fleet rate (every
+    # rank counting the global batch would multiply it by N). The
+    # report/log rates below stay global-batch-derived — they are the
+    # run's aggregate, not this rank's share.
+    local_tokens_per_step = local_rows * seq_len
     last_loss = None  # None until the first sync: never log a fake NaN
     tracing = False
     max_steps = max(args.steps - start_step, 0)
@@ -311,6 +607,17 @@ def main(argv=None) -> int:
     from .trainer import batch_spec
     from jax.sharding import NamedSharding
 
+    # Per-process input sharding: every rank runs the same deterministic
+    # host stream (same seed / same shard files), but only this rank's
+    # row block is ever staged to devices — the global jax.Array is
+    # assembled from process-local data, so no host transfers rows it
+    # does not own. Single-process keeps the plain sharded device_put.
+    place = None
+    if n_processes > 1:
+        from ..parallel import multihost
+
+        place = multihost.make_batch_placer(mesh, batch_spec())
+
     def make_batches(start: int):
         gen = _batches(args, config, batch_size, seq_len)
         if start:
@@ -318,14 +625,15 @@ def main(argv=None) -> int:
             for _ in range(start):
                 next(gen)
         host = ({"tokens": b["tokens"]} for b in gen)
-        # device_put with a mesh sharding needs the whole array
-        # addressable; multi-host slices keep the historical feed (jit
-        # stages per step).
-        if args.prefetch > 0 and jax.process_count() == 1:
+        if args.prefetch > 0:
             pf = DevicePrefetch(
-                host, sharding=NamedSharding(mesh, batch_spec()),
-                buffer_size=args.prefetch)
+                host,
+                sharding=(None if place is not None
+                          else NamedSharding(mesh, batch_spec())),
+                place=place, buffer_size=args.prefetch)
             return pf, pf
+        if place is not None:
+            return (place(b) for b in host), None
         return host, None
 
     first_iter, first_pf = (None, None)
@@ -365,8 +673,15 @@ def main(argv=None) -> int:
         holder["pf"] = pf  # keep on_sync's wait accounting on the live one
         return it, pf
 
+    # Per-window (steps, seconds) pairs: the report's steady-state rate
+    # is computed over every window but the first, which carries the
+    # jit compile and first-batch staging — whole-run wall answers "how
+    # long did this take", steady answers "how fast does it train".
+    sync_windows: list = []
+
     def on_sync(gstep, cur_state, window_losses, window_dt):
         nonlocal last_loss
+        sync_windows.append((len(window_losses), window_dt))
         last_loss = window_losses[-1]
         tps = tokens_per_step * len(window_losses) / max(window_dt, 1e-9)
         fields = dict(step=gstep, loss=round(last_loss, 4),
@@ -385,7 +700,17 @@ def main(argv=None) -> int:
 
     guard = (LossAnomalyGuard(factor=args.anomaly_factor)
              if args.anomaly_factor > 0 else None)
-    preempt = PreemptionGuard()
+    if n_processes > 1:
+        # The stop decision must be a cross-process AGREEMENT: signal
+        # delivery skews across workers, and a rank that stops
+        # dispatching while its peers enter the next step's collective
+        # deadlocks the slice. One tiny all-reduce per sync window keeps
+        # every rank stopping on the same step (parallel/multihost.py).
+        from ..parallel.multihost import SyncedPreemptionGuard
+
+        preempt = SyncedPreemptionGuard(check_every=sync_every)
+    else:
+        preempt = PreemptionGuard()
     try:
         preempt.install()
     except ValueError:  # not the main thread (embedded run): unguarded
@@ -393,6 +718,57 @@ def main(argv=None) -> int:
 
     report = None
     aborted = None
+
+    def write_report(outcome: str) -> None:
+        """--report-json: the machine-readable record the scale-out
+        harness, goodput runner, and CI evidence read. Rank 0 only."""
+        if not args.report_json or jax.process_index() != 0:
+            return
+        wall = max(time.perf_counter() - run_t0, 1e-9)
+        steps_done = report.steps if report is not None else 0
+        data = {
+            "schema": 1,
+            "model": config.name,
+            "mesh": describe_mesh(mesh),
+            "n_processes": n_processes,
+            "dcn_sync": dcn_sync,
+            "process_id": jax.process_index(),
+            "devices": n_devices,
+            "global_batch": batch_size,
+            "seq_len": seq_len,
+            "device_ms_per_row": args.device_ms_per_row,
+            "start_step": start_step,
+            "target_step": target_step,
+            "steps": steps_done,
+            "losses": list(report.losses) if report is not None else [],
+            "sync_points": report.sync_points if report is not None else 0,
+            "rollbacks": report.rollbacks if report is not None else 0,
+            "interrupted": bool(report is not None and report.interrupted),
+            "emergency_step": (report.emergency_step
+                               if report is not None else None),
+            "wall_seconds": round(wall, 3),
+            "steps_per_sec": round(steps_done / wall, 4),
+            "tokens_per_sec": round(
+                steps_done * tokens_per_step / wall, 1),
+            "outcome": outcome,
+        }
+        steady = sync_windows[1:]
+        if steady:
+            s_steps = sum(n for n, _ in steady)
+            s_secs = max(sum(dt for _, dt in steady), 1e-9)
+            data["steady_steps_per_sec"] = round(s_steps / s_secs, 4)
+            data["steady_tokens_per_sec"] = round(
+                s_steps * tokens_per_step / s_secs, 1)
+        parent = os.path.dirname(os.path.abspath(args.report_json))
+        os.makedirs(parent, exist_ok=True)
+        tmp = args.report_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.report_json)
+        log.log("info", "run report written", path=args.report_json)
+
+    run_t0 = time.perf_counter()
     try:
         if max_steps:
             if args.profile_dir and not args.dry_run:
@@ -413,9 +789,10 @@ def main(argv=None) -> int:
                     skip_anomalous_window=args.skip_anomalous_window,
                     start_is_checkpointed=start_is_checkpointed,
                     preemption=preempt,
-                    tokens_per_step=tokens_per_step,
+                    tokens_per_step=local_tokens_per_step,
                     config_name=config.name,
-                    on_sync=on_sync, on_checkpoint=on_checkpoint)
+                    on_sync=on_sync, on_checkpoint=on_checkpoint,
+                    step_floor_seconds=step_floor)
             except AnomalyAbortedError as e:
                 aborted = e
                 log.log("error", "anomaly guard aborted the run",
@@ -454,8 +831,10 @@ def main(argv=None) -> int:
         for mgr in (ckpt, em_ckpt):
             if mgr is not None:
                 mgr.close()
+        write_report("anomaly-abort")
         log.log("info", "trainer done", final_loss=final_loss,
                 outcome="anomaly-abort")
+        _distributed_shutdown(n_processes)
         return 4
     if report is not None and report.interrupted:
         # Preemption warning honored: the emergency checkpoint (manifest-
@@ -468,8 +847,10 @@ def main(argv=None) -> int:
                 step=start_step + report.steps,
                 emergency_step=report.emergency_step,
                 exit_code=EXIT_RESUME)
+        write_report("preempted")
         log.log("info", "trainer done", final_loss=final_loss,
                 outcome="preempted")
+        _distributed_shutdown(n_processes)
         return EXIT_RESUME
     if ckpt:
         if ckpt.latest_step() != int(state.step):
@@ -478,7 +859,9 @@ def main(argv=None) -> int:
         ckpt.close()
     if em_ckpt is not None:
         em_ckpt.close()
+    write_report("ok")
     log.log("info", "trainer done", final_loss=final_loss)
+    _distributed_shutdown(n_processes)
     return 0
 
 

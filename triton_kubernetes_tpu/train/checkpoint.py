@@ -129,10 +129,38 @@ class CheckpointManager:
     leave a half-written step masquerading as ``latest_step()``.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 single_controller: bool = False):
         self.directory = os.path.abspath(directory)
+        options_kwargs: Dict[str, Any] = {}
+        if single_controller:
+            # Multi-process runs coordinate checkpoints OUTSIDE orbax
+            # (parallel/multihost.CoordinatedCheckpoint: process 0 writes
+            # host-assembled trees, explicit barriers around the commit).
+            # Orbax must therefore never run its own cross-process
+            # barriers — a rank-0-only save would deadlock inside them —
+            # so each rank's orbax instance is scoped to exactly its own
+            # process.
+            try:
+                from orbax.checkpoint import options as ocp_options
+
+                rank = jax.process_index()
+                options_kwargs["multiprocessing_options"] = (
+                    ocp_options.MultiprocessingOptions(
+                        primary_host=rank, active_processes={rank},
+                        barrier_sync_key_prefix=f"tk8s-r{rank}"))
+            except (ImportError, TypeError) as e:
+                raise CheckpointError(
+                    f"this orbax cannot scope its process set "
+                    f"(multiprocessing_options unavailable: {e}); "
+                    f"single-controller checkpointing needs orbax >= 0.5"
+                ) from e
+            # Orbax refuses create=True alongside active_processes; the
+            # root directory is ours to make.
+            os.makedirs(self.directory, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=True)
+            max_to_keep=max_to_keep, create=not single_controller,
+            **options_kwargs)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
         self._closed = False
         # step -> {"t0": dispatch clock, "kind": ..., "tree": leaf meta};
@@ -279,6 +307,12 @@ class CheckpointManager:
         return dst
 
     # --------------------------------------------------------------- restore
+    def reload(self) -> None:
+        """Re-scan the directory for steps other writers committed (the
+        coordinated multi-process wrapper calls this on non-writer ranks:
+        their orbax index only tracks their OWN saves, which is none)."""
+        self._mgr.reload()
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 

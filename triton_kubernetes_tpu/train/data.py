@@ -314,10 +314,19 @@ class DevicePrefetch:
 
     def __init__(self, source: Iterable[Any], sharding=None,
                  buffer_size: int = 2, threaded: bool = True,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 place: Callable[[Any], Any] | None = None):
         if buffer_size < 1:
             raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        if place is not None and sharding is not None:
+            raise ValueError("pass either sharding or place, not both")
         self.sharding = sharding
+        # Custom staging hook: multi-process feeds swap the plain sharded
+        # device_put for parallel.multihost.make_batch_placer, which
+        # slices out this process's rows and forms the global jax.Array
+        # from process-local data — same double-buffered overlap, but no
+        # host ever transfers rows it does not own.
+        self._place_fn = place
         self.buffer_size = buffer_size
         self.threaded = threaded
         self.wait_seconds = 0.0
@@ -371,6 +380,8 @@ class DevicePrefetch:
     def _place(self, batch):
         import jax
 
+        if self._place_fn is not None:
+            return self._place_fn(batch)
         if self.sharding is None:
             return jax.tree.map(jax.device_put, batch)
         return jax.tree.map(

@@ -18,24 +18,46 @@ might get baked into bench.py is produced the same way:
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass
 from typing import Any, Dict, List, Tuple
 
 
-def measure_tokens_per_sec(step, state, batches: List[Dict[str, Any]],
-                           tokens_per_step: int, warmup: int,
-                           n_short: int, n_long: int,
-                           sync_every: int = 0,
-                           config_name: str = "",
-                           on_window=None,
-                           ) -> Tuple[float, float, Any]:
-    """Returns (tokens/sec, last loss, final state). ``n_long`` must
-    exceed ``n_short`` (the timed window is their difference).
-    ``sync_every`` sets the host-sync cadence inside each window; 0 syncs
-    once at the window end (the historical behavior — the whole window is
-    in flight). ``on_window(name, steps, seconds)`` fires as each window
+@dataclass(frozen=True)
+class ThroughputReport:
+    """One timed measurement, in the units the scale-out story is told
+    in: ``tokens_per_sec`` is the AGGREGATE rate (``tokens_per_step``
+    counts the *global* batch, so under a DCN data-parallel mesh the
+    number already sums over every process), ``steps_per_sec`` the
+    global-step rate, and ``n_processes`` records how many
+    ``jax.distributed`` processes produced it — the context a bare
+    tokens/s is meaningless without."""
+
+    steps_per_sec: float
+    tokens_per_sec: float
+    loss: float
+    n_processes: int
+    steps_timed: int        # n_long - n_short (the two-point window)
+    window_seconds: float   # t_long - t_short
+
+
+def measure_throughput(step, state, batches: List[Dict[str, Any]],
+                       tokens_per_step: int, warmup: int,
+                       n_short: int, n_long: int,
+                       sync_every: int = 0,
+                       config_name: str = "",
+                       on_window=None,
+                       ) -> Tuple[ThroughputReport, Any]:
+    """Two-point timed measurement through the pipelined loop; returns
+    ``(ThroughputReport, final_state)``. ``n_long`` must exceed
+    ``n_short`` (the timed window is their difference). ``sync_every``
+    sets the host-sync cadence inside each window; 0 syncs once at the
+    window end (the historical behavior — the whole window is in
+    flight). ``on_window(name, steps, seconds)`` fires as each window
     completes (warmup/short/long) — bench.py's partial-progress markers,
     so a measurement killed mid-run still reports the windows it
     finished."""
+    import jax
+
     from .pipeline import run_pipelined
 
     if n_long <= n_short:
@@ -61,4 +83,29 @@ def measure_tokens_per_sec(step, state, batches: List[Dict[str, Any]],
     t_short, _ = run("short", n_short)
     t_long, loss = run("long", n_long)
     dt = max(t_long - t_short, 1e-9)
-    return tokens_per_step * (n_long - n_short) / dt, loss, state
+    steps = n_long - n_short
+    return ThroughputReport(
+        steps_per_sec=steps / dt,
+        tokens_per_sec=tokens_per_step * steps / dt,
+        loss=loss,
+        n_processes=jax.process_count(),
+        steps_timed=steps,
+        window_seconds=dt,
+    ), state
+
+
+def measure_tokens_per_sec(step, state, batches: List[Dict[str, Any]],
+                           tokens_per_step: int, warmup: int,
+                           n_short: int, n_long: int,
+                           sync_every: int = 0,
+                           config_name: str = "",
+                           on_window=None,
+                           ) -> Tuple[float, float, Any]:
+    """Historical surface: ``(tokens/sec, last loss, final state)`` —
+    see :func:`measure_throughput` for the full report (steps/s,
+    process count) the multi-host harness reads."""
+    report, state = measure_throughput(
+        step, state, batches, tokens_per_step, warmup, n_short, n_long,
+        sync_every=sync_every, config_name=config_name,
+        on_window=on_window)
+    return report.tokens_per_sec, report.loss, state

@@ -246,6 +246,7 @@ def run_pipelined(
     should_stop: Optional[Callable[[], bool]] = None,
     prefetch: Any = None,
     clock: Callable[[], float] = time.perf_counter,
+    step_floor_seconds: float = 0.0,
 ) -> Tuple[Any, LoopReport]:
     """Bounded-async training loop: dispatch every step, sync every K.
 
@@ -268,6 +269,16 @@ def run_pipelined(
     ``batches`` when the iterable wraps it (e.g. in an
     ``itertools.chain``), so input-wait accounting still reaches the
     gauge.
+
+    ``step_floor_seconds`` is a deterministic per-step device-time
+    floor — the train-loop analogue of cloudsim's ``op_latency`` knob:
+    it models the accelerator each CPU process stands in for, so
+    scale-out concurrency is measurable without a cloud. The host
+    sleeps only the *remainder* of the floor after each dispatch, so
+    real (async) compute overlaps it and per-step wall converges to
+    ``max(floor, compute)``; losses are untouched and every real
+    overhead (staging, collectives, host syncs) still lands on top.
+    0 (the default) disables it.
 
     Returns ``(final_state, LoopReport)``; ``report.losses`` is bitwise
     identical to what a per-step-synced loop over the same step_fn and
@@ -333,10 +344,19 @@ def run_pipelined(
             on_sync(report.steps, state, window_losses, dt)
         t_window = clock()
 
+    t_dispatch = clock()
     for batch in batches_it:
         if should_stop is not None and should_stop():
             report.interrupted = True
             break
+        if step_floor_seconds > 0.0:
+            # Device-time model: pace dispatch to the floor. Sleeping
+            # (not spinning) frees the core for the async steps already
+            # in flight, so compute overlaps the modeled device time.
+            remain = step_floor_seconds - (clock() - t_dispatch)
+            if remain > 0:
+                time.sleep(remain)
+            t_dispatch = clock()
         state, metrics = step_fn(state, batch)
         window.append(metrics)
         report.steps += 1

@@ -207,6 +207,7 @@ def run_resilient(
     config_name: str = "",
     on_sync: Optional[Callable[[int, Any, List[float], float], None]] = None,
     on_checkpoint: Optional[Callable[[int, str], None]] = None,
+    step_floor_seconds: float = 0.0,
 ) -> Tuple[Any, ResilienceReport]:
     """Drive ``run_pipelined`` to ``target_step`` under the guards.
 
@@ -309,7 +310,8 @@ def run_resilient(
                 sync_every=sync_every, max_steps=target_step - seg_base,
                 tokens_per_step=tokens_per_step, config_name=config_name,
                 on_sync=_on_sync, force_sync=force_sync,
-                should_stop=should_stop, prefetch=prefetch)
+                should_stop=should_stop, prefetch=prefetch,
+                step_floor_seconds=step_floor_seconds)
         except _AnomalyTrip:
             anomaly: Anomaly = trip["anomaly"]
             report.anomalies.append(anomaly)

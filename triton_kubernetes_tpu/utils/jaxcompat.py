@@ -28,6 +28,43 @@ def axis_size(axis_name: Any) -> int:
     return jax.lax.psum(1, axis_name)
 
 
+def make_process_array(sharding: Any, local_data: Any,
+                       global_shape: Optional[tuple] = None) -> Any:
+    """``jax.make_array_from_process_local_data`` with a fallback for jax
+    builds that predate it: assemble the global array from per-device
+    slices of this process's block via
+    ``make_array_from_single_device_arrays``. ``local_data`` is exactly
+    this process's contiguous block of the global array (for replicated
+    dims, the full extent); ``global_shape`` defaults to treating
+    ``local_data`` as the whole array (single-process)."""
+    import numpy as np
+
+    local_data = np.asarray(local_data)
+    if global_shape is None:
+        global_shape = tuple(local_data.shape)
+    if hasattr(jax, "make_array_from_process_local_data"):
+        return jax.make_array_from_process_local_data(
+            sharding, local_data, tuple(global_shape))
+    index_map = sharding.devices_indices_map(tuple(global_shape))
+    local = {dev: idx for dev, idx in index_map.items()
+             if dev.process_index == jax.process_index()}
+    if not local:
+        raise ValueError("sharding has no addressable devices here")
+    # The local block's origin in global coordinates: per-dim min start
+    # over this process's device slices.
+    origin = [min(idx[dim].start or 0 for idx in local.values())
+              for dim in range(local_data.ndim)]
+    shards = []
+    for dev, idx in local.items():
+        rel = tuple(
+            slice((s.start or 0) - o,
+                  (s.stop if s.stop is not None else dim_size) - o)
+            for s, o, dim_size in zip(idx, origin, global_shape))
+        shards.append(jax.device_put(local_data[rel], dev))
+    return jax.make_array_from_single_device_arrays(
+        tuple(global_shape), sharding, shards)
+
+
 def shard_map(f: Callable, *, mesh: Optional[Any] = None,
               in_specs: Any, out_specs: Any,
               check_vma: Optional[bool] = None,

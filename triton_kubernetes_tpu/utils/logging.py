@@ -47,12 +47,16 @@ class Logger:
 
     def __init__(self, stream: Optional[TextIO] = None, *,
                  json_mode: bool = False, level: str = "info",
-                 trace: Optional["TraceCollector"] = None):
+                 trace: Optional["TraceCollector"] = None,
+                 fields: Optional[Dict[str, Any]] = None):
         # None = "current sys.stderr", resolved at emit time so the logger
         # follows stream redirection (pytest capsys, daemonized CLIs).
         self._stream = stream
         self.json_mode = json_mode
         self.level_no = _level_no(level)
+        # Fields stamped on EVERY record (rank tags under multi-process
+        # training: process=N); per-call fields win on collision.
+        self.bound_fields: Dict[str, Any] = dict(fields or {})
         # Optional span sink (utils/trace.TraceCollector): every finished
         # span is exported as a Chrome trace event (--trace-out).
         self.trace = trace
@@ -60,9 +64,18 @@ class Logger:
         self._span_stack = threading.local()
 
     # ------------------------------------------------------------------ emit
+    def bind(self, **fields: Any) -> "Logger":
+        """Stamp ``fields`` on every subsequent record (e.g.
+        ``log.bind(process=jax.process_index())`` after distributed
+        init). Returns self for chaining."""
+        self.bound_fields.update(fields)
+        return self
+
     def log(self, level: str, msg: str, **fields: Any) -> None:
         if _level_no(level) < self.level_no:
             return
+        if self.bound_fields:
+            fields = {**self.bound_fields, **fields}
         spans = self._spans()
         if self.json_mode:
             rec: Dict[str, Any] = {"ts": round(time.time(), 3),

@@ -112,51 +112,51 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
     # ------------------------------------- train/pipeline.py (step loop)
     "tk8s_train_step_duration_seconds": (
         "histogram", "Per-step wall-clock duration, amortized over each "
-        "sync window of the pipelined training loop", ("config",),
+        "sync window of the pipelined training loop", ("config", "process_id"),
         DEFAULT_BUCKETS),
     "tk8s_train_tokens_total": (
         "counter", "Tokens trained, incremented at each host sync point",
-        ("config",), None),
+        ("config", "process_id"), None),
     "tk8s_train_host_syncs_total": (
         "counter", "Device->host metric syncs taken by the training loop "
-        "(one per sync window, NOT one per step)", ("config",), None),
+        "(one per sync window, NOT one per step)", ("config", "process_id"), None),
     "tk8s_train_prefetch_wait_seconds": (
         "gauge", "Seconds the training loop has spent blocked waiting on "
         "the device-prefetch iterator (cumulative; ~0 means host input "
-        "fully overlaps device compute)", (), None),
+        "fully overlaps device compute)", ("process_id",), None),
     "tk8s_train_steps_in_flight": (
         "gauge", "Dispatched-but-unsynced steps currently in flight in "
-        "the pipelined training loop", (), None),
+        "the pipelined training loop", ("process_id",), None),
     # ------------------------------------ train/trainer.py (AOT compile)
     "tk8s_train_compile_seconds": (
         "gauge", "AOT compile-time split of the train step by phase "
         "(lower / compile); near-zero compile on a warm persistent "
-        "cache", ("config", "phase"), None),
+        "cache", ("config", "phase", "process_id"), None),
     "tk8s_train_memory_bytes": (
         "gauge", "Per-device byte accounting of the AOT-compiled train "
         "step from XLA's memory_analysis(), by kind (argument/output/"
         "temp/alias/peak); temp is what a remat policy moves, argument "
         "what a precision policy's storage dtypes move",
-        ("config", "kind"), None),
+        ("config", "kind", "process_id"), None),
     # --------------------------------- train/checkpoint.py (integrity)
     "tk8s_train_checkpoint_save_duration_seconds": (
         "histogram", "Wall clock from checkpoint-save dispatch to "
         "manifest commit, by save kind (scheduled/emergency/final)",
-        ("kind",), DEFAULT_BUCKETS),
+        ("kind", "process_id"), DEFAULT_BUCKETS),
     "tk8s_train_checkpoint_bytes_total": (
         "counter", "Bytes committed to manifest-verified checkpoints, "
-        "by save kind", ("kind",), None),
+        "by save kind", ("kind", "process_id"), None),
     "tk8s_train_checkpoint_verify_failures_total": (
         "counter", "Checkpoint manifest verification failures, by "
         "reason (missing-manifest/torn-manifest/digest-mismatch/"
         "truncated/checksum-mismatch/missing-file/missing-step)",
-        ("reason",), None),
+        ("reason", "process_id"), None),
     "tk8s_train_checkpoint_emergency_saves_total": (
         "counter", "Synchronous emergency checkpoints written on a "
-        "preemption warning", (), None),
+        "preemption warning", ("process_id",), None),
     "tk8s_train_checkpoint_fallback_restores_total": (
         "counter", "Restores that quarantined a bad step and fell back "
-        "to an earlier verified one", (), None),
+        "to an earlier verified one", ("process_id",), None),
     # ------------------------------------------- serve/engine.py + server
     "tk8s_serve_requests_total": (
         "counter", "Serving requests completed, by outcome "
@@ -192,10 +192,10 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
     "tk8s_train_anomaly_rollbacks_total": (
         "counter", "Loss-anomaly rollbacks taken by the guarded "
         "training loop, by trip reason (non-finite/spike)",
-        ("reason",), None),
+        ("reason", "process_id"), None),
     "tk8s_train_anomaly_aborts_total": (
         "counter", "Guarded-loop aborts after the consecutive-rollback "
-        "budget was exhausted", (), None),
+        "budget was exhausted", ("process_id",), None),
 }
 
 _VALID_KINDS = ("counter", "gauge", "histogram")
@@ -222,14 +222,26 @@ class _Metric:
     kind = "untyped"
 
     def __init__(self, name: str, help: str, labelnames: Sequence[str],
-                 lock: threading.RLock):
+                 lock: threading.RLock,
+                 defaults: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = lock  # the owning registry's lock, shared
+        # Registry-wide default label values (shared dict): declared
+        # labels a call site omits are filled from here — how every
+        # tk8s_train_* family gets its process_id rank tag without each
+        # call site threading the rank through.
+        self._defaults = defaults if defaults is not None else {}
         self._series: Dict[Tuple[str, ...], Any] = {}
 
     def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
+        missing = set(self.labelnames) - set(labels)
+        if missing & set(self._defaults):
+            labels = dict(labels)
+            for name in missing:
+                if name in self._defaults:
+                    labels[name] = self._defaults[name]
         if set(labels) != set(self.labelnames):
             raise ValueError(
                 f"metric {self.name!r} takes labels {list(self.labelnames)}, "
@@ -300,8 +312,9 @@ class Histogram(_Metric):
 
     def __init__(self, name: str, help: str, labelnames: Sequence[str],
                  lock: threading.RLock,
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
-        super().__init__(name, help, labelnames, lock)
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 defaults: Optional[Dict[str, str]] = None):
+        super().__init__(name, help, labelnames, lock, defaults)
         bs = tuple(sorted(float(b) for b in buckets))
         if not bs:
             raise ValueError(f"histogram {name!r} needs at least one bucket")
@@ -359,6 +372,13 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.RLock()
         self._families: Dict[str, _Metric] = {}
+        # Default label values filled into any family that declares the
+        # label but whose call site omits it. process_id defaults to "0"
+        # (the truthful single-process rank); multi-process trainers call
+        # set_default_labels(process_id=str(jax.process_index())) once
+        # after distributed init and every tk8s_train_* series emitted by
+        # that worker is rank-tagged from then on.
+        self._default_labels: Dict[str, str] = {"process_id": "0"}
 
     # ------------------------------------------------------------ families
     def _get_or_create(self, kind: str, name: str, help: Optional[str],
@@ -384,11 +404,14 @@ class MetricsRegistry:
                         f"{list(existing.labelnames)}, not {list(labelnames)}")
                 return existing
             if kind == "counter":
-                fam: _Metric = Counter(name, help, labelnames, self._lock)
+                fam = Counter(name, help, labelnames, self._lock,
+                              self._default_labels)
             elif kind == "gauge":
-                fam = Gauge(name, help, labelnames, self._lock)
+                fam = Gauge(name, help, labelnames, self._lock,
+                            self._default_labels)
             elif kind == "histogram":
-                fam = Histogram(name, help, labelnames, self._lock, buckets)
+                fam = Histogram(name, help, labelnames, self._lock, buckets,
+                                self._default_labels)
             else:
                 raise ValueError(f"unknown metric kind {kind!r} "
                                  f"(valid: {list(_VALID_KINDS)})")
@@ -408,6 +431,14 @@ class MetricsRegistry:
                   buckets: Optional[Sequence[float]] = None) -> Histogram:
         return self._get_or_create("histogram", name, help, labelnames,
                                    buckets)  # type: ignore[return-value]
+
+    def set_default_labels(self, **labels: Any) -> None:
+        """Set registry-wide default label values (merged into every
+        family — existing and future — that declares the label). The
+        multi-process rank tag: ``set_default_labels(process_id="1")``."""
+        with self._lock:
+            for name, value in labels.items():
+                self._default_labels[str(name)] = str(value)
 
     def register_catalog(self) -> None:
         """Instantiate every :data:`CATALOG` family (zero series), so a
@@ -485,6 +516,12 @@ def configure(registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
 # Convenience module-level constructors against the *current* default
 # registry — instrumented call sites use these so a registry swap/reset
 # takes effect immediately (no stale family references).
+def set_default_labels(**labels: Any) -> None:
+    """Registry-wide default label values on the current default
+    registry (see :meth:`MetricsRegistry.set_default_labels`)."""
+    get_registry().set_default_labels(**labels)
+
+
 def counter(name: str, help: Optional[str] = None,
             labelnames: Optional[Sequence[str]] = None) -> Counter:
     return get_registry().counter(name, help, labelnames)
