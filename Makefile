@@ -2,13 +2,24 @@
 GIT_SHA := $(shell git rev-parse --short HEAD 2>/dev/null || echo dev)
 PY := python
 
-.PHONY: test test-fast build native bench clean
+.PHONY: test test-fast lint typecheck build native bench clean
 
 test:
 	$(PY) -m pytest tests/ -q
 
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow" -x
+
+# The repo-native TK8S1xx invariant checkers (stdlib-only; exits 1 on
+# findings) and the mypy ratchet over the typed jax-free core
+# (docs/guide/static-analysis.md). `typecheck` needs `pip install -e
+# .[dev]`; the ratchet gate itself runs via
+# scripts/ci/static_analysis_evidence.py.
+lint:
+	$(PY) -m triton_kubernetes_tpu.cli lint
+
+typecheck:
+	$(PY) -m mypy --no-error-summary
 
 # Wheel + sdist with the git SHA stamped into `version` output
 # (the reference's -ldflags -X cmd.cliVersion analog, Makefile:2 there).

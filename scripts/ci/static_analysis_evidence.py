@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Produce the static-analysis evidence artifact and enforce the gates:
+`tk8s lint` must be clean, and the mypy error count must not rise above
+the committed baseline.
+
+Two gates, one artifact
+(docs/ci-evidence/static-analysis-<tag>.json):
+
+* **lint** — runs ``tk8s lint --format json`` over the repo; any
+  finding fails the build (the rules are the invariants PRs 1-8
+  established: docs/guide/static-analysis.md).
+* **mypy ratchet** — runs mypy over the jax-free core ([tool.mypy] in
+  pyproject.toml) and compares the per-file error counts against
+  scripts/ci/mypy_baseline.json. A count *rising* anywhere fails; a
+  count falling prints the tightened baseline (commit it via
+  ``--update-baseline``). The ratchet only turns one way.
+
+Degradation contract (the scaleout_evidence.py pattern): on machines
+without mypy installed the ratchet is a LOUD typed skip
+(``skipped:mypy-unavailable``) and only the lint gate applies — the
+linter itself is stdlib-only by design. A baseline still marked
+``"bootstrap": true`` is (re-)pinned rather than enforced on the first
+run with mypy available.
+
+``--require-baseline`` (what CI passes) turns the bootstrap state into
+a FAILURE instead of a silent re-bootstrap: without it, a CI whose
+workspace is ephemeral would pin the baseline into the void every run
+and never enforce anything. The failing run uploads the observed
+counts in its artifact — commit them (or run ``--update-baseline``
+locally) and the ratchet is armed from then on.
+
+Usage: python scripts/ci/static_analysis_evidence.py [tag]
+       python scripts/ci/static_analysis_evidence.py --update-baseline
+       python scripts/ci/static_analysis_evidence.py --require-baseline [tag]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, Optional, Tuple
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), os.pardir, os.pardir))
+BASELINE_PATH = os.path.join(REPO, "scripts", "ci", "mypy_baseline.json")
+EVIDENCE_DIR = os.path.join(REPO, "docs", "ci-evidence")
+
+MYPY_ERROR_RE = re.compile(r"^(?P<path>[^:\n]+\.pyi?):\d+(?::\d+)?: error:")
+
+
+def run_lint(root: str = REPO) -> Tuple[int, dict]:
+    """``tk8s lint --format json`` as a subprocess — the exact command
+    CI and operators run, not an in-process shortcut."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "triton_kubernetes_tpu.cli", "lint",
+         "--format", "json", "--root", root],
+        capture_output=True, text=True, cwd=root)
+    try:
+        doc = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        doc = {"error": "lint produced no JSON",
+               "stdout": proc.stdout[-2000:], "stderr": proc.stderr[-2000:]}
+    return proc.returncode, doc
+
+
+def run_mypy(root: str = REPO) -> Optional[str]:
+    """mypy's stdout over the configured core, or None when mypy is not
+    installed (the loud-skip path)."""
+    try:
+        import mypy  # noqa: F401
+    except ImportError:
+        return None
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--no-error-summary"],
+        capture_output=True, text=True, cwd=root)
+    return proc.stdout
+
+
+def parse_mypy_output(text: str) -> Dict[str, int]:
+    """POSIX path -> error count, from mypy's line output."""
+    counts: Dict[str, int] = {}
+    for line in text.splitlines():
+        m = MYPY_ERROR_RE.match(line.strip())
+        if m:
+            path = m.group("path").replace(os.sep, "/")
+            counts[path] = counts.get(path, 0) + 1
+    return counts
+
+
+def compare_to_baseline(counts: Dict[str, int],
+                        baseline: dict) -> Tuple[str, list, dict]:
+    """(status, regressions, tightened-baseline).
+
+    status: ``bootstrap`` (baseline not yet pinned), ``regressed``
+    (some file's count rose — the CI failure), or ``ok``. The tightened
+    baseline carries the observed counts, for --update-baseline.
+    """
+    tightened = {"bootstrap": False, "by_file": dict(sorted(counts.items())),
+                 "total": sum(counts.values())}
+    if baseline.get("bootstrap", False):
+        return "bootstrap", [], tightened
+    pinned: Dict[str, int] = baseline.get("by_file", {})
+    regressions = []
+    for path, n in sorted(counts.items()):
+        allowed = pinned.get(path, 0)
+        if n > allowed:
+            regressions.append(
+                f"{path}: {n} errors > baseline {allowed}")
+    return ("regressed" if regressions else "ok"), regressions, tightened
+
+
+def main(argv) -> int:
+    update = "--update-baseline" in argv
+    require_baseline = "--require-baseline" in argv
+    args = [a for a in argv if not a.startswith("--")]
+    tag = args[0] if args else "local"
+
+    lint_rc, lint_doc = run_lint()
+    lint_total = lint_doc.get("summary", {}).get("total")
+    print(f"lint: rc={lint_rc} findings={lint_total} "
+          f"files={lint_doc.get('files_checked')}")
+
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)
+    mypy_out = run_mypy()
+    if mypy_out is None:
+        mypy_doc: dict = {"status": "skipped:mypy-unavailable"}
+        print("mypy: skipped:mypy-unavailable (pip install -e .[dev] to "
+              "enable the ratchet locally; the lint gate still ran)")
+        ratchet_failed = False
+    else:
+        counts = parse_mypy_output(mypy_out)
+        status, regressions, tightened = compare_to_baseline(
+            counts, baseline)
+        mypy_doc = {"status": status, "total": sum(counts.values()),
+                    "by_file": dict(sorted(counts.items())),
+                    "regressions": regressions,
+                    "baseline_total": baseline.get("total")}
+        print(f"mypy: {status} total={mypy_doc['total']} "
+              f"baseline={baseline.get('total')}")
+        for r in regressions:
+            print(f"mypy regression: {r}")
+        if status == "bootstrap" or update:
+            with open(BASELINE_PATH, "w") as f:
+                json.dump(tightened, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"baseline {'updated' if update else 'pinned'}: "
+                  f"{BASELINE_PATH} (commit it)")
+        elif status == "ok" and sum(counts.values()) < (
+                baseline.get("total") or 0):
+            print("mypy improved below baseline — run with "
+                  "--update-baseline and commit the tighter pin")
+        ratchet_failed = status == "regressed"
+        if status == "bootstrap" and require_baseline:
+            # An ephemeral workspace would re-bootstrap (and pass)
+            # forever — under CI a missing pin is itself a failure. The
+            # observed counts ride the artifact; commit them to arm the
+            # ratchet.
+            print("FAIL: mypy baseline is still the bootstrap sentinel "
+                  "— commit the pinned counts from this run's artifact "
+                  "(or run --update-baseline locally)")
+            ratchet_failed = True
+
+    os.makedirs(EVIDENCE_DIR, exist_ok=True)
+    out = os.path.join(EVIDENCE_DIR, f"static-analysis-{tag}.json")
+    with open(out, "w") as f:
+        json.dump({"tag": tag, "lint": lint_doc, "mypy": mypy_doc},
+                  f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"evidence: {out}")
+
+    if lint_rc != 0:
+        print("FAIL: lint findings (fix them or suppress with a reason "
+              "— docs/guide/static-analysis.md)")
+        return 1
+    if ratchet_failed:
+        print("FAIL: mypy error count rose above the committed baseline")
+        return 1
+    print("OK: static-analysis gates green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

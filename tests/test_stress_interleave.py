@@ -1,0 +1,181 @@
+"""Deterministic thread-interleaving stress tests (ISSUE 9 satellite):
+the dynamic companion to lint rule TK8S103 (lock discipline).
+
+``sys.setswitchinterval(1e-5)`` makes the interpreter release the GIL
+~1000x more often than the 5ms default, so racy read-modify-write
+windows that virtually never interleave under normal scheduling get
+hammered on every run — the cheapest honest way to exercise lock
+coverage without injecting scheduler hooks. Workers start on a Barrier
+so every thread enters the contended region together.
+
+Targets are the three structures the serving/apply concurrency regime
+leans on: MetricsRegistry (every layer writes it from worker threads),
+serve/blocks.py BlockAllocator (scheduler bookkeeping), and the
+wavefront engine's per-module state saves (8 workers committing through
+one lock).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+import pytest
+
+import test_wavefront as tw
+from triton_kubernetes_tpu.serve.blocks import BlockAllocator, OutOfBlocksError
+from triton_kubernetes_tpu.utils.metrics import MetricsRegistry
+
+N_THREADS = 8
+N_OPS = 400
+
+
+@pytest.fixture(autouse=True)
+def _fast_switch():
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    yield
+    sys.setswitchinterval(old)
+
+
+@pytest.fixture(autouse=True)
+def _clean_memory_executor_state():
+    # test_wavefront's autouse fixture does not reach this module.
+    from triton_kubernetes_tpu.executor.engine import _MEMORY_STATES
+
+    yield
+    _MEMORY_STATES.clear()
+
+
+def _run_workers(fn, n=N_THREADS):
+    """Barrier-started workers; the first worker exception is re-raised
+    in the test thread (a swallowed assert is a vacuous pass)."""
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def wrap(i):
+        try:
+            barrier.wait()
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 - reraised below
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+# ------------------------------------------------------------- metrics
+
+def test_metrics_registry_counts_exact_under_interleaving():
+    reg = MetricsRegistry()
+    counter = reg.counter("tk8s_cloudsim_ops_total")
+    hist = reg.histogram("tk8s_module_apply_duration_seconds")
+    gauge = reg.gauge("tk8s_apply_in_flight")
+
+    def work(i):
+        for k in range(N_OPS):
+            counter.inc(op=f"op{i % 4}")
+            hist.observe(0.001 * (k % 7), module=f"m{i % 2}")
+            gauge.inc()
+            gauge.inc(-1)
+
+    _run_workers(work)
+    snap = reg.snapshot()
+    ops = snap["tk8s_cloudsim_ops_total"]["series"]
+    assert sum(s["value"] for s in ops) == N_THREADS * N_OPS
+    h = snap["tk8s_module_apply_duration_seconds"]["series"]
+    assert sum(s["count"] for s in h) == N_THREADS * N_OPS
+    assert all(s["buckets"]["+Inf"] == s["count"] for s in h)
+    inflight = snap["tk8s_apply_in_flight"]["series"]
+    assert [s["value"] for s in inflight] == [0.0]
+
+
+def test_metrics_reader_never_sees_torn_state():
+    """snapshot()/render_prometheus() race the writers: every observed
+    total must be a value some prefix of increments could produce (a
+    multiple of nothing weirder than the per-op amount), and rendering
+    must never throw mid-mutation."""
+    reg = MetricsRegistry()
+    counter = reg.counter("tk8s_cloudsim_ops_total")
+    stop = threading.Event()
+    seen = []
+
+    def reader():
+        while not stop.is_set():
+            snap = reg.snapshot()
+            series = snap.get("tk8s_cloudsim_ops_total", {}).get("series", [])
+            seen.append(sum(s["value"] for s in series))
+            reg.render_prometheus()
+
+    r = threading.Thread(target=reader)
+    r.start()
+    try:
+        _run_workers(lambda i: [counter.inc(op="x")
+                                for _ in range(N_OPS)])
+    finally:
+        stop.set()
+        r.join()
+    assert seen == sorted(seen)  # totals only ever grow
+    assert seen[-1] <= N_THREADS * N_OPS
+    final = reg.snapshot()["tk8s_cloudsim_ops_total"]["series"]
+    assert sum(s["value"] for s in final) == N_THREADS * N_OPS
+
+
+# ------------------------------------------------------------ allocator
+
+def test_block_allocator_invariants_under_interleaved_churn():
+    """The allocator is single-owner by design — the engine loop guards
+    it — so the contract under test is the one the scheduler relies on:
+    externally serialized interleaved alloc/free cycles never hand the
+    same page to two holders, never leak, and drain back to a full pool."""
+    alloc = BlockAllocator(num_blocks=N_THREADS * 4 + 1)
+    lock = threading.Lock()
+    held_global: set = set()
+
+    def work(i):
+        for k in range(N_OPS // 4):
+            want = 1 + (i + k) % 4
+            with lock:
+                try:
+                    pages = alloc.alloc(want)
+                except OutOfBlocksError:
+                    continue  # pool contended dry: a scheduler signal,
+                              # not a bug
+                overlap = held_global & set(pages)
+                assert not overlap, f"double-allocated {overlap}"
+                held_global.update(pages)
+            # interleave point: other threads run between alloc and free
+            with lock:
+                held_global.difference_update(pages)
+                alloc.free(pages)
+
+    _run_workers(work)
+    assert alloc.in_use == 0
+    assert alloc.available == alloc.capacity
+    # Determinism survives churn: a drained pool hands out the lowest
+    # pages again, in order.
+    assert alloc.alloc(3) == [1, 2, 3]
+
+
+# ------------------------------------------------------------ wavefront
+
+def test_wavefront_state_saves_bitwise_stable_under_interleaving():
+    """8 workers committing per-module state saves through the engine
+    lock, with the scheduler switching ~every 10us: the final state and
+    normalized journal must stay byte-identical to the serial run (the
+    PR 5 parity pin, now under adversarial interleaving)."""
+    prints = {}
+    for par, name in [(1, "stress-serial"), (8, "stress-par8a"),
+                      (8, "stress-par8b")]:
+        doc, _ = tw._fanout_doc(name, n_hosts=12,
+                                driver={"name": "sim"})
+        ex = tw._quiet(parallelism=par)
+        ex.apply(doc)
+        prints[name] = tw._fingerprint(doc)
+    assert prints["stress-par8a"] == prints["stress-serial"]
+    assert prints["stress-par8b"] == prints["stress-serial"]

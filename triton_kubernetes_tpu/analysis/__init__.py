@@ -1,0 +1,53 @@
+"""Repo-native static analysis: the invariants PRs 1-8 established,
+checked mechanically.
+
+Every hard bug in this repo's history has been an invariant violation a
+tree walk can catch: donating a host-aliased buffer (PR 8's memory
+corruption), importing ``jax.experimental.shard_map`` raw instead of
+through ``utils/jaxcompat.py`` (a C++ abort, not an exception, on old
+jax), sleeping under the cloudsim lock, a port constant drifting at one
+of its jax-free duplication sites. ``tk8s lint`` encodes each of those
+as a ``TK8S1xx`` rule over stdlib :mod:`ast` — no third-party linter
+dependency, matching the metrics/trace ethos.
+
+Public surface:
+
+* :func:`lint_project` — run every rule over a repo root, returns
+  (findings, stats);
+* :data:`RULES` — the active rule registry;
+* :class:`Finding` — one diagnostic;
+* reporters in :mod:`.report` (human text + JSON evidence).
+
+Suppressions are inline comments with a mandatory reason::
+
+    time.sleep(0.1)  # tk8s-lint: disable=TK8S103(latency knob; lock not held)
+
+A reasonless ``disable`` is itself an error (TK8S100). Policy and the
+rule catalog: docs/guide/static-analysis.md.
+"""
+
+from .core import (
+    DEFAULT_SCAN_ROOTS,
+    FileContext,
+    Finding,
+    Project,
+    RULES,
+    Rule,
+    lint_project,
+    register,
+)
+from . import rules as _rules  # noqa: F401  (importing registers the rules)
+from .report import render_human, render_json
+
+__all__ = [
+    "DEFAULT_SCAN_ROOTS",
+    "FileContext",
+    "Finding",
+    "Project",
+    "RULES",
+    "Rule",
+    "lint_project",
+    "register",
+    "render_human",
+    "render_json",
+]

@@ -1,0 +1,314 @@
+"""Linter engine: file loading, the rule registry, suppressions.
+
+Design constraints, in order:
+
+* **stdlib only** — :mod:`ast` + :mod:`tokenize`; this must run on the
+  provisioning-only install (no jax, no third-party linter).
+* **root-relative** — every rule addresses files by POSIX-style path
+  relative to a configurable root, so the test suite can build minimal
+  known-bad trees under ``tmp_path`` and the same rule code checks both
+  the fixture and the real repo.
+* **two rule shapes** — per-file rules see one :class:`FileContext`;
+  project rules see the whole :class:`Project` (cross-file constant
+  agreement, catalog/docs drift).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# What `tk8s lint` scans when no explicit paths are given: the package,
+# the CI scripts, and the two top-level entrypoints. tests/ is excluded
+# by design — fixtures there *intentionally* violate invariants.
+DEFAULT_SCAN_ROOTS: Tuple[str, ...] = (
+    "triton_kubernetes_tpu", "scripts", "bench.py", "__graft_entry__.py",
+)
+
+SUPPRESS_RE = re.compile(
+    r"tk8s-lint:\s*disable=(?P<codes>TK8S\d{3}(?:\s*,\s*TK8S\d{3})*)"
+    r"(?P<rest>.*)")
+
+# The attestation rule TK8S102 looks for (see rules.DonationAttestation).
+# Matched against a joined comment block, so the why may span lines and
+# contain parens (greedy to the block's last `)`).
+DONATE_SAFE_RE = re.compile(r"tk8s:\s*donate-safe\((?P<why>.*)\)",
+                            re.DOTALL)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``path`` is root-relative POSIX."""
+
+    code: str
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"code": self.code, "rule": self.rule, "path": self.path,
+                "line": self.line, "col": self.col, "message": self.message}
+
+
+@dataclass(frozen=True)
+class Suppression:
+    codes: Tuple[str, ...]
+    reason: str
+    line: int          # physical line the `disable=` comment sits on
+    end_line: int      # last line of the comment block (reason may span
+                       # consecutive full-line comments until the `)`)
+    own_line: bool     # a comment-only block also covers the next line
+
+
+@dataclass
+class FileContext:
+    """One parsed source file plus its comment map."""
+
+    path: str                    # root-relative POSIX
+    source: str
+    tree: ast.AST
+    comments: Dict[int, str] = field(default_factory=dict)
+    suppressions: List[Suppression] = field(default_factory=list)
+    parse_error: Optional[str] = None
+
+    def comment_in_range(self, lo: int, hi: int,
+                         pattern: re.Pattern) -> Optional[re.Match]:
+        """First regex match over the comments on lines [lo, hi]."""
+        for ln in range(lo, hi + 1):
+            text = self.comments.get(ln)
+            if text:
+                m = pattern.search(text)
+                if m:
+                    return m
+        return None
+
+    def block_comment_text(self, node: ast.AST) -> str:
+        """The contiguous full-line comment block immediately above
+        ``node``, plus any comments inline within its span, joined —
+        where statement-level attestations like donate-safe live."""
+        lines = self.source.splitlines()
+
+        def full_line(ln: int) -> bool:
+            return (ln in self.comments and 1 <= ln <= len(lines)
+                    and lines[ln - 1].lstrip().startswith("#"))
+
+        start = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", None) or start
+        block: List[str] = []
+        ln = start - 1
+        while full_line(ln):
+            block.insert(0, self.comments[ln])
+            ln -= 1
+        for inner in range(start, end + 1):
+            if inner in self.comments:
+                block.append(self.comments[inner])
+        return " ".join(c.lstrip("# ").strip() for c in block)
+
+    def suppressed(self, code: str, line: int) -> bool:
+        """True if a well-formed (reason-carrying) suppression covers
+        ``code`` at ``line``: same-line, or a comment-only line
+        immediately above."""
+        for s in self.suppressions:
+            if code not in s.codes or not s.reason.strip():
+                continue
+            if s.line == line or (s.own_line and s.end_line == line - 1):
+                return True
+        return False
+
+
+def _comment_map(source: str) -> Dict[int, str]:
+    """line -> comment text, via tokenize (comments inside string
+    literals never count)."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # the ast parse reports the real problem
+    return out
+
+
+def load_file(root: Path, rel: str) -> FileContext:
+    source = (root / rel).read_text(encoding="utf-8")
+    try:
+        tree: ast.AST = ast.parse(source, filename=rel)
+        err = None
+    except SyntaxError as e:
+        tree = ast.Module(body=[], type_ignores=[])
+        err = f"{e.msg} (line {e.lineno})"
+    comments = _comment_map(source)
+    lines = source.splitlines()
+
+    def full_line(ln: int) -> bool:
+        return (ln in comments and 1 <= ln <= len(lines)
+                and lines[ln - 1].lstrip().startswith("#"))
+
+    sups: List[Suppression] = []
+    for ln in sorted(comments):
+        m = SUPPRESS_RE.search(comments[ln])
+        if m is None:
+            continue
+        codes = tuple(c.strip() for c in m.group("codes").split(","))
+        own = full_line(ln)
+        # The mandatory reason: `(...)`. An own-line suppression extends
+        # over the whole contiguous full-line comment block, so real
+        # explanations need not cram one line; the reason runs to the
+        # LAST `)` in the block (reasons may themselves contain parens,
+        # e.g. "close() already quarantines").
+        rest, end = m.group("rest").strip(), ln
+        while own and full_line(end + 1):
+            end += 1
+            rest += " " + comments[end].lstrip("# ").strip()
+        rm = re.match(r"\((?P<reason>.*)\)", rest, re.DOTALL)
+        reason = rm.group("reason").strip() if rm else ""
+        sups.append(Suppression(codes=codes, reason=reason, line=ln,
+                                end_line=end, own_line=own))
+    return FileContext(path=rel, source=source, tree=tree,
+                       comments=comments, suppressions=sups,
+                       parse_error=err)
+
+
+@dataclass
+class Project:
+    """Every scanned file, addressable root-relative."""
+
+    root: Path
+    files: Dict[str, FileContext] = field(default_factory=dict)
+
+    def file(self, rel: str) -> Optional[FileContext]:
+        """Fetch (loading lazily) a file a project rule needs even when
+        it is outside the scanned set — e.g. a docs .md is read raw via
+        :meth:`read_text`, but pinned-constant sites are .py files that
+        may not be under an explicitly restricted scan."""
+        if rel in self.files:
+            return self.files[rel]
+        p = self.root / rel
+        if not p.is_file():
+            return None
+        ctx = load_file(self.root, rel)
+        self.files[rel] = ctx
+        return ctx
+
+    def read_text(self, rel: str) -> Optional[str]:
+        p = self.root / rel
+        if not p.is_file():
+            return None
+        return p.read_text(encoding="utf-8")
+
+
+class Rule:
+    """Base class. Subclasses set ``code``/``name``/``summary`` and
+    override one (or both) of the check hooks."""
+
+    code: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+    # -- helpers shared by subclasses ------------------------------------
+    def finding(self, ctx_or_path, line: int, col: int,
+                message: str) -> Finding:
+        path = (ctx_or_path.path if isinstance(ctx_or_path, FileContext)
+                else str(ctx_or_path))
+        return Finding(code=self.code, rule=self.name, path=path,
+                       line=line, col=col, message=message)
+
+
+RULES: List[Rule] = []
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the active registry."""
+    RULES.append(cls())
+    return cls
+
+
+def discover(root: Path, scan: Sequence[str]) -> List[str]:
+    rels: List[str] = []
+    for entry in scan:
+        p = root / entry
+        if p.is_file() and p.suffix == ".py":
+            rels.append(Path(entry).as_posix())
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                rels.append(f.relative_to(root).as_posix())
+    return rels
+
+
+def _suppression_hygiene(ctx: FileContext) -> List[Finding]:
+    """TK8S100: every disable must carry a non-empty reason. Emitted by
+    the engine (not a registered rule instance) so it cannot itself be
+    disabled."""
+    out = []
+    for s in ctx.suppressions:
+        if not s.reason.strip():
+            out.append(Finding(
+                code="TK8S100", rule="suppression-hygiene", path=ctx.path,
+                line=s.line, col=0,
+                message="tk8s-lint disable without a reason — write "
+                        "disable=CODE(<why this is safe here>)"))
+    return out
+
+
+def lint_project(root, paths: Optional[Sequence[str]] = None,
+                 rules: Optional[Sequence[Rule]] = None,
+                 ) -> Tuple[List[Finding], Dict[str, object]]:
+    """Run the registry over ``root``. Returns (findings, stats).
+
+    ``paths`` restricts the per-file scan (project rules still load the
+    specific files they pin). Suppressed findings are dropped; malformed
+    suppressions surface as TK8S100.
+    """
+    root = Path(root)
+    active = list(rules) if rules is not None else list(RULES)
+    scan = list(paths) if paths else list(DEFAULT_SCAN_ROOTS)
+    project = Project(root=root)
+    for rel in discover(root, scan):
+        project.file(rel)
+    scanned = list(project.files)
+
+    findings: List[Finding] = []
+    for rel in scanned:
+        ctx = project.files[rel]
+        findings.extend(_suppression_hygiene(ctx))
+        if ctx.parse_error:
+            findings.append(Finding(
+                code="TK8S199", rule="syntax", path=rel, line=1, col=0,
+                message=f"file does not parse: {ctx.parse_error}"))
+            continue
+        for rule in active:
+            findings.extend(rule.check_file(ctx))
+    for rule in active:
+        findings.extend(rule.check_project(project))
+
+    kept = []
+    for f in findings:
+        ctx = project.files.get(f.path)
+        if (f.code != "TK8S100" and ctx is not None
+                and ctx.suppressed(f.code, f.line)):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    stats: Dict[str, object] = {
+        "files_checked": len(scanned),
+        "rules": sorted({r.code for r in active} | {"TK8S100"}),
+    }
+    return kept, stats

@@ -1,0 +1,46 @@
+"""Reporters: human terminal text and the JSON evidence document.
+
+The JSON shape is the `static-analysis-evidence` CI artifact
+(scripts/ci/static_analysis_evidence.py uploads it), so it is versioned
+and additive-only.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from .core import RULES, Finding
+
+JSON_VERSION = 1
+
+
+def render_human(findings: Sequence[Finding],
+                 stats: Dict[str, object]) -> str:
+    lines: List[str] = []
+    for f in findings:
+        lines.append(f"{f.location()}: {f.code} [{f.rule}] {f.message}")
+    n = len(findings)
+    rules = stats.get("rules", [])
+    lines.append(
+        f"{'FAIL' if n else 'OK'}: {n} finding{'s' if n != 1 else ''} "
+        f"({stats.get('files_checked', 0)} files, {len(rules)} rules)")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding],
+                stats: Dict[str, object]) -> str:
+    by_code: Dict[str, int] = {}
+    for f in findings:
+        by_code[f.code] = by_code.get(f.code, 0) + 1
+    doc = {
+        "version": JSON_VERSION,
+        "files_checked": stats.get("files_checked", 0),
+        "rules": [
+            {"code": r.code, "name": r.name, "summary": r.summary}
+            for r in sorted(RULES, key=lambda r: r.code)
+        ],
+        "findings": [f.to_dict() for f in findings],
+        "summary": {"total": len(findings), "by_code": by_code},
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -1,0 +1,628 @@
+"""The TK8S1xx rule set: one rule per bug class PRs 1-8 fixed by hand.
+
+Each rule's docstring names the historical incident it mechanizes; the
+full catalog with suppression policy lives in
+docs/guide/static-analysis.md. Codes are stable — tests pin them, and
+suppression comments reference them — so renumbering is a breaking
+change.
+
+Engine-reserved codes (emitted by :mod:`.core`, not here): TK8S100
+(suppression without a reason), TK8S199 (file does not parse).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import (
+    DONATE_SAFE_RE,
+    FileContext,
+    Finding,
+    Project,
+    Rule,
+    register,
+)
+
+PKG = "triton_kubernetes_tpu"
+JAXCOMPAT = f"{PKG}/utils/jaxcompat.py"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_map(tree: ast.AST) -> Dict[str, str]:
+    """local name -> fully qualified origin, for every import binding.
+
+    ``import time`` -> {time: time}; ``import subprocess as sp`` ->
+    {sp: subprocess}; ``from time import sleep`` -> {sleep: time.sleep}.
+    Relative imports keep their leading dots (callers match suffixes).
+    """
+    out: Dict[str, str] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(n, ast.ImportFrom):
+            mod = "." * n.level + (n.module or "")
+            for a in n.names:
+                out[a.asname or a.name] = f"{mod}.{a.name}"
+    return out
+
+
+def resolve_call(node: ast.Call, imports: Dict[str, str]) -> Optional[str]:
+    """Fully qualified dotted name of the callee, through the file's
+    import aliases. ``sp.run(...)`` with ``import subprocess as sp``
+    resolves to ``subprocess.run``."""
+    name = dotted(node.func)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    origin = imports.get(head)
+    if origin is None:
+        return name
+    return f"{origin}.{rest}" if rest else origin
+
+
+# ---------------------------------------------------------------------------
+# TK8S101 — jaxcompat discipline
+# ---------------------------------------------------------------------------
+
+@register
+class JaxcompatDiscipline(Rule):
+    """``jax.experimental.shard_map`` and ``jax.experimental.pallas``
+    may be imported ONLY inside utils/jaxcompat.py.
+
+    History: on jax < 0.5 the old ``auto=`` shard_map spelling aborts
+    the whole process with a C++ crash (not a catchable exception), and
+    ``pltpu.CompilerParams`` does not exist (it is TPUCompilerParams).
+    utils/jaxcompat.py is the one adapter that translates; a raw import
+    anywhere else reintroduces the crash on exactly the environments CI
+    cannot reach.
+    """
+
+    code = "TK8S101"
+    name = "jaxcompat-discipline"
+    summary = ("jax.experimental.shard_map / pallas imports only inside "
+               "utils/jaxcompat.py")
+
+    GATED = ("jax.experimental.shard_map", "jax.experimental.pallas")
+
+    def _gated(self, module: str) -> bool:
+        return any(module == g or module.startswith(g + ".")
+                   for g in self.GATED)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path == JAXCOMPAT:
+            return ()
+        rule = self
+        out: List[Finding] = []
+
+        def report(node: ast.AST, name: str) -> None:
+            out.append(rule.finding(
+                ctx, node.lineno, node.col_offset,
+                f"{name} used outside utils/jaxcompat.py — route it "
+                f"through the jaxcompat adapter (raw use aborts the "
+                f"process on jax < 0.5)"))
+
+        class _Visitor(ast.NodeVisitor):
+            def visit_Import(self, n: ast.Import) -> None:
+                for a in n.names:
+                    if rule._gated(a.name):
+                        report(n, a.name)
+
+            def visit_ImportFrom(self, n: ast.ImportFrom) -> None:
+                if n.level != 0 or not n.module:
+                    return
+                if rule._gated(n.module):
+                    report(n, n.module)
+                elif n.module == "jax.experimental":
+                    for a in n.names:
+                        full = f"jax.experimental.{a.name}"
+                        if rule._gated(full):
+                            report(n, full)
+
+            def visit_Attribute(self, n: ast.Attribute) -> None:
+                # Report only the outermost chain: descending after a
+                # match would re-report every gated prefix of the same
+                # expression (jax.experimental.pallas.tpu would fire
+                # twice).
+                full = dotted(n)
+                if full and rule._gated(full):
+                    report(n, full)
+                    return
+                self.generic_visit(n)
+
+        _Visitor().visit(ctx.tree)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# TK8S102 — donation-aliasing attestation
+# ---------------------------------------------------------------------------
+
+@register
+class DonationAttestation(Rule):
+    """Every ``donate_argnums``/``donate_argnames`` site must carry a
+    ``# tk8s: donate-safe(<why>)`` attestation.
+
+    History (PR 8): on jax 0.4.37 CPU, ``device_put`` can zero-copy a
+    host numpy buffer; donating that host-aliased array corrupted
+    memory a few steps after every restore — NaN losses, then a
+    segfault. Donation is an aliasing contract the type system cannot
+    see; the attestation forces the author to state why the donated
+    buffer is device-owned and never read again.
+    """
+
+    code = "TK8S102"
+    name = "donate-attestation"
+    summary = "donate_argnums sites need a # tk8s: donate-safe(<why>)"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            kw = next((k for k in n.keywords
+                       if k.arg in ("donate_argnums", "donate_argnames")),
+                      None)
+            if kw is None:
+                continue
+            m = DONATE_SAFE_RE.search(ctx.block_comment_text(n))
+            if m is None:
+                yield self.finding(
+                    ctx, n.lineno, n.col_offset,
+                    "buffer donation without a '# tk8s: donate-safe(<why>)' "
+                    "attestation — state why the donated operand is "
+                    "device-owned and never read after this call "
+                    "(donating a host-aliased buffer corrupts memory on "
+                    "zero-copy backends)")
+            elif not m.group("why").strip():
+                yield self.finding(
+                    ctx, n.lineno, n.col_offset,
+                    "donate-safe attestation has an empty reason — say "
+                    "why the donated buffer cannot alias host memory")
+
+
+# ---------------------------------------------------------------------------
+# TK8S103 — lock discipline
+# ---------------------------------------------------------------------------
+
+@register
+class LockDiscipline(Rule):
+    """No sleeps, subprocess, or network I/O lexically inside a
+    ``with <...lock...>:`` block.
+
+    History: cloudsim's deterministic ``op_latency`` knob originally
+    slept while holding the simulator RLock, serializing the wavefront
+    it existed to measure; the fix ("sleeps outside the lock") is a
+    one-line ordering constraint nothing enforced. Scope matches where
+    locks guard hot shared state: executor/, serve/, manager/, and
+    utils/metrics.py.
+    """
+
+    code = "TK8S103"
+    name = "lock-discipline"
+    summary = "no sleep/subprocess/socket/HTTP under a held lock"
+
+    SCOPES = (f"{PKG}/executor/", f"{PKG}/serve/", f"{PKG}/manager/")
+    FILES = (f"{PKG}/utils/metrics.py",)
+    BLOCKING = ("time.sleep", "subprocess.", "socket.",
+                "urllib.request.", "http.client.", "requests.")
+
+    def _in_scope(self, path: str) -> bool:
+        return path.startswith(self.SCOPES) or path in self.FILES
+
+    def _is_lock(self, item: ast.withitem) -> bool:
+        expr = item.context_expr
+        # `with self._lock:` / `with lock:` / `with pool.state_lock:` —
+        # anything whose terminal name mentions "lock".
+        name = dotted(expr)
+        if name is None and isinstance(expr, ast.Call):
+            name = dotted(expr.func)
+        return bool(name) and "lock" in name.rsplit(".", 1)[-1].lower()
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not self._in_scope(ctx.path):
+            return
+        imports = import_map(ctx.tree)
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(self._is_lock(i) for i in n.items):
+                continue
+            for inner in ast.walk(ast.Module(body=n.body, type_ignores=[])):
+                if not isinstance(inner, ast.Call):
+                    continue
+                callee = resolve_call(inner, imports)
+                if callee and (callee in self.BLOCKING
+                               or callee.startswith(self.BLOCKING)):
+                    yield self.finding(
+                        ctx, inner.lineno, inner.col_offset,
+                        f"{callee} called while a lock is held — move the "
+                        f"blocking call outside the `with` block (it "
+                        f"serializes every thread contending this lock)")
+
+
+# ---------------------------------------------------------------------------
+# TK8S104 — pinned-constant agreement
+# ---------------------------------------------------------------------------
+
+@register
+class PinnedConstants(Rule):
+    """Port and exit-code constants duplicated across the jax boundary
+    must literal-match ``triton_kubernetes_tpu/constants.py`` (or import
+    from it) at every registered site.
+
+    History: COORDINATOR_PORT, SERVE_PORT, and exit 75 are deliberately
+    duplicated jax-free (rendering must not import the jax-loaded train
+    package) and were pinned equal only by individual tests — a new
+    duplication site silently escaped the convention.
+    """
+
+    code = "TK8S104"
+    name = "pinned-constants"
+    summary = ("cross-file port/exit-code duplication sites must match "
+               "constants.py")
+
+    CANONICAL = f"{PKG}/constants.py"
+    # canonical name -> [(site file, local name), ...]
+    SITES: Dict[str, List[Tuple[str, str]]] = {
+        "COORDINATOR_PORT": [
+            (f"{PKG}/topology/jobset.py", "COORDINATOR_PORT"),
+            (f"{PKG}/train/__main__.py", "COORDINATOR_PORT"),
+        ],
+        "SERVE_PORT": [
+            (f"{PKG}/serve/server.py", "SERVE_PORT"),
+            (f"{PKG}/topology/serving.py", "SERVE_PORT"),
+        ],
+        "EXIT_RESUME": [
+            (f"{PKG}/train/resilience.py", "EXIT_RESUME"),
+            (f"{PKG}/topology/jobset.py", "RESUME_EXIT_CODE"),
+        ],
+        "EXIT_UNSUPPORTED": [
+            (f"{PKG}/parallel/multihost.py", "EXIT_UNSUPPORTED"),
+        ],
+        "EXIT_CONFIG": [
+            (f"{PKG}/train/__main__.py", "EXIT_CONFIG"),
+        ],
+        "EXIT_ANOMALY": [
+            (f"{PKG}/train/__main__.py", "EXIT_ANOMALY"),
+        ],
+    }
+
+    @staticmethod
+    def _literal_assign(tree: ast.AST, name: str
+                        ) -> Optional[Tuple[object, int]]:
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if (isinstance(t, ast.Name) and t.id == name
+                            and isinstance(n.value, ast.Constant)):
+                        return n.value.value, n.lineno
+            elif (isinstance(n, ast.AnnAssign)
+                  and isinstance(n.target, ast.Name)
+                  and n.target.id == name
+                  and isinstance(n.value, ast.Constant)):
+                return n.value.value, n.lineno
+        return None
+
+    @staticmethod
+    def _imports_from_constants(tree: ast.AST, canonical: str,
+                                local: str) -> bool:
+        for n in ast.walk(tree):
+            if not isinstance(n, ast.ImportFrom):
+                continue
+            mod = n.module or ""
+            if not (mod == "constants" or mod.endswith(".constants")
+                    or mod == f"{PKG}.constants"):
+                continue
+            for a in n.names:
+                if a.name == canonical and (a.asname or a.name) == local:
+                    return True
+        return False
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        canon = project.file(self.CANONICAL)
+        if canon is None:
+            return
+        for name, sites in self.SITES.items():
+            got = self._literal_assign(canon.tree, name)
+            if got is None:
+                yield self.finding(
+                    self.CANONICAL, 1, 0,
+                    f"{name} missing from the canonical constants module")
+                continue
+            value, _ = got
+            for rel, local in sites:
+                site = project.file(rel)
+                if site is None:
+                    continue
+                if self._imports_from_constants(site.tree, name, local):
+                    continue
+                lit = self._literal_assign(site.tree, local)
+                if lit is None:
+                    yield self.finding(
+                        rel, 1, 0,
+                        f"{local} is a registered duplication site of "
+                        f"constants.{name} but neither assigns a literal "
+                        f"nor imports it from {PKG}.constants")
+                elif lit[0] != value:
+                    yield self.finding(
+                        rel, lit[1], 0,
+                        f"{local} = {lit[0]!r} drifted from "
+                        f"constants.{name} = {value!r} — the manifests "
+                        f"and the runtime now disagree")
+
+
+# ---------------------------------------------------------------------------
+# TK8S105 — metrics-catalog drift
+# ---------------------------------------------------------------------------
+
+@register
+class MetricsCatalogDrift(Rule):
+    """Every ``tk8s_*`` family used anywhere must be declared in
+    utils/metrics.py CATALOG, every CATALOG family must appear in
+    docs/guide/observability.md, and every family the docs name must
+    exist in CATALOG.
+
+    History: CATALOG is "the single source of truth that docs and the
+    ``tk8s metrics`` dump share" — but nothing checked it. A family
+    registered ad hoc is invisible to ``register_catalog()`` (so the
+    ``tk8s metrics`` zero-valued dump and Grafana discovery miss it) and
+    to the docs table operators read.
+    """
+
+    code = "TK8S105"
+    name = "metrics-catalog-drift"
+    summary = "tk8s_* families must agree across code, CATALOG, and docs"
+
+    CATALOG_FILE = f"{PKG}/utils/metrics.py"
+    DOCS_FILE = "docs/guide/observability.md"
+    FAMILY_RE = re.compile(r"tk8s_[a-z0-9_]*[a-z0-9]\*?")
+
+    def _catalog(self, ctx: FileContext) -> Optional[Dict[str, int]]:
+        for n in ast.walk(ctx.tree):
+            value = None
+            if (isinstance(n, ast.AnnAssign)
+                    and isinstance(n.target, ast.Name)
+                    and n.target.id == "CATALOG"):
+                value = n.value
+            elif isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "CATALOG"
+                    for t in n.targets):
+                value = n.value
+            if isinstance(value, ast.Dict):
+                return {k.value: k.lineno for k in value.keys
+                        if isinstance(k, ast.Constant)}
+        return None
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        cat_ctx = project.file(self.CATALOG_FILE)
+        if cat_ctx is None:
+            return
+        catalog = self._catalog(cat_ctx)
+        if catalog is None:
+            yield self.finding(self.CATALOG_FILE, 1, 0,
+                               "no CATALOG dict found in the metrics module")
+            return
+        # code -> CATALOG
+        for rel, ctx in list(project.files.items()):
+            if not rel.endswith(".py"):
+                continue
+            for n in ast.walk(ctx.tree):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("counter", "gauge", "histogram")
+                        and n.args
+                        and isinstance(n.args[0], ast.Constant)
+                        and isinstance(n.args[0].value, str)
+                        and n.args[0].value.startswith("tk8s_")):
+                    fam = n.args[0].value
+                    if fam not in catalog:
+                        yield self.finding(
+                            rel, n.lineno, n.col_offset,
+                            f"metric family {fam!r} is not declared in "
+                            f"utils/metrics.py CATALOG — add it there "
+                            f"(and to the observability docs table)")
+        docs = project.read_text(self.DOCS_FILE)
+        if docs is None:
+            return
+        # CATALOG -> docs
+        for fam, lineno in sorted(catalog.items()):
+            if fam not in docs:
+                yield self.finding(
+                    self.CATALOG_FILE, lineno, 0,
+                    f"CATALOG family {fam!r} is missing from "
+                    f"{self.DOCS_FILE} — document it in the metrics table")
+        # docs -> CATALOG (names ending in `*` or `_` are wildcard
+        # prose mentions like tk8s_train_*, not family names)
+        for m in self.FAMILY_RE.finditer(docs):
+            fam = m.group(0)
+            if fam.endswith("*"):
+                continue
+            if docs[m.end():m.end() + 2].startswith(("_*", "*")):
+                continue  # wildcard prose mention, e.g. tk8s_train_*
+            if fam not in catalog:
+                line = docs.count("\n", 0, m.start()) + 1
+                yield self.finding(
+                    self.DOCS_FILE, line, 0,
+                    f"docs name metric family {fam!r} which is not in "
+                    f"utils/metrics.py CATALOG — stale docs or a typo'd "
+                    f"family name")
+
+
+# ---------------------------------------------------------------------------
+# TK8S106 — typed-error discipline
+# ---------------------------------------------------------------------------
+
+@register
+class TypedErrors(Rule):
+    """No bare ``except:`` and no swallowed ``except Exception: pass``
+    in executor/, workflows/, train/.
+
+    History: the repo's error taxonomy (TransientApplyError vs
+    FatalApplyError, CheckpointIntegrityError.reason slugs, typed
+    workflow errors) exists so retry/fallback logic can classify — a
+    blanket swallow upstream turns a classifiable fault into silence.
+    Genuine best-effort paths (atexit, __del__) carry a suppression
+    with the reason spelled out.
+    """
+
+    code = "TK8S106"
+    name = "typed-errors"
+    summary = "no bare except / swallowed `except Exception: pass`"
+
+    SCOPES = (f"{PKG}/executor/", f"{PKG}/workflows/", f"{PKG}/train/")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.path.startswith(self.SCOPES):
+            return
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.ExceptHandler):
+                continue
+            if n.type is None:
+                yield self.finding(
+                    ctx, n.lineno, n.col_offset,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt "
+                    "too — catch a typed error (or at least Exception)")
+                continue
+            broad = (isinstance(n.type, ast.Name)
+                     and n.type.id in ("Exception", "BaseException"))
+            swallows = all(
+                isinstance(s, ast.Pass)
+                or (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and s.value.value is Ellipsis)
+                for s in n.body)
+            if broad and swallows:
+                yield self.finding(
+                    ctx, n.lineno, n.col_offset,
+                    f"`except {n.type.id}: pass` swallows every fault "
+                    f"unclassified — narrow the type, log it, or "
+                    f"suppress with the best-effort reason spelled out")
+
+
+# ---------------------------------------------------------------------------
+# TK8S107 — resume determinism
+# ---------------------------------------------------------------------------
+
+@register
+class ResumeDeterminism(Rule):
+    """No wall-clock or global-RNG calls in the journal/checkpoint
+    commit paths — time and randomness must come through the injectable
+    seams (``clock``/``sleep`` ctor args, seeded ``random.Random``).
+
+    History: the whole resume story — bitwise serial/parallel journal
+    parity, kill-mid-wave resume, rollback stream replay — holds only
+    because these paths are deterministic functions of their inputs. A
+    naked ``time.time()`` in a journal write is invisible until a
+    resume diff flakes in CI.
+    """
+
+    code = "TK8S107"
+    name = "resume-determinism"
+    summary = ("no naked time.time()/random.* in journal/checkpoint "
+               "commit paths")
+
+    FILES = (
+        f"{PKG}/executor/engine.py",
+        f"{PKG}/executor/cloudsim.py",
+        f"{PKG}/train/checkpoint.py",
+        f"{PKG}/train/resilience.py",
+        f"{PKG}/serve/engine.py",
+        f"{PKG}/serve/blocks.py",
+        f"{PKG}/state/document.py",
+    )
+    BANNED = {
+        "time.time", "time.time_ns", "datetime.datetime.now",
+        "datetime.datetime.utcnow", "datetime.date.today", "uuid.uuid4",
+        "random.random", "random.randint", "random.randrange",
+        "random.choice", "random.choices", "random.shuffle",
+        "random.sample", "random.uniform", "random.gauss",
+        "random.getrandbits", "random.seed",
+    }
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path not in self.FILES:
+            return
+        imports = import_map(ctx.tree)
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = resolve_call(n, imports)
+            if callee in self.BANNED:
+                yield self.finding(
+                    ctx, n.lineno, n.col_offset,
+                    f"{callee}() in a resume-critical path — inject a "
+                    f"clock/seeded RNG seam instead (ManualClock, the "
+                    f"`sleep`/`clock` ctor args, random.Random(seed)); "
+                    f"nondeterminism here breaks bitwise resume parity")
+
+
+# ---------------------------------------------------------------------------
+# TK8S108 — CLI/docs drift
+# ---------------------------------------------------------------------------
+
+@register
+class CliDocsDrift(Rule):
+    """Every ``--flag`` the user-facing entrypoints register must be
+    documented somewhere under docs/.
+
+    History: the trainer grew ~35 flags across five PRs; the guide
+    pages (performance.md, workloads.md, serving.md) documented them by
+    convention only, and several (--learning-rate, --dry-run, --stage)
+    had silently never made it into any doc.
+    """
+
+    code = "TK8S108"
+    name = "cli-docs-drift"
+    summary = "every registered --flag must appear in docs/"
+
+    CLI_FILES = (f"{PKG}/cli/main.py", f"{PKG}/train/__main__.py")
+
+    def _docs_corpus(self, project: Project) -> Optional[str]:
+        docs_dir = project.root / "docs"
+        if not docs_dir.is_dir():
+            return None
+        parts = []
+        for p in sorted(docs_dir.rglob("*.md")):
+            parts.append(p.read_text(encoding="utf-8"))
+        readme = project.root / "README.md"
+        if readme.is_file():
+            parts.append(readme.read_text(encoding="utf-8"))
+        return "\n".join(parts)
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        corpus = self._docs_corpus(project)
+        if corpus is None:
+            return
+        for rel in self.CLI_FILES:
+            ctx = project.file(rel)
+            if ctx is None:
+                continue
+            for n in ast.walk(ctx.tree):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "add_argument"):
+                    continue
+                for a in n.args:
+                    if (isinstance(a, ast.Constant)
+                            and isinstance(a.value, str)
+                            and a.value.startswith("--")
+                            and a.value not in corpus):
+                        yield self.finding(
+                            ctx, n.lineno, n.col_offset,
+                            f"flag {a.value} is not documented anywhere "
+                            f"under docs/ — add it to the relevant guide "
+                            f"page")

@@ -201,6 +201,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="dump the in-process metrics registry (Prometheus text; "
              "--json for the snapshot)")
 
+    lint = sub.add_parser(
+        "lint",
+        help="run the repo-native TK8S1xx static invariant checkers "
+             "(docs/guide/static-analysis.md); exits 1 on findings")
+    lint.add_argument("--format", choices=["human", "json"],
+                      default="human", dest="lint_format",
+                      help="report format (default: human; json is the "
+                           "CI evidence document)")
+    lint.add_argument("--root", default=".", metavar="DIR",
+                      help="repo root to lint (default: current "
+                           "directory)")
+    lint.add_argument("--list-rules", action="store_true",
+                      help="print the active rule catalog and exit")
+    lint.add_argument("paths", nargs="*", metavar="PATH",
+                      help="restrict the per-file scan to these "
+                           "root-relative files/dirs (cross-file rules "
+                           "still read their pinned sites)")
+
     serve = sub.add_parser(
         "serve",
         help="run the TPU-native inference endpoint: continuous batching "
@@ -275,6 +293,27 @@ def main(argv: Optional[List[str]] = None,
             # this command opens no spans.
             trace.write(args.trace_out)
         return 0
+
+    if args.command == "lint":
+        # Pure stdlib-ast tree walk: needs no backend, no config, no jax.
+        from ..analysis import RULES, lint_project, render_human, render_json
+
+        if args.list_rules:
+            for r in sorted(RULES, key=lambda r: r.code):
+                print(f"{r.code}  {r.name}: {r.summary}")
+            if trace is not None:
+                # Honor the global contract: a --trace-out file always
+                # lands, even from a command that opens no spans.
+                trace.write(args.trace_out)
+            return 0
+        findings, stats = lint_project(args.root, paths=args.paths or None)
+        if args.lint_format == "json":
+            print(render_json(findings, stats))
+        else:
+            print(render_human(findings, stats))
+        if trace is not None:
+            trace.write(args.trace_out)
+        return 1 if findings else 0
 
     if args.command == "serve":
         # Workload-stack imports stay lazy: the provisioning verbs must

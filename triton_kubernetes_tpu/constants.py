@@ -1,0 +1,51 @@
+"""Cross-layer pinned constants: ports and process exit codes.
+
+These values cross the jax boundary: the rendering layer (``topology/``)
+bakes them into Kubernetes manifests while the workload stack
+(``train/``, ``serve/``, ``parallel/``) returns or listens on them at
+runtime. The two sides must never import each other (rendering stays
+importable on jax-less machines; the trainer never pulls the rendering
+layer), so for eight PRs each value was *duplicated* at every use site
+and pinned equal only by test convention (tests/test_topology.py,
+tests/test_multihost.py).
+
+This module is the single source of truth: it imports nothing, so every
+layer can import it. Sites either import from here or keep a local
+literal — in both cases ``tk8s lint`` rule TK8S104 enforces agreement
+with this module at every registered duplication site, cross-file, at
+lint time (docs/guide/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+# The jax.distributed coordinator port every worker dials (worker 0
+# listens); rendered into the JobSet headless Service and container
+# ports (topology/jobset.py), parsed by the trainer
+# (train/__main__.py).
+COORDINATOR_PORT = 8476
+
+# The serving endpoint port: rendered into the Deployment/Service
+# (topology/serving.py), bound by serve/server.py.
+SERVE_PORT = 8000
+
+# Process exit codes — bounded and machine-readable so launchers, the
+# JobSet podFailurePolicy, and CI classify terminations without parsing
+# logs:
+#
+# EXIT_CONFIG       (2)  bad/unsupported invocation: malformed CLI args
+#                        or JobSet-injected distributed env
+#                        (train/__main__.py).
+# EXIT_ANOMALY      (4)  the loss-anomaly guard gave up after
+#                        max_rollbacks consecutive trips
+#                        (train/resilience.py AnomalyAbortedError).
+# EXIT_UNSUPPORTED  (69) EX_UNAVAILABLE: the environment cannot host
+#                        this run (no multi-host jax support) — a loud
+#                        skip, never a failure (parallel/multihost.py).
+# EXIT_RESUME       (75) EX_TEMPFAIL: "resume me" — a preemption-warned
+#                        trainer saved an emergency checkpoint; the
+#                        podFailurePolicy restarts it with --resume
+#                        (train/resilience.py, topology/jobset.py).
+EXIT_CONFIG = 2
+EXIT_ANOMALY = 4
+EXIT_UNSUPPORTED = 69
+EXIT_RESUME = 75

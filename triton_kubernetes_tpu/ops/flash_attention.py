@@ -36,15 +36,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-# jax < 0.5 spells it TPUCompilerParams; the fields used here (only
-# dimension_semantics) are identical. Without this shim every kernel —
-# including interpret mode, which is how the CPU parity suite runs —
-# dies at trace time on older jax.
-_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
-    pltpu, "TPUCompilerParams")
+from ..utils.jaxcompat import pallas_tpu
+
+pl, pltpu, _CompilerParams = pallas_tpu()
 
 NEG_INF = -1e30
 

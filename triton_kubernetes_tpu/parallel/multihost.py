@@ -54,8 +54,9 @@ from .mesh import (
 # The trainer's "environment cannot host this run" exit code
 # (EX_UNAVAILABLE): distinct from config errors (2), anomaly aborts (4)
 # and resume-me (75) so launchers and CI classify a skipped harness as a
-# skip, never as a failure or a retry.
-EXIT_UNSUPPORTED = 69
+# skip, never as a failure or a retry. Single-sourced from constants.py
+# (lint rule TK8S104).
+from ..constants import EXIT_UNSUPPORTED
 
 # Reason slugs for MultiHostUnavailable — bounded, machine-readable, the
 # same contract as CheckpointIntegrityError.reason.
@@ -281,6 +282,10 @@ def make_fused_dcn_step(config: Any, mesh: "jax.sharding.Mesh",
         body, mesh=mesh,
         in_specs=(P(), {"tokens": batch_spec()}),
         out_specs=(P(), P()), check_vma=False)
+    # tk8s: donate-safe(restore re-places leaves with an explicit device
+    # copy before the loop — the PR 8 zero-copy device_put corruption fix
+    # — so the donated TrainState never aliases host numpy; callers
+    # always rebind the returned state)
     return jax.jit(step, donate_argnums=(0,))
 
 

@@ -173,11 +173,17 @@ class ServeEngine:
         # input buffers instead of copying the whole pool every token
         # (self.cache is unconditionally replaced by the result, so the
         # consumed operands are never read again).
+        # tk8s: donate-safe(k/v come from init_paged_cache's device
+        # zeros — distinct buffers, never host-aliased — and self.cache
+        # is rebound to the jit result every call, so the donated pool
+        # is dead on return)
         self._prefill = jax.jit(
             lambda p, toks, length, k, v, table: paged_prefill(
                 p, toks, length, cfg,
                 _cache_like(self.cache, k, v), table),
             donate_argnums=(3, 4))
+        # tk8s: donate-safe(same pool-ownership contract as _prefill:
+        # device-allocated k/v, rebound from the result each decode step)
         self._decode = jax.jit(
             lambda p, tok, k, v, bt, lens: paged_decode_step(
                 p, tok, cfg, _cache_like(self.cache, k, v), bt, lens),

@@ -42,7 +42,9 @@ from .engine import FinishedRequest, Request, ServeEngine
 
 # Default port for rendered manifests and the CLI (the serving analog of
 # the manager's API port; /metrics rides the same listener).
-SERVE_PORT = 8000
+# Single-sourced from constants.py; topology/serving.py renders the same
+# value (lint rule TK8S104 keeps every site agreeing).
+from ..constants import SERVE_PORT
 
 _ROUTES = ("/healthz", "/metrics", "/stats", "/generate")
 

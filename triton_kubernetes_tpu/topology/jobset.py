@@ -17,14 +17,13 @@ from typing import Any, Dict, List, Optional
 from .labels import selector_for_slice
 from .slices import SliceSpec
 
-COORDINATOR_PORT = 8476
-
-# The trainer's "resume me" exit code (train/resilience.py EXIT_RESUME,
-# duplicated here so rendering never imports the jax-loaded train package;
-# pinned equal in tests/test_topology.py). A preemption-warned worker
-# saves an emergency checkpoint and exits with this code; the Job's
-# podFailurePolicy recreates the pod instead of failing the job.
-RESUME_EXIT_CODE = 75
+# Single-sourced from the dependency-free constants module (rendering
+# still never imports the jax-loaded train package). A preemption-warned
+# worker saves an emergency checkpoint and exits RESUME_EXIT_CODE; the
+# Job's podFailurePolicy recreates the pod instead of failing the job.
+# Lint rule TK8S104 re-checks every duplication site cross-file.
+from ..constants import COORDINATOR_PORT
+from ..constants import EXIT_RESUME as RESUME_EXIT_CODE
 
 
 def render_headless_service(name: str, namespace: str = "default") -> Dict[str, Any]:

@@ -23,8 +23,9 @@ from typing import Any, Dict, List, Optional
 from .labels import selector_for_slice
 from .slices import SliceSpec
 
-# serve.server.SERVE_PORT duplicate (see module docstring).
-SERVE_PORT = 8000
+# Single-sourced with serve.server.SERVE_PORT from the dependency-free
+# constants module (see module docstring; lint rule TK8S104).
+from ..constants import SERVE_PORT
 
 APP_LABEL = "serve.tk8s.io/name"
 MODEL_LABEL = "serve.tk8s.io/model"

@@ -39,11 +39,11 @@ from dataclasses import dataclass
 from typing import Mapping, Optional
 
 # The jax.distributed coordinator port every worker dials (worker 0
-# listens). Duplicated jax-free from topology/jobset.py COORDINATOR_PORT
-# (the same duplication-pinned pattern as SERVE_PORT: rendering must not
-# import the jax-loaded train package, the trainer must not import the
-# rendering layer at runtime); pinned equal in tests/test_multihost.py.
-COORDINATOR_PORT = 8476
+# listens), plus the config/anomaly exit codes — all single-sourced from
+# the dependency-free constants module (the rendering layer imports the
+# same values, so manifests and runtime cannot drift; lint rule TK8S104
+# re-checks every duplication site cross-file).
+from ..constants import COORDINATOR_PORT, EXIT_ANOMALY, EXIT_CONFIG
 
 
 class DistributedEnvError(ValueError):
@@ -306,10 +306,14 @@ def _distributed_shutdown(n_processes: int) -> None:
 
     try:
         barrier("tk8s-exit")
+    # tk8s-lint: disable=TK8S106(a peer crashed mid-barrier: exiting
+    # loudly with our own rc is all that is left to do)
     except Exception:
-        pass  # a peer crashed: exiting loudly is all that is left
+        pass
     try:
         jax.distributed.shutdown()
+    # tk8s-lint: disable=TK8S106(shutdown after a dead coordinator
+    # raises; the process is exiting either way and rc is already set)
     except Exception:
         pass
 
@@ -345,7 +349,7 @@ def main(argv=None) -> int:
         _maybe_init_distributed(args.distributed, log)
     except DistributedEnvError as e:
         log.log("error", "malformed distributed environment", error=str(e))
-        return 2
+        return EXIT_CONFIG
     except Exception as e:
         from ..parallel.multihost import EXIT_UNSUPPORTED, MultiHostUnavailable
 
@@ -423,7 +427,7 @@ def main(argv=None) -> int:
             log.log("error", "hybrid mesh placement rejected",
                     error=str(e))
             _distributed_shutdown(n_processes)
-            return 2
+            return EXIT_CONFIG
     else:
         mesh = create_mesh(mesh_cfg)
     n_devices = mesh.size
@@ -438,7 +442,7 @@ def main(argv=None) -> int:
         log.log("error", "global batch must divide the data*fsdp axes",
                 batch=batch_size, shards=batch_shards)
         _distributed_shutdown(n_processes)
-        return 2
+        return EXIT_CONFIG
     stages = mesh.shape["stage"]
     if stages > 1:
         # The per-stage kernel shard_maps split each microbatch over
@@ -451,7 +455,7 @@ def main(argv=None) -> int:
                     "under pipeline stages",
                     batch=batch_size, microbatches=m, shards=batch_shards)
             _distributed_shutdown(n_processes)
-            return 2
+            return EXIT_CONFIG
 
     attention_fn = None
     if args.ring_attention and mesh.shape["seq"] == 1:
@@ -498,7 +502,7 @@ def main(argv=None) -> int:
                     "fused DCN sync unavailable: " + "; ".join(blockers),
                     mesh=describe_mesh(mesh))
             _distributed_shutdown(n_processes)
-            return 2
+            return EXIT_CONFIG
     if dcn_sync == "fused":
         step_fn = make_fused_dcn_step(config, mesh, opt)
     else:
@@ -817,6 +821,9 @@ def main(argv=None) -> int:
             # trace (or mask the original exception).
             try:
                 jax.block_until_ready(state.params)
+            # tk8s-lint: disable=TK8S106(the sync re-raises a failed
+            # computation; that must not cost the trace or mask the
+            # original exception unwinding through this finally)
             except Exception:
                 pass
             jax.profiler.stop_trace()
@@ -835,7 +842,7 @@ def main(argv=None) -> int:
         log.log("info", "trainer done", final_loss=final_loss,
                 outcome="anomaly-abort")
         _distributed_shutdown(n_processes)
-        return 4
+        return EXIT_ANOMALY
     if report is not None and report.interrupted:
         # Preemption warning honored: the emergency checkpoint (manifest-
         # committed) is on disk; exit with the resume code so the JobSet

@@ -260,6 +260,8 @@ class ShardedTokenPipeline:
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
+        # tk8s-lint: disable=TK8S106(GC-time close: the interpreter may
+        # be tearing down, raising here would mask the real exit path)
         except Exception:
             pass
 

@@ -55,7 +55,8 @@ from .pipeline import run_pipelined
 # restart policy (topology/jobset.py podFailurePolicy) treats it as
 # retryable — a preempted trainer restarts with --resume, a genuinely
 # failed one (any other nonzero code) does not loop forever.
-EXIT_RESUME = 75
+# Single-sourced from constants.py (lint rule TK8S104).
+from ..constants import EXIT_RESUME
 
 
 class AnomalyAbortedError(RuntimeError):

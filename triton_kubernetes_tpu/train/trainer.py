@@ -303,6 +303,10 @@ def make_train_step(
         return TrainState(step=state.step + 1, params=new_params,
                           opt_state=new_opt), metrics
 
+    # tk8s: donate-safe(state is device-owned — built by jitted init or
+    # an orbax restore, never a zero-copy device_put of host numpy — and
+    # every caller rebinds the returned TrainState, so the donated
+    # buffers are dead after the step)
     return jax.jit(step, donate_argnums=(0,))
 
 
