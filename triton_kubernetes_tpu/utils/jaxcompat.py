@@ -19,6 +19,27 @@ from typing import Any, Callable, Optional
 import jax
 
 
+def pallas_tpu() -> tuple:
+    """The Pallas namespaces under their modern spellings:
+    ``(pl, pltpu, CompilerParams)``.
+
+    jax < 0.5 spells the compiler-params class ``TPUCompilerParams``;
+    the fields the kernels use (only ``dimension_semantics``) are
+    identical. Without this shim every kernel — including interpret
+    mode, which is how the CPU parity suite runs — dies at trace time
+    on older jax. This is the ONLY place jax.experimental.pallas may be
+    imported (lint rule TK8S101); kernels unpack it at module import::
+
+        pl, pltpu, CompilerParams = pallas_tpu()
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    compiler_params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return pl, pltpu, compiler_params
+
+
 def axis_size(axis_name: Any) -> int:
     """``jax.lax.axis_size`` (jax >= 0.5), or the classic pmap-era
     ``psum(1, axis)`` — which constant-folds to a static int inside a
