@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Produce the chaos-harness evidence artifact.
+
+Two halves, both deterministic:
+
+1. **The sweep** — >= 200 generated scenarios (the `default` + `tpu`
+   profiles together cover every provider family and parallelism
+   1/2/8) through the full invariant suite. The gate: every scenario
+   passes every pinned invariant. The summary (per-invariant check
+   counts, provider/parallelism coverage, simulated mutation-clock
+   seconds) is the artifact.
+2. **The forced shrink** — a known-bad seed (the committed
+   `unfaulted-reference` mutation, the pre-PR1 bug class) must be
+   *caught*, then shrunk to a minimal spec of <= 3 modules and <= 2
+   fault rules that replays deterministically — proving the
+   catch -> shrink -> corpus pipeline end to end, not just the happy
+   path. The shrunk spec is included in the artifact and must match the
+   committed corpus entry's verdict.
+
+Usage: python scripts/ci/chaos_evidence.py [tag] [--runs N]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+from triton_kubernetes_tpu.chaos import (  # noqa: E402
+    generate_spec, load_entries, run_scenario, run_sweep, scenario_seed,
+    shrink_spec)
+from triton_kubernetes_tpu.chaos.corpus import CORPUS_DIR  # noqa: E402
+from triton_kubernetes_tpu.chaos.shrink import spec_size  # noqa: E402
+from triton_kubernetes_tpu.utils import metrics  # noqa: E402
+
+SWEEP_SEED = 20260804
+MUTATION_SEED = 3  # the committed mutation-unfaulted-reference ancestor
+
+
+def _coverage(seed: int, runs: int, profile: str) -> dict:
+    providers, widths = set(), set()
+    for i in range(runs):
+        # Same derivation the sweep itself uses (chaos.scenario_seed):
+        # the coverage block must describe the scenarios actually run.
+        spec = generate_spec(scenario_seed(seed, i), profile)
+        widths.add(spec["parallelism"])
+        providers.add(spec["topology"]["manager"]["provider"])
+        for cl in spec["topology"]["clusters"]:
+            providers.add(cl["provider"])
+    return {"providers": sorted(providers), "parallelism": sorted(widths)}
+
+
+def main(argv):
+    args = list(argv[1:])
+    runs = 200
+    if "--runs" in args:
+        i = args.index("--runs")
+        if i + 1 >= len(args):
+            print("error: --runs needs a value", file=sys.stderr)
+            return 2
+        runs = int(args[i + 1])
+        del args[i:i + 2]
+    # Flags consumed above; whatever remains is the tag (sibling evidence
+    # scripts are tag-only, so the tag must not swallow a flag).
+    tag = args[0] if args else "local"
+    repo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, os.pardir)
+    out_path = os.path.normpath(os.path.join(
+        repo, "docs", "ci-evidence", f"chaos-{tag}.json"))
+
+    # --- half 1: the seeded sweep across profiles.
+    per_profile = {"default": (runs * 3) // 4, "tpu": runs - (runs * 3) // 4}
+    reports = {}
+    coverage = {}
+    for profile, n in per_profile.items():
+        reports[profile] = run_sweep(seed=SWEEP_SEED, runs=n,
+                                     profile=profile, shrink=False)
+        coverage[profile] = _coverage(SWEEP_SEED, n, profile)
+    total = sum(r.runs for r in reports.values())
+    failed = sum(r.failed for r in reports.values())
+    all_providers = sorted(set().union(*(c["providers"]
+                                         for c in coverage.values())))
+    all_widths = sorted(set().union(*(c["parallelism"]
+                                      for c in coverage.values())))
+
+    # --- half 2: the forced shrink on a known-bad seed.
+    bad = generate_spec(MUTATION_SEED, "default")
+    bad["mutation"] = "unfaulted-reference"
+    caught = run_scenario(bad, ns="evidence-mutation")
+    assert not caught.passed, \
+        "mutation test NOT caught: the parity checker has rotted"
+    mini, mini_result = shrink_spec(bad, caught)
+    mods, rules = spec_size(mini)
+    assert mods <= 3 and rules <= 2, \
+        f"shrink did not reach the minimal-spec bar: {mods} modules, " \
+        f"{rules} rules"
+    assert mini_result.violated("parity")
+    # The committed corpus entry for this mutation must replay too.
+    corpus_dir = os.path.normpath(os.path.join(repo, CORPUS_DIR))
+    committed = dict(load_entries(corpus_dir)).values()
+    mutation_entries = [e for e in committed
+                        if e["name"].startswith("mutation-")]
+    assert mutation_entries, "no committed mutation corpus entry"
+    for entry in mutation_entries:
+        replayed = run_scenario(entry["spec"], ns="evidence-replay")
+        assert replayed.violated(entry["invariant"]), entry["name"]
+
+    checks = metrics.get_registry().snapshot().get(
+        "tk8s_chaos_invariant_checks_total")
+
+    evidence = {
+        "tag": tag,
+        "sweep": {
+            "seed": SWEEP_SEED,
+            "scenarios": total,
+            "passed": total - failed,
+            "failed": failed,
+            "profiles": {p: r.to_dict() for p, r in reports.items()},
+            "coverage": {"providers": all_providers,
+                         "parallelism": all_widths},
+            "simulated_seconds": round(sum(
+                r.simulated_seconds for r in reports.values()), 3),
+        },
+        "forced_shrink": {
+            "seed": MUTATION_SEED,
+            "mutation": "unfaulted-reference",
+            "caught_invariants": sorted({v["invariant"]
+                                         for v in caught.violations}),
+            "shrunk_spec": mini,
+            "shrunk_size": {"modules": mods, "rules": rules},
+            "committed_entries_replayed": [e["name"]
+                                           for e in mutation_entries],
+        },
+        "invariant_check_counters": checks,
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(evidence, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    if failed:
+        for profile, r in reports.items():
+            for res in r.results:
+                print(f"FAIL [{profile}] seed {res.spec['seed']}: "
+                      f"{res.violations}")
+        print(f"wrote {out_path}")
+        return 1
+    print(f"wrote {out_path} ({total} scenarios passed across "
+          f"providers={all_providers} parallelism={all_widths}; "
+          f"forced shrink -> {mods} modules / {rules} rules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

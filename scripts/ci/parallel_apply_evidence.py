@@ -79,15 +79,12 @@ def build_doc(arm: str) -> StateDocument:
 
 
 def fingerprint(doc: StateDocument) -> str:
-    """Canonical bytes of everything the parity contract covers; timings
-    are excluded (they are the variable under test)."""
-    est = load_executor_state(doc)
-    j = est.journal
-    return json.dumps(
-        {"modules": est.modules, "cloud": est.cloud, "serial": est.serial,
-         "journal": {k: j[k] for k in ("kind", "order", "wave", "waves",
-                                       "completed", "retries", "status")}},
-        sort_keys=True)
+    """The engine's canonical parity bytes — one fingerprint for tests,
+    the chaos harness, and this artifact; timings are excluded (they are
+    the variable under test)."""
+    from triton_kubernetes_tpu.executor.engine import state_fingerprint
+
+    return state_fingerprint(doc)
 
 
 def run_arm(arm: str, parallelism: int):

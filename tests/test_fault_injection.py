@@ -205,6 +205,84 @@ def test_graceful_warning_state_roundtrip_does_not_rewarn():
     assert list(revived.preempted_slices()) == ["ml-pool0"]
 
 
+# ----------------------------------------------------- fault-plan validation
+
+def test_fault_plan_rejects_malformed_rules_with_typed_errors():
+    """Construction-time validation (PR 10 hardening): every malformed
+    rule shape raises the same typed FaultPlanError naming the rule —
+    a generated or typo'd plan must fail before the first op, never
+    silently fire nothing."""
+    from triton_kubernetes_tpu.executor.cloudsim import FaultPlanError
+
+    cases = [
+        ({"op": "creat_resource"}, "unknown op"),
+        ({"op": ""}, "must name its 'op'"),
+        ({"nop": "create_resource"}, "must name its 'op'"),
+        ("create_resource", "must be a mapping"),
+        ({"op": "create_resource", "kind": "retriable"}, "unknown kind"),
+        ({"op": "create_resource", "times": 0}, "'times' must be >= 1"),
+        ({"op": "create_resource", "times": "two"}, "must be an integer"),
+        ({"op": "create_resource", "match": "x"}, "'match' must be a"),
+        ({"op": "create_resource", "slice_id": "s"}, "unknown rule keys"),
+        ({"op": "create_resource", "mode": "graceful-warning"},
+         "unknown rule keys"),
+        ({"op": "preempt"}, "must name their 'slice_id'"),
+        ({"op": "preempt", "slice_id": ""}, "must name their 'slice_id'"),
+        ({"op": "preempt", "slice_id": "s", "mode": "gracefull"},
+         "unknown preempt mode"),
+        ({"op": "preempt", "slice_id": "s", "grace_ops": "3"},
+         "must be an integer"),
+        ({"op": "preempt", "slice_id": "s", "slice": "typo"},
+         "unknown preempt-rule keys"),
+        ({"op": "preempt", "slice_id": "s", "kind": "bogus"},
+         "unknown kind"),
+        ({"op": "preempt", "slice_id": "s", "at_op": -5},
+         "must be >= 0"),
+        ({"op": "*", "module": "m", "at_module_op": 0},
+         "must be >= 1"),
+        ({"op": "*", "at_module_op": 2}, "must name its module"),
+    ]
+    for rule, match in cases:
+        with pytest.raises(FaultPlanError, match=match):
+            FaultPlan({"faults": [rule]})
+    # FaultPlanError IS a ValueError: existing except ValueError paths
+    # (drivers, config validation) keep catching it.
+    assert issubclass(FaultPlanError, ValueError)
+
+
+def test_fault_plan_round_trips_every_rule_shape():
+    """to_dict -> FaultPlan -> to_dict is the identity for every rule
+    shape, including live mid-state (fired counts, graceful 'warned'
+    flags) — the property the executor-state round-trip rests on."""
+    spec = {"faults": [
+        {"op": "create_resource", "match": {"name": "w-1"}, "times": 2,
+         "error": "boot failed"},
+        {"op": "register_node", "times": 1, "kind": "transient",
+         "error": "503"},
+        {"op": "create_node_pool", "match": {"pool": "huge"},
+         "kind": "fatal", "error": "quota exceeded"},
+        {"op": "*", "module": "node_gcp_ml_w1", "at_module_op": 2},
+        {"op": "preempt", "slice_id": "ml-pool0", "at_op": 7},
+        {"op": "preempt", "slice_id": "ml-pool0", "module": "job_ml_j0",
+         "at_module_op": 1},
+        {"op": "preempt", "slice_id": "ml-pool1", "at_op": 3,
+         "mode": "graceful-warning", "notify_pid": 0,
+         "signal": "SIGTERM", "grace_ops": 2},
+    ]}
+    plan = FaultPlan(spec)
+    d1 = plan.to_dict()
+    d2 = FaultPlan(d1).to_dict()
+    assert d1 == d2
+    # Mid-state: fire the boot flake once and warn the graceful rule;
+    # the revived plan continues, it does not restart.
+    plan.rules[0]["fired"] = 1
+    plan.rules[6]["warned"] = 1
+    revived = FaultPlan(plan.to_dict())
+    assert revived.to_dict() == plan.to_dict()
+    assert revived.rules[0]["fired"] == 1
+    assert revived.rules[6]["warned"] == 1
+
+
 # ------------------------------------------------------------- engine retry
 
 def test_engine_retries_boot_fault_with_backoff():
@@ -461,6 +539,7 @@ def test_repair_slice_requires_a_preempted_slice():
 
 # ------------------------------------------------- the full loop, end to end
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_preemption_repair_resume_end_to_end(tmp_path, cpu_mesh_devices):
     """The acceptance loop, deterministically: a fault plan 5xxes the pool
     creation (engine retries with injected-sleeper backoff and journals),

@@ -40,6 +40,7 @@ def _greedy_reference(params, cfg, prompt, n):
     return jnp.stack(out, axis=1)  # [B, n]
 
 
+@pytest.mark.slow  # budget pass (PR 10): tier-1 decode parity rides the paged-attention llama arm, whose reference IS this contiguous path
 @pytest.mark.parametrize("name,over", [
     ("llama-test", {}),
     # MoE decode-consistency needs dropless routing: capacity_factor =

@@ -41,10 +41,10 @@ def hits(findings, code):
 
 # ---------------------------------------------------------------- registry
 
-def test_at_least_eight_active_rules():
+def test_at_least_nine_active_rules():
     codes = {r.code for r in RULES}
-    assert len(codes) >= 8
-    assert codes == {f"TK8S10{i}" for i in range(1, 9)}
+    assert len(codes) >= 9
+    assert codes == {f"TK8S10{i}" for i in range(1, 10)}
 
 
 # ----------------------------------------------------------- TK8S101
@@ -330,6 +330,36 @@ def test_tk8s108_undocumented_flag(tmp_path):
     findings, _ = lint_project(root)
     assert hits(findings, "TK8S108") == [
         ("triton_kubernetes_tpu/cli/main.py", 3)]
+
+
+# ----------------------------------------------------------- TK8S109
+
+def test_tk8s109_invalid_corpus_entry(tmp_path):
+    import json
+
+    good = {"version": 1, "kind": "tk8s-chaos-corpus", "name": "ok-entry",
+            "expect": "pass",
+            "spec": {"seed": 1, "parallelism": 1, "faults": [],
+                     "topology": {"manager": {"provider": "bare-metal"}}}}
+    root = make_tree(tmp_path, {
+        "tests/chaos_corpus/ok-entry.json": json.dumps(good),
+        "tests/chaos_corpus/broken.json": "{not json",
+        "tests/chaos_corpus/drifted.json": json.dumps(
+            dict(good, name="drifted", expect="violated")),
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S109")
+    assert ("tests/chaos_corpus/broken.json", 1) in got
+    assert any(p == "tests/chaos_corpus/drifted.json" for p, _ in got)
+    assert not any(p.endswith("ok-entry.json") for p, _ in got)
+
+
+def test_tk8s109_absent_corpus_dir_is_clean(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/x.py": "x = 1\n",
+    })
+    findings, _ = lint_project(root)
+    assert hits(findings, "TK8S109") == []
 
 
 # ------------------------------------------------- suppression round trip

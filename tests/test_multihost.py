@@ -210,6 +210,7 @@ def test_fused_dcn_needs_pure_data_parallel(cpu_mesh_devices):
                            decay_steps=10))
 
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_fused_dcn_step_matches_xla_step(cpu_mesh_devices):
     """The one-all-reduce DDP step must track the GSPMD-partitioned step
     on the same pure data-parallel mesh — same batch split, same
@@ -338,6 +339,7 @@ def test_worker_env_matches_jobset_contract():
     assert (d.process_id, d.num_processes) == (1, 4)
 
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_launch_trainers_two_process_data_parallel(tmp_path):
     """The real trainer as two local jax.distributed workers: hybrid
     data=2 mesh, fused DCN sync, rank-tagged logs, one coordinated
@@ -368,6 +370,7 @@ def test_launch_trainers_two_process_data_parallel(tmp_path):
         assert f"process={w.process_id}" in body or w.process_id == 0
 
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_launch_trainers_fail_fast_on_early_worker_death(tmp_path):
     """A worker that dies at startup (injected via TK8S_TEST_CRASH_RANK)
     must reap the whole fleet in seconds — the survivor is blocked in

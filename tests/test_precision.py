@@ -89,6 +89,7 @@ def test_grads_all_finite_flags_nan():
 
 # ------------------------------------------- bf16 vs f32 training contracts
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_bf16_loss_trajectory_tracks_f32(cpu_mesh_devices):
     """The tentpole numerics contract: bf16 compute over f32 master state
     follows the f32 loss trajectory within a pinned tolerance (measured
@@ -158,6 +159,7 @@ def test_make_train_step_precision_param(cpu_mesh_devices):
 
 # --------------------------------------------------- remat policy contracts
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_remat_policy_does_not_change_the_math(cpu_mesh_devices):
     """Rematerialization trades FLOPs for memory and must move NOTHING
     else: every policy's first-step loss and grad norm match the

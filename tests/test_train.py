@@ -329,7 +329,11 @@ def test_flash_forfeit_is_loud(cpu_mesh_devices, monkeypatch):
 @pytest.mark.parametrize(
     "dtype,loss_rtol,gn_rtol,p_rtol,p_atol",
     [("float32", 1e-5, 1e-4, 5e-4, 5e-6),
-     ("bfloat16", 1e-4, 2e-2, 2e-2, 2e-3)])
+     # Second full compile of the same contract at a different dtype:
+     # slow lane (PR 10 budget pass); CI's precision evidence covers
+     # bf16 end-to-end every push.
+     pytest.param("bfloat16", 1e-4, 2e-2, 2e-2, 2e-3,
+                  marks=pytest.mark.slow)])
 def test_fused_ce_matches_logits_path(cpu_mesh_devices, dtype, loss_rtol,
                                       gn_rtol, p_rtol, p_atol):
     """config.fused_ce computes the identical loss and step without ever
@@ -414,6 +418,7 @@ def test_fused_ce_rejects_bad_chunk():
                             jnp.zeros((4,), jnp.int32), 0)
 
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_checkpoint_elastic_reshard_across_meshes(tmp_path, cpu_mesh_devices):
     """Elastic recovery (SURVEY.md §5): a checkpoint written under one mesh
     restores onto a DIFFERENT mesh shape — orbax lands each shard per the

@@ -79,6 +79,7 @@ def test_checkpoint_cadence_not_quantized_by_sync_window(
     assert saves == [2, 4]
 
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_profile_dir_traces_single_window_run(cpu_mesh_devices, tmp_path,
                                               capsys):
     """A run that fits in one sync window still produces a trace (the
@@ -106,6 +107,7 @@ def test_zero_step_run_reports_na_not_nan(cpu_mesh_devices, capsys):
     assert done and done[0]["final_loss"] == "n/a"
 
 
+@pytest.mark.slow  # budget pass (PR 10): multi-second compile; see CI evidence + slow lane
 def test_anomaly_and_emergency_flags_clean_run(cpu_mesh_devices, tmp_path,
                                                capsys):
     """--anomaly-factor/--max-rollbacks/--emergency-dir wired end to end:

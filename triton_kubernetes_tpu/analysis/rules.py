@@ -626,3 +626,49 @@ class CliDocsDrift(Rule):
                             f"flag {a.value} is not documented anywhere "
                             f"under docs/ — add it to the relevant guide "
                             f"page")
+
+
+# ---------------------------------------------------------------------------
+# TK8S109 — chaos-corpus schema
+# ---------------------------------------------------------------------------
+
+@register
+class ChaosCorpusSchema(Rule):
+    """Every ``tests/chaos_corpus/*.json`` entry must parse and match the
+    corpus schema (triton_kubernetes_tpu/chaos/corpus.py).
+
+    History: the corpus exists so every shrunk chaos counterexample
+    replays as a pinned regression test (ISSUE 10). The replay tests
+    load the whole directory and fail loudly on an invalid file — but
+    only when they run; a hand-edited entry that stops validating would
+    otherwise sit silent until the next full test pass. The lint gate
+    reports the drift in seconds, file and reason named.
+    """
+
+    code = "TK8S109"
+    name = "chaos-corpus-schema"
+    summary = "tests/chaos_corpus entries must match the corpus schema"
+
+    CORPUS_DIR = "tests/chaos_corpus"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        import json
+
+        from ..chaos.corpus import validate_entry
+
+        corpus = project.root / self.CORPUS_DIR
+        if not corpus.is_dir():
+            return
+        for p in sorted(corpus.glob("*.json")):
+            rel = p.relative_to(project.root).as_posix()
+            try:
+                entry = json.loads(p.read_text(encoding="utf-8"))
+            except ValueError as e:
+                yield self.finding(rel, 1, 0,
+                                   f"corpus entry is not valid JSON: {e}")
+                continue
+            for problem in validate_entry(entry):
+                yield self.finding(
+                    rel, 1, 0,
+                    f"corpus entry does not match the schema: {problem} "
+                    f"(see triton_kubernetes_tpu/chaos/corpus.py)")

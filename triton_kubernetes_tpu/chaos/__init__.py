@@ -1,0 +1,53 @@
+"""Property-based chaos harness over the deterministic cloudsim.
+
+ROADMAP item 5: fault coverage by *construction* instead of enumeration.
+A seeded PRNG generates random module DAGs (every provider family the
+modules layer ships), random ``op_latency`` distributions, random apply
+parallelism, and random fault plans (5xx, boot flakes, fatal faults,
+``at_op``/``at_module_op`` preemption, graceful-warning, kill-mid-wave),
+runs them against the simulator, and checks the invariant suite the
+robustness PRs pinned:
+
+* **parity** — parallel and serial applies leave bitwise-identical state;
+* **kill-resume** — a run killed mid-wave converges, once resumed, to the
+  uninterrupted run's applied modules;
+* **trace-journal** / **metrics-journal** — span exports, the apply
+  journal, and the Prometheus histograms tell one duration story;
+* **repair** — a preempted TPU slice comes back with exact ICI labels;
+* **destroy-clean** — destroy leaves zero orphaned simulator resources.
+
+Failing seeds are shrunk to minimal specs (drop modules, drop rules,
+lower parallelism, rebisect anchors) and serialized into
+``tests/chaos_corpus/*.json``; every corpus entry replays as a pinned
+tier-1 regression test. ``tk8s chaos`` is the CLI surface; the ``slow``
+soak (tests/test_chaos.py) runs apply→train→preempt→repair→resume over
+hours of simulated mutation-clock time. No third-party dependencies —
+the PRNG is ``random.Random(seed)``, and nothing here imports jax.
+"""
+
+from .corpus import (
+    CORPUS_DIR,
+    CorpusError,
+    load_entries,
+    save_entry,
+    validate_entry,
+)
+from .generator import PROFILES, generate_spec, scenario_seed
+from .runner import ScenarioResult, SweepReport, run_scenario, run_sweep
+from .shrink import shrink_spec
+
+__all__ = [
+    "CORPUS_DIR",
+    "CorpusError",
+    "PROFILES",
+    "ScenarioResult",
+    "SweepReport",
+    "generate_spec",
+    "load_entries",
+    "run_scenario",
+    "run_sweep",
+    "save_entry",
+    "scenario_seed",
+    "shrink_spec",
+    "validate_entry",
+]

@@ -1,0 +1,238 @@
+"""Seeded scenario generation: DAGs, latency models, fault plans.
+
+One scenario is a JSON-able *spec* — the unit the whole harness shares:
+the runner materializes it (executor/dagspec.py), the shrinker reduces
+it structurally, and the corpus pins it. Same seed, same spec, byte for
+byte: the only randomness source is ``random.Random(seed)`` and every
+draw is ordered, so a corpus entry replays the identical scenario on any
+box.
+
+Spec shape::
+
+    {"version": 1, "seed": 7, "profile": "default",
+     "parallelism": 2,                  # the non-serial parity arm
+     "op_latency": None | 0.002 | {"register_node": 0.01, "*": 0.0},
+     "topology": {...},                 # executor/dagspec.py shape
+     "faults": [...],                   # cloudsim FaultPlan rules
+     "kill_fraction": None | 0.4,       # arms the kill-resume invariant
+     "mutation": None | "unfaulted-reference"}   # harness self-test
+
+Generation discipline worth naming: every generated fault rule is
+**module-anchored** (``module`` / ``at_module_op``) — the
+interleaving-safe form the wavefront scheduler documents, valid at any
+parallelism. Global-clock ``at_op`` preemption anchors are NOT drawn
+(the safe tick depends on op counts the generator cannot know a
+priori); that shape is pinned by a hand-written serial corpus entry
+(tests/chaos_corpus/tpu-at-op-preempt-serial.json) instead. Preempt
+rules anchor on a module that *depends on* the pool (the jobset), so
+the slice exists by the time the reclaim fires.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from ..executor.dagspec import MANAGER_PROVIDERS
+
+SPEC_VERSION = 1
+
+# Cluster shapes a profile draws from. Weights are draw multiplicities.
+_RANCHER = ("aws", "azure", "triton", "vsphere", "bare-metal", "gcp")
+_HOSTED = ("gke", "aks")
+
+#: Generation profiles: (knobs the drawing loop reads).
+PROFILES: Dict[str, Dict[str, Any]] = {
+    # Small mixed DAGs, cheap faults — the CI sweep workhorse.
+    "quick": {"clusters": (1, 2), "nodes": (0, 2), "tpu_weight": 0.0,
+              "hosted_weight": 0.2, "parallelism": (1, 2),
+              "fault_rules": (0, 2), "latency_weight": 0.15,
+              "kill_weight": 0.2},
+    # The full matrix: every provider family, widths 1/2/8, all fault
+    # kinds, occasional latency models and kills.
+    "default": {"clusters": (1, 3), "nodes": (0, 3), "tpu_weight": 0.25,
+                "hosted_weight": 0.25, "parallelism": (1, 2, 8),
+                "fault_rules": (0, 3), "latency_weight": 0.25,
+                "kill_weight": 0.3},
+    # TPU-pool DAGs with preemption/graceful-warning faults — the
+    # apply -> preempt -> repair -> resume loop.
+    "tpu": {"clusters": (1, 2), "nodes": (0, 1), "tpu_weight": 1.0,
+            "hosted_weight": 0.0, "parallelism": (1, 2, 8),
+            "fault_rules": (1, 3), "latency_weight": 0.25,
+            "kill_weight": 0.25},
+    # The long soak: TPU loops under a heavy simulated latency model so
+    # every round advances the mutation clock by minutes of simulated
+    # time (the sleeper is a recorder — no wall-clock cost).
+    "soak": {"clusters": (1, 2), "nodes": (0, 1), "tpu_weight": 1.0,
+             "hosted_weight": 0.0, "parallelism": (1, 2, 8),
+             "fault_rules": (1, 2), "latency_weight": 1.0,
+             "latency_scale": 60.0, "kill_weight": 0.2},
+}
+
+# Ops each module family is known to issue — rules target these so a
+# drawn fault actually lands somewhere interesting (a rule that never
+# fires is legal but tests only the matching machinery).
+_FAMILY_OPS = {
+    "manager": ("bootstrap_manager", "create_resource"),
+    "rancher-cluster": ("create_or_get_cluster", "create_resource"),
+    "rancher-host": ("register_node", "create_resource"),
+    "hosted-cluster": ("create_hosted_cluster", "create_node_pool",
+                       "apply_manifest"),
+    "tpu-cluster": ("create_hosted_cluster", "create_or_get_cluster"),
+    "tpu-pool": ("create_node_pool", "apply_manifest"),
+    "jobset": ("apply_manifest",),
+}
+
+
+def _draw_topology(rng: random.Random, prof: Dict[str, Any]
+                   ) -> Dict[str, Any]:
+    topo: Dict[str, Any] = {
+        "manager": {"provider": rng.choice(MANAGER_PROVIDERS), "name": "m1"},
+        "clusters": [],
+    }
+    lo, hi = prof["clusters"]
+    for ci in range(rng.randint(lo, hi)):
+        roll = rng.random()
+        if roll < prof["tpu_weight"]:
+            pools = [{"name": f"pool{pi}", "accelerator": "v5e-16"}
+                     for pi in range(rng.randint(1, 2))]
+            cl: Dict[str, Any] = {"provider": "gcp-tpu",
+                                  "name": f"tpu{ci}", "pools": pools}
+            if rng.random() < 0.7:
+                cl["jobsets"] = [{"name": f"job{ci}",
+                                  "pool": rng.choice(pools)["name"]}]
+            topo["clusters"].append(cl)
+        elif roll < prof["tpu_weight"] + prof["hosted_weight"]:
+            topo["clusters"].append({"provider": rng.choice(_HOSTED),
+                                     "name": f"hosted{ci}"})
+        else:
+            prov = rng.choice(_RANCHER)
+            nlo, nhi = prof["nodes"]
+            nodes = [f"c{ci}-w{ni}" for ni in range(rng.randint(nlo, nhi))]
+            topo["clusters"].append({"provider": prov, "name": f"c{ci}",
+                                     "nodes": nodes})
+    return topo
+
+
+def _module_sites(topo: Dict[str, Any]) -> List[Dict[str, str]]:
+    """Every module key the topology will materialize, with its family —
+    the anchor vocabulary fault rules draw from. Mirrors the
+    executor/dagspec.py key scheme."""
+    sites = [{"key": "cluster-manager", "family": "manager"}]
+    for cl in topo["clusters"]:
+        prov, cname = cl["provider"], cl["name"]
+        if prov == "gcp-tpu":
+            sites.append({"key": f"cluster_{prov}_{cname}",
+                          "family": "tpu-cluster"})
+            for pool in cl.get("pools", []):
+                sites.append({"key": f"node_{prov}_{cname}_{pool['name']}",
+                              "family": "tpu-pool",
+                              "slice_id": f"{cname}-{pool['name']}"})
+            for job in cl.get("jobsets", []):
+                sites.append({"key": f"job_{cname}_{job['name']}",
+                              "family": "jobset",
+                              "slice_id": f"{cname}-{job['pool']}"})
+        elif prov in _HOSTED:
+            sites.append({"key": f"cluster_{prov}_{cname}",
+                          "family": "hosted-cluster"})
+        else:
+            sites.append({"key": f"cluster_{prov}_{cname}",
+                          "family": "rancher-cluster"})
+            for host in cl.get("nodes", []):
+                sites.append({"key": f"node_{prov}_{cname}_{host}",
+                              "family": "rancher-host"})
+    return sites
+
+
+def _draw_faults(rng: random.Random, prof: Dict[str, Any],
+                 topo: Dict[str, Any]) -> List[Dict[str, Any]]:
+    sites = _module_sites(topo)
+    jobset_sites = [s for s in sites if s["family"] == "jobset"]
+    lo, hi = prof["fault_rules"]
+    rules: List[Dict[str, Any]] = []
+    for _ in range(rng.randint(lo, hi)):
+        kind_roll = rng.random()
+        if kind_roll < 0.35 and jobset_sites:
+            # Preemption, anchored on a module that depends on the pool
+            # so the slice exists when the rule fires; at_module_op is
+            # interleaving-safe at any width. (Global at_op preempts are
+            # corpus-pinned, not generated — module docstring.)
+            site = rng.choice(jobset_sites)
+            rule: Dict[str, Any] = {"op": "preempt",
+                                    "slice_id": site["slice_id"],
+                                    "module": site["key"],
+                                    "at_module_op": 1}
+            if rng.random() < 0.5:
+                rule.update({"mode": "graceful-warning",
+                             "grace_ops": rng.randint(0, 1),
+                             "notify_pid": 0})
+            rules.append(rule)
+            continue
+        site = rng.choice(sites)
+        ops = _FAMILY_OPS[site["family"]]
+        if kind_roll < 0.55:
+            # Boot-flake / 5xx: transient, inside the retry budget.
+            rules.append({"op": rng.choice(ops), "module": site["key"],
+                          "times": rng.randint(1, 2),
+                          "error": rng.choice((
+                              "503 service unavailable",
+                              "instance boot failed",
+                              "429 too many requests"))})
+        elif kind_roll < 0.75:
+            # Fatal, one-shot: the first apply fails fast at this module,
+            # the re-run (rule exhausted) converges.
+            rules.append({"op": rng.choice(ops), "module": site["key"],
+                          "kind": "fatal", "times": 1,
+                          "error": "quota exceeded"})
+        else:
+            # Anchored wildcard: whatever the module's Nth mutation is —
+            # an anchor past the module's last apply op rolls over onto
+            # its destroy ops (per-module counters persist), which is how
+            # the sweep also exercises destroy-resume.
+            rules.append({"op": "*", "module": site["key"],
+                          "at_module_op": rng.randint(1, 3), "times": 1,
+                          "error": "injected at module op"})
+    return rules
+
+
+def _draw_latency(rng: random.Random, prof: Dict[str, Any]
+                  ) -> Optional[Any]:
+    if rng.random() >= prof["latency_weight"]:
+        return None
+    scale = prof.get("latency_scale", 0.002)
+    if rng.random() < 0.5:
+        return round(rng.uniform(0.2, 1.0) * scale, 6)
+    return {"register_node": round(rng.uniform(0.5, 2.0) * scale, 6),
+            "create_node_pool": round(rng.uniform(0.5, 2.0) * scale, 6),
+            "*": round(rng.uniform(0.05, 0.5) * scale, 6)}
+
+
+def scenario_seed(base: int, i: int) -> int:
+    """Per-scenario seed of sweep step ``i``. One shared formula: the
+    sweep runner and the CI evidence coverage report must derive the
+    same seeds, or the coverage claim describes scenarios never run."""
+    return (base * 1_000_003 + i) % (2 ** 31 - 1)
+
+
+def generate_spec(seed: int, profile: str = "default") -> Dict[str, Any]:
+    """One scenario spec, fully determined by (seed, profile)."""
+    if profile not in PROFILES:
+        raise ValueError(
+            f"unknown chaos profile {profile!r} (choices: {sorted(PROFILES)})")
+    prof = PROFILES[profile]
+    rng = random.Random(seed)
+    parallelism = rng.choice(prof["parallelism"])
+    topo = _draw_topology(rng, prof)
+    spec: Dict[str, Any] = {
+        "version": SPEC_VERSION,
+        "seed": seed,
+        "profile": profile,
+        "parallelism": parallelism,
+        "op_latency": _draw_latency(rng, prof),
+        "topology": topo,
+        "faults": _draw_faults(rng, prof, topo),
+        "kill_fraction": (round(rng.uniform(0.2, 0.9), 3)
+                          if rng.random() < prof["kill_weight"] else None),
+        "mutation": None,
+    }
+    return spec

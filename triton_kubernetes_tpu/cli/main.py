@@ -58,6 +58,11 @@ from ..workflows import (
 
 GIT_SHA = "dev"  # stamped by packaging (Makefile -ldflags analog, Makefile:2)
 
+# Pinned copy of chaos.generator.PROFILES' keys (equality test-enforced,
+# tests/test_chaos.py): argparse choices must not cost an eager import of
+# the chaos/runner stack on every CLI start.
+CHAOS_PROFILES = ("default", "quick", "soak", "tpu")
+
 
 def choose_backend(resolver: InputResolver) -> Backend:
     """Backend selection (util/backend_prompt.go:18-168 analog).
@@ -219,6 +224,30 @@ def build_parser() -> argparse.ArgumentParser:
                            "root-relative files/dirs (cross-file rules "
                            "still read their pinned sites)")
 
+    chaos = sub.add_parser(
+        "chaos",
+        help="property-based chaos sweep: seeded random module DAGs + "
+             "fault plans against cloudsim, checking the pinned "
+             "robustness invariants; failing seeds shrink into "
+             "tests/chaos_corpus (docs/guide/fault-tolerance.md)")
+    chaos.add_argument("--seed", type=int, default=0, metavar="N",
+                       help="base seed of the sweep (default: 0; scenario "
+                            "i derives its own seed deterministically)")
+    chaos.add_argument("--runs", type=int, default=25, metavar="N",
+                       help="generated scenarios to run (default: 25)")
+    chaos.add_argument("--profile", choices=sorted(CHAOS_PROFILES),
+                       default="default",
+                       help="generation profile: DAG sizes, provider mix, "
+                            "fault density (default: default)")
+    chaos.add_argument("--shrink", action="store_true",
+                       help="shrink failing seeds to minimal specs and "
+                            "write them as corpus entries under "
+                            "--corpus-dir")
+    chaos.add_argument("--corpus-dir", default=None, metavar="DIR",
+                       help="where shrunk counterexamples land (default: "
+                            "tests/chaos_corpus; implies nothing unless "
+                            "--shrink finds failures)")
+
     serve = sub.add_parser(
         "serve",
         help="run the TPU-native inference endpoint: continuous batching "
@@ -314,6 +343,33 @@ def main(argv: Optional[List[str]] = None,
         if trace is not None:
             trace.write(args.trace_out)
         return 1 if findings else 0
+
+    if args.command == "chaos":
+        # Pure cloudsim work: needs no backend choice, no config, no jax.
+        from ..chaos import CORPUS_DIR, run_sweep
+
+        corpus_dir = args.corpus_dir if args.corpus_dir is not None \
+            else CORPUS_DIR
+        report = run_sweep(
+            seed=args.seed, runs=args.runs, profile=args.profile,
+            shrink=args.shrink,
+            corpus_dir=corpus_dir if args.shrink else None,
+            log=lambda m: logger.info(m))
+        if args.json:
+            print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+        else:
+            print(f"chaos sweep: {report.passed}/{report.runs} scenarios "
+                  f"passed (profile={args.profile}, seed={args.seed}, "
+                  f"simulated {report.simulated_seconds:.1f}s)")
+            for r in report.results:
+                print(f"  seed {r.spec['seed']}: violated "
+                      + ", ".join(sorted({v['invariant']
+                                          for v in r.violations})))
+            for path in report.corpus_written:
+                print(f"  corpus entry written: {path}")
+        if trace is not None:
+            trace.write(args.trace_out)
+        return 1 if report.failed else 0
 
     if args.command == "serve":
         # Workload-stack imports stay lazy: the provisioning verbs must

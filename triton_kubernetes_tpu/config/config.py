@@ -96,13 +96,18 @@ def load_yaml_file(path: str) -> Dict[str, Any]:
 
 class Config:
     def __init__(self, config_file: Optional[str] = None,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 use_default_file: bool = True):
+        """``use_default_file=False`` makes the config hermetic: no
+        fallback to ~/.triton-kubernetes-tpu.yaml. Programmatic callers
+        (automation building a silent context from explicit values) use
+        it so an operator's leftover defaults cannot steer them."""
         self._overrides: Dict[str, Any] = {}
         self._file_values: Dict[str, Any] = {}
         self._env = env if env is not None else dict(os.environ)
         if config_file:
             self._file_values = load_yaml_file(config_file)
-        else:
+        elif use_default_file:
             default = Path(os.path.expanduser(DEFAULT_CONFIG_PATH))
             if default.is_file():
                 self._file_values = load_yaml_file(str(default))

@@ -18,7 +18,14 @@ from .interpolate import (
     resolve,
 )
 from .plan import Plan, PlanAction, diff_states
-from .cloudsim import FatalFaultError, FaultPlan, TransientFaultError
+from .cloudsim import (
+    FatalFaultError,
+    FaultPlan,
+    FaultPlanError,
+    SimulatedKillError,
+    TransientFaultError,
+)
+from .dagspec import DagSpecError, document_from_spec, tpu_slices
 from .drivers import driver_names, make_driver, register_driver
 from .engine import (
     ApplyError,
@@ -28,29 +35,38 @@ from .engine import (
     OutputError,
     RetryPolicy,
     TransientApplyError,
+    modules_fingerprint,
+    state_fingerprint,
 )
 from .terraform import TerraformExecutor
 
 __all__ = [
     "ApplyError",
+    "DagSpecError",
     "ExecutorState",
     "FatalApplyError",
     "FatalFaultError",
     "FaultPlan",
+    "FaultPlanError",
     "InterpolationError",
     "LocalExecutor",
     "OutputError",
     "RetryPolicy",
+    "SimulatedKillError",
     "TransientApplyError",
     "TransientFaultError",
     "Plan",
     "PlanAction",
     "TerraformExecutor",
     "diff_states",
+    "document_from_spec",
     "driver_names",
     "make_driver",
+    "modules_fingerprint",
     "register_driver",
+    "state_fingerprint",
     "extract_dependencies",
     "module_dependencies",
     "resolve",
+    "tpu_slices",
 ]

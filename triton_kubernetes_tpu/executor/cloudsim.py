@@ -55,6 +55,24 @@ class FatalFaultError(CloudSimError):
     provider permanently rejects. The engine fails fast on this type."""
 
 
+class FaultPlanError(ValueError):
+    """A fault-rule spec is malformed (unknown op/kind/mode, a preempt
+    rule with no slice, a floating at_module_op anchor). Raised at plan
+    *construction*, uniformly for every rule shape — a generated or
+    hand-written plan must fail loudly before the first op, not fire
+    nothing (or the wrong thing) mid-apply."""
+
+
+class SimulatedKillError(BaseException):
+    """An injected process death (the kill-mid-wave fault class).
+
+    Derives from BaseException on purpose: the engine's retry loop
+    catches ``Exception``, so a kill rides past retry/backoff exactly
+    like a real SIGKILL would — the module is NOT retried, the wavefront
+    unwinds, and whatever state was saved per completed module is what
+    the resumed run starts from."""
+
+
 class FaultPlan:
     """Deterministic fault injection for the simulator.
 
@@ -116,21 +134,93 @@ class FaultPlan:
     the ops the run will actually make.
     """
 
+    #: Every mutating operation the simulator exposes — the closed set an
+    #: op rule may name. Kept in sync with the ``_mutate`` call sites
+    #: below; a rule naming anything else is a typo that would silently
+    #: never fire, so it is rejected at construction instead.
+    MUTATING_OPS = frozenset({
+        "create_resource", "delete_resource", "bootstrap_manager",
+        "create_or_get_cluster", "register_node", "deregister_node",
+        "set_node_health", "create_hosted_cluster", "create_node_pool",
+        "apply_manifest", "delete_manifest",
+    })
+
+    # Key vocabularies per rule shape: a misspelled key ("slice" for
+    # "slice_id") is as silently inert as a misspelled op. ``fired`` /
+    # ``warned`` are the serialized live-state keys, accepted so a
+    # persisted plan round-trips through its own to_dict().
+    _OP_RULE_KEYS = frozenset({
+        "op", "times", "kind", "error", "match", "module", "at_module_op",
+        "fired"})
+    _PREEMPT_RULE_KEYS = frozenset({
+        "op", "slice_id", "at_op", "at_module_op", "module", "mode",
+        "notify_pid", "signal", "grace_ops", "times", "kind", "fired",
+        "warned"})
+
     def __init__(self, spec: Optional[Dict[str, Any]] = None):
         self.rules: List[Dict[str, Any]] = []
-        for rule in (spec or {}).get("faults", []):
-            r = dict(rule)
-            r.setdefault("times", 1)
-            r.setdefault("kind", "transient")
-            r.setdefault("fired", 0)
-            if "at_module_op" in r and not r.get("module"):
-                # Without a module anchor the per-module op index matches
-                # whichever module reaches it first — exactly the
-                # interleaving-dependence this anchor exists to remove.
-                raise ValueError(
-                    "fault rule with at_module_op must name its module "
-                    f"(got {rule!r})")
-            self.rules.append(r)
+        for i, rule in enumerate((spec or {}).get("faults", [])):
+            self.rules.append(self._validated(i, rule))
+
+    @classmethod
+    def _validated(cls, i: int, rule: Any) -> Dict[str, Any]:
+        """One rule, checked and normalized. Every malformed shape raises
+        the same typed :class:`FaultPlanError` naming the rule index and
+        the offending field — the uniform error path the generated-plan
+        machinery (chaos harness) and hand-written docs plans share."""
+        def bad(msg: str) -> FaultPlanError:
+            return FaultPlanError(f"fault rule #{i}: {msg} (got {rule!r})")
+
+        if not isinstance(rule, dict):
+            raise bad("must be a mapping")
+        op = rule.get("op")
+        if not isinstance(op, str) or not op:
+            raise bad("must name its 'op'")
+        r = dict(rule)
+        r.setdefault("times", 1)
+        r.setdefault("kind", "transient")
+        r.setdefault("fired", 0)
+        if "at_module_op" in r and not r.get("module"):
+            # Without a module anchor the per-module op index matches
+            # whichever module reaches it first — exactly the
+            # interleaving-dependence this anchor exists to remove.
+            raise bad("fault rule with at_module_op must name its module")
+        for key in ("times", "fired", "at_op", "at_module_op", "grace_ops",
+                    "notify_pid", "warned"):
+            if key in r and not isinstance(r[key], int):
+                raise bad(f"{key!r} must be an integer")
+            if key in r and r[key] < 0:
+                raise bad(f"{key!r} must be >= 0")
+        if r["times"] < 1:
+            raise bad("'times' must be >= 1")
+        if "at_module_op" in r and r["at_module_op"] < 1:
+            raise bad("'at_module_op' is a 1-based op index, must be >= 1")
+        # kind is checked for EVERY rule shape (preempt rules carry the
+        # serialized default too): a typo'd kind silently firing with
+        # transient semantics is the exact class this validation kills.
+        if r["kind"] not in ("transient", "fatal"):
+            raise bad(f"unknown kind {r['kind']!r} "
+                      "(choices: transient, fatal)")
+        if op == "preempt":
+            unknown = set(r) - cls._PREEMPT_RULE_KEYS
+            if unknown:
+                raise bad(f"unknown preempt-rule keys {sorted(unknown)}")
+            if not isinstance(r.get("slice_id"), str) or not r["slice_id"]:
+                raise bad("preempt rules must name their 'slice_id'")
+            if r.get("mode") not in (None, "graceful-warning"):
+                raise bad(f"unknown preempt mode {r.get('mode')!r} "
+                          "(only 'graceful-warning')")
+            return r
+        unknown = set(r) - cls._OP_RULE_KEYS
+        if unknown:
+            raise bad(f"unknown rule keys {sorted(unknown)} "
+                      "(mode/slice_id/grace_ops are preempt-rule keys)")
+        if op != "*" and op not in cls.MUTATING_OPS:
+            raise bad(f"unknown op {op!r} (choices: '*', 'preempt', "
+                      f"{sorted(cls.MUTATING_OPS)})")
+        if "match" in r and not isinstance(r["match"], dict):
+            raise bad("'match' must be a mapping of info-field substrings")
+        return r
 
     def to_dict(self) -> Dict[str, Any]:
         return {"faults": [dict(r) for r in self.rules]}
@@ -241,6 +331,15 @@ class CloudSimulator:
         # clock tick + fault check + state mutation are indivisible.
         self._lock = threading.RLock()
         self._scope = threading.local()
+        # Injectable process-death hook (the chaos harness's kill-mid-wave
+        # fault): called after every mutation's clock tick + fault check
+        # but BEFORE the op's state mutation lands, outside the lock; may
+        # raise :class:`SimulatedKillError`. The death therefore leaves
+        # the current op not-yet-applied (like an injected fault would) —
+        # half-applied *modules* and mid-wave sibling commits are the
+        # states it exercises, not a torn individual op. Never
+        # serialized — a kill is an event, not state.
+        self.kill_hook: Optional[Callable[[str, str, int], None]] = None
         # Opt-in deterministic per-op simulated latency (seconds): a float
         # applied to every mutating op, or an {op: seconds} map with "*"
         # as the default. Off (0) unless configured; serialized with the
@@ -304,6 +403,8 @@ class CloudSimulator:
                     info = dict(info, module=module)
                 self.fault_plan.check(self, op, info, module=module,
                                       module_op=module_op)
+        if self.kill_hook is not None:
+            self.kill_hook(op, module, module_op)
         latency = self._op_latency_s(op)
         if latency > 0:
             self._sleep(latency)

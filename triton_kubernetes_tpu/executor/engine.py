@@ -214,6 +214,41 @@ def delete_executor_state(doc: StateDocument) -> None:
             loc["objectstore"]["path"])
 
 
+# Journal fields that are deterministic at every parallelism — what the
+# bitwise-parity contract covers. Timings (durations, backoff_total,
+# critical_path/total_work) vary run to run and are excluded.
+JOURNAL_PARITY_FIELDS = ("kind", "order", "wave", "waves", "completed",
+                         "retries", "status")
+
+
+def state_fingerprint(doc: StateDocument, with_journal: bool = True) -> str:
+    """Canonical bytes of everything the parallel-vs-serial parity
+    contract covers: applied modules + outputs, the full cloud dict
+    (content-addressed ids/ips, fault-plan fired counts, op clocks), and
+    — unless ``with_journal=False`` — the journal's deterministic fields
+    (:data:`JOURNAL_PARITY_FIELDS`).
+
+    Extracted from the wavefront parity tests so every consumer (tests,
+    the chaos harness, CI evidence scripts) compares the same bytes.
+    """
+    est = load_executor_state(doc)
+    fp: Dict[str, Any] = {"modules": est.modules, "cloud": est.cloud,
+                          "serial": est.serial}
+    if with_journal:
+        fp["journal"] = {k: est.journal.get(k)
+                         for k in JOURNAL_PARITY_FIELDS}
+    return json.dumps(fp, sort_keys=True)
+
+
+def modules_fingerprint(doc: StateDocument) -> str:
+    """Canonical bytes of the applied module records alone (configs,
+    outputs, resources) — the convergence contract for interrupted runs:
+    a killed-and-resumed apply must end with the same *modules* as an
+    uninterrupted one, even though its cloud op clocks and journal
+    necessarily differ (the retried ops ticked extra mutations)."""
+    return json.dumps(load_executor_state(doc).modules, sort_keys=True)
+
+
 def _cloud_snapshot(cloud: Any) -> Dict[str, Any]:
     """A point-in-time dict of the driver's state, safe to persist while
     sibling modules may still be mutating it. CloudSimulator deep-copies
@@ -243,7 +278,8 @@ class LocalExecutor:
     def __init__(self, log: Optional[Callable[[str], None]] = None,
                  logger=None, retry: Optional[RetryPolicy] = None,
                  sleep: Optional[Callable[[float], None]] = None,
-                 parallelism: int = 1):
+                 parallelism: int = 1,
+                 driver_factory: Optional[Callable[..., Any]] = None):
         from ..utils import get_logger
 
         self.logger = logger if logger is not None else get_logger()
@@ -251,6 +287,12 @@ class LocalExecutor:
         self.retry = retry if retry is not None else RetryPolicy()
         # Injected sleeper: tests drive backoff without wall-clock waits.
         self._sleep = sleep if sleep is not None else time.sleep
+        # Injected driver construction (make_driver signature): the seam
+        # the chaos harness and timing tests use to hand the simulator a
+        # recording sleeper or a kill hook — things a JSON driver config
+        # cannot carry.
+        self._make_driver = (driver_factory if driver_factory is not None
+                             else make_driver)
         # Wavefront width. The CLI defaults this to 4 (terraform's
         # -parallelism analog); the constructor default stays 1 so
         # embedders and tests get the exact serial contract unless they
@@ -459,7 +501,7 @@ class LocalExecutor:
         self._taint_dependents(plan, desired, targets)
         self.log(plan.summary())
 
-        cloud = make_driver(doc, est.cloud)
+        cloud = self._make_driver(doc, est.cloud)
         order = topo_order(desired)
         outputs: Dict[str, Dict[str, Any]] = {
             name: rec.get("outputs", {}) for name, rec in est.modules.items()
@@ -683,7 +725,7 @@ class LocalExecutor:
         ``tk8s_module_destroy_duration_seconds``.
         """
         est = load_executor_state(doc)
-        cloud = make_driver(doc, est.cloud)
+        cloud = self._make_driver(doc, est.cloud)
         names = list(est.modules) if targets is None else [
             t for t in targets if t in est.modules
         ]
@@ -822,7 +864,7 @@ class LocalExecutor:
             resolved_rec["config"] = resolve(rec.get("config", {}), outputs)
         except KeyError as e:
             raise ApplyError(f"module {backup_key!r}: {e}") from e
-        cloud = make_driver(doc, est.cloud)
+        cloud = self._make_driver(doc, est.cloud)
         with self.logger.span("restore", doc=doc.name, backup=backup_key), \
                 tempfile.TemporaryDirectory(prefix="tk-tpu-restore-") as workdir:
             ctx = DriverContext(cloud=cloud, workdir=workdir,

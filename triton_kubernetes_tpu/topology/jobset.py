@@ -98,7 +98,7 @@ def render_jobset(
             # checkpoint, or the pod being disrupted outright (node
             # drain, spot reclaim) — those recreate the pod, which
             # resumes from the newest verified checkpoint (the command
-            # must pass --resume; docs/guide/fault-tolerance.md §5).
+            # must pass --resume; docs/guide/fault-tolerance.md §6).
             "backoffLimit": 0,
             "podFailurePolicy": {"rules": [
                 {"action": "Ignore",

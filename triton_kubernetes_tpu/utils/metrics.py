@@ -109,6 +109,17 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
     "tk8s_repairs_total": (
         "counter", "repair {node,slice} workflow runs by outcome",
         ("kind", "outcome"), None),
+    # ------------------------------------------------------------ chaos/
+    "tk8s_chaos_scenarios_total": (
+        "counter", "Chaos-harness scenarios run, by verdict "
+        "(ok / violated)", ("status",), None),
+    "tk8s_chaos_invariant_checks_total": (
+        "counter", "Chaos-harness invariant evaluations by invariant id "
+        "and verdict", ("invariant", "status"), None),
+    "tk8s_chaos_shrink_steps_total": (
+        "counter", "Candidate reductions tried while shrinking failing "
+        "chaos specs, by outcome (accepted / rejected)",
+        ("outcome",), None),
     # ------------------------------------- train/pipeline.py (step loop)
     "tk8s_train_step_duration_seconds": (
         "histogram", "Per-step wall-clock duration, amortized over each "

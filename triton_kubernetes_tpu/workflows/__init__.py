@@ -22,6 +22,7 @@ from .repair import (
     NoUnhealthyNodesError,
     repair_node,
     repair_slice,
+    repair_slice_auto,
 )
 
 __all__ = [
@@ -31,6 +32,7 @@ __all__ = [
     "WorkflowContext",
     "WorkflowError",
     "repair_slice",
+    "repair_slice_auto",
     "delete_cluster",
     "delete_manager",
     "delete_node",

@@ -151,6 +151,29 @@ def repair_slice(ctx: WorkflowContext) -> str:
     return _counted_repair("slice", _repair_slice, ctx)
 
 
+def repair_slice_auto(backend, executor, manager: str, cluster: str,
+                      slice_id: str = "") -> str:
+    """Programmatic ``repair slice`` for automation — the chaos harness's
+    apply→preempt→repair→resume loop and (eventually) a reconcile
+    operator. Same detect→cordon→replace→verify path as the CLI verb,
+    driven through a silent auto-confirming context; raises the same
+    typed errors (:class:`NoPreemptedSlicesError` when nothing is
+    preempted)."""
+    from ..config import Config, InputResolver
+
+    # Hermetic config: no env, no ~/.triton-kubernetes-tpu.yaml fallback —
+    # an operator's leftover `slice_id:` default must not steer an
+    # automated repair onto the wrong pool.
+    cfg = Config(env={}, use_default_file=False)
+    cfg.set("cluster_manager", manager)
+    cfg.set("cluster_name", cluster)
+    if slice_id:
+        cfg.set("slice_id", slice_id)
+    ctx = WorkflowContext(backend=backend, executor=executor,
+                          resolver=InputResolver(cfg, None, True))
+    return repair_slice(ctx)
+
+
 def _repair_slice(ctx: WorkflowContext) -> str:
     """Replace a preempted TPU slice pool and restore its ICI labels.
 
