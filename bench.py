@@ -243,6 +243,14 @@ def _child() -> None:
         "attention": config.attention,
         "precision": _precision.policy_of(config),
         "remat": _precision.remat_policy_of(config),
+        # Serving-side quantization knobs for the record (BENCH_r06+):
+        # what `tk8s serve --kv-dtype auto / --weight-dtype auto`
+        # resolve to for this config — the dtype the paged KV pool and
+        # decode weights default to on the benched numerics. The
+        # quantized-engine A/B itself is gated separately
+        # (scripts/ci/quant_evidence.py).
+        "kv_dtype": config.dtype,
+        "weight_dtype": config.param_dtype,
         **mem_fields,
         # Compile-vs-step split (persistent cache makes the warm-attempt
         # compile collapse toward zero) + loop-overlap evidence.

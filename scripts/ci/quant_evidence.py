@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Produce the quantization evidence artifact: the int8-KV engine vs the
+bf16 baseline at EQUAL pool bytes, written to
+docs/ci-evidence/quant-<tag>.json.
+
+The reviewable counterpart of the quantized-path tests, through the
+serving_evidence harness shapes (seeded loadgen schedule, percentile
+summaries, the engine's own TTFT/TPOT measurements). Both arms run the
+SAME seeded request stream on the SAME model params; the ONLY axis is
+``kv_dtype`` — the baseline gets bf16 pages, the quantized arm int8
+pages plus per-page-per-head scales, with ``num_blocks`` sized so both
+pools occupy the same device bytes (scales counted against the int8
+arm). What the artifact shows, and the gates:
+
+- **capacity**: peak concurrently-decoding sequences per arm under a
+  burst that oversubscribes both pools — the int8 arm must reach
+  >= 1.5x the bf16 arm's peak (bf16->int8 halves page bytes; the scale
+  overhead is why the gate is 1.5x, not 2x). Deterministic: admission
+  is FIFO, allocation lowest-index-first, and the burst is submitted
+  before the first step.
+- **latency**: TTFT and TPOT from the engine's completions — the
+  quantized arm's MEDIAN must not regress past the bf16 arm by more
+  than the noise margin (quantize-on-write/dequantize-in-attention must
+  stay in the step's noise, not become a new hot spot). The gate runs
+  on the median on purpose: p99 over a 14-request CPU run is just the
+  max sample, and one GC pause on a shared runner would fail CI with no
+  code change — p99 is *recorded* in the artifact, never gated.
+- **parity**: greedy outputs per request across arms — exact match
+  required on the short-sequence pin (first decode steps over quantized
+  pages), and the mean matched-prefix fraction over the full stream
+  must clear the pinned tolerance.
+
+Latency figures vary run to run; capacity, token counts, and outputs
+are deterministic.
+
+Usage: python scripts/ci/quant_evidence.py [tag]  (default: local)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from triton_kubernetes_tpu.models import get_config, init_params  # noqa: E402
+from triton_kubernetes_tpu.serve import (  # noqa: E402
+    PoissonSchedule, Request, ServeEngine, percentile)
+from triton_kubernetes_tpu.utils import metrics  # noqa: E402
+
+N_REQUESTS = 14
+MAX_NEW = 8
+BLOCK_SIZE = 4
+BF16_BLOCKS = 25  # 24 allocatable; the burst below oversubscribes this
+GATE_CAPACITY = 1.5    # peak concurrent sequences, int8 vs bf16
+GATE_LATENCY = 1.5     # median TTFT/TPOT may not regress past this factor
+GATE_MATCH = 0.90      # mean matched-prefix fraction across the stream
+SHORT_PIN = ([5, 7, 9, 11, 2], 3)  # exact-match pin: prompt, max_new
+
+
+def int8_blocks_for_equal_bytes(cfg, bf16_blocks):
+    """num_blocks an int8 pool may use inside the bf16 pool's byte
+    budget (per-page scale bytes charged against it)."""
+    per_page = cfg.num_kv_heads * cfg.head_dim * BLOCK_SIZE
+    bf16_bytes = 2 * bf16_blocks * per_page * 2          # K+V, 2B each
+    int8_page = 2 * (per_page * 1 + cfg.num_kv_heads * 4)  # + f32 scales
+    return bf16_bytes // int8_page
+
+
+def run_arm(params, cfg, schedule, kv_dtype, num_blocks):
+    """Burst-submit the whole schedule, then step to drain. Returns the
+    per-arm evidence dict. Peak concurrency is read after each step's
+    admissions — page capacity is the binding constraint (max_batch is
+    sized above the pool)."""
+    metrics.configure()
+    eng = ServeEngine(params, cfg, block_size=BLOCK_SIZE,
+                      num_blocks=num_blocks, max_batch=N_REQUESTS,
+                      max_model_len=64, kv_dtype=kv_dtype)
+    for tr in schedule:
+        eng.submit(Request(tr.request_id, tr.tokens, tr.max_new_tokens))
+    done, peak, steps = {}, 0, 0
+    while eng.has_work:
+        for d in eng.step():
+            done[d.request_id] = d
+        peak = max(peak, eng.num_running)
+        steps += 1
+        assert steps < 10_000, "engine failed to drain"
+    assert eng.allocator.in_use == 0, "leaked KV pages"
+    ttfts = [d.ttft for d in done.values()]
+    tpots = [d.tpot for d in done.values() if d.tpot > 0]
+    return {
+        "kv_dtype": kv_dtype,
+        "num_blocks": num_blocks,
+        "kv_pool_bytes": int(
+            metrics.gauge("tk8s_serve_kv_bytes").value(component="pages")
+            + metrics.gauge("tk8s_serve_kv_bytes").value(
+                component="scales")),
+        "quant_error_k": round(float(metrics.gauge(
+            "tk8s_serve_quant_error").value(tensor="k")), 5),
+        "quant_error_v": round(float(metrics.gauge(
+            "tk8s_serve_quant_error").value(tensor="v")), 5),
+        "peak_concurrent_sequences": peak,
+        "preemptions": int(metrics.counter(
+            "tk8s_serve_preemptions_total").value()),
+        "steps_to_drain": steps,
+        "ttft_p50_s": round(percentile(ttfts, 50), 4),
+        "ttft_p99_s": round(percentile(ttfts, 99), 4),
+        "tpot_p50_s": round(percentile(tpots, 50), 5),
+        "tpot_p99_s": round(percentile(tpots, 99), 5),
+        "outputs": {rid: d.tokens for rid, d in done.items()},
+    }
+
+
+def solo_tokens(params, cfg, kv_dtype, prompt, max_new):
+    metrics.configure()
+    eng = ServeEngine(params, cfg, block_size=BLOCK_SIZE, num_blocks=16,
+                      max_batch=1, max_model_len=64, kv_dtype=kv_dtype)
+    eng.submit(Request("pin", list(prompt), max_new))
+    return eng.run_until_idle()[0].tokens
+
+
+def match_fraction(a, b):
+    """Matched-prefix fraction: the first divergence point over the
+    longer length (greedy decode compounds after one flipped token, so
+    prefix length is the honest unit)."""
+    n = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        n += 1
+    return n / max(len(a), len(b), 1)
+
+
+def main(argv):
+    tag = argv[1] if len(argv) > 1 else "local"
+    out_dir = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        os.pardir, os.pardir, "docs", "ci-evidence"))
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"quant-{tag}.json")
+
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    int8_blocks = int8_blocks_for_equal_bytes(cfg, BF16_BLOCKS)
+    schedule = PoissonSchedule(rate=60.0, n=N_REQUESTS,
+                               vocab_size=cfg.vocab_size,
+                               prompt_len_range=(4, 16),
+                               max_new_tokens=MAX_NEW, seed=7)
+
+    bf16 = run_arm(params, cfg, schedule, "bf16", BF16_BLOCKS)
+    int8 = run_arm(params, cfg, schedule, "int8", int8_blocks)
+
+    capacity_ratio = (int8["peak_concurrent_sequences"]
+                      / max(bf16["peak_concurrent_sequences"], 1))
+    fracs = [match_fraction(int8["outputs"][rid], bf16["outputs"][rid])
+             for rid in bf16["outputs"]]
+    mean_match = sum(fracs) / len(fracs)
+    pin_prompt, pin_new = SHORT_PIN
+    pin_bf16 = solo_tokens(params, cfg, "bf16", pin_prompt, pin_new)
+    pin_int8 = solo_tokens(params, cfg, "int8", pin_prompt, pin_new)
+
+    evidence = {
+        "tag": tag,
+        "config": cfg.name,
+        "schedule_seed": 7,
+        "requests": N_REQUESTS,
+        "block_size": BLOCK_SIZE,
+        "bf16": bf16,
+        "int8": int8,
+        "capacity_ratio": round(capacity_ratio, 3),
+        "mean_matched_prefix_fraction": round(mean_match, 4),
+        "short_seq_pin": {"prompt": pin_prompt, "max_new": pin_new,
+                          "bf16": pin_bf16, "int8": pin_int8,
+                          "exact_match": pin_bf16 == pin_int8},
+        "gates": {"capacity": GATE_CAPACITY, "latency": GATE_LATENCY,
+                  "match": GATE_MATCH},
+    }
+    with open(out_path, "w") as f:
+        json.dump(evidence, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"quant evidence written: {out_path}")
+    print(f"pool bytes: bf16={bf16['kv_pool_bytes']} "
+          f"int8={int8['kv_pool_bytes']} "
+          f"(blocks {BF16_BLOCKS} -> {int8_blocks})")
+    print(f"peak concurrency: bf16={bf16['peak_concurrent_sequences']} "
+          f"int8={int8['peak_concurrent_sequences']} "
+          f"({capacity_ratio:.2f}x)")
+    print(f"ttft p99: bf16={bf16['ttft_p99_s']} int8={int8['ttft_p99_s']}; "
+          f"tpot p99: bf16={bf16['tpot_p99_s']} int8={int8['tpot_p99_s']}")
+    print(f"matched-prefix fraction {mean_match:.3f}; short pin "
+          f"{'exact' if pin_bf16 == pin_int8 else 'DIVERGED'}")
+
+    # Hard contracts.
+    if int8["kv_pool_bytes"] > bf16["kv_pool_bytes"]:
+        print("FAIL: int8 arm exceeds the bf16 pool-byte budget",
+              file=sys.stderr)
+        return 1
+    if capacity_ratio < GATE_CAPACITY:
+        print(f"FAIL: capacity ratio {capacity_ratio:.2f}x < "
+              f"{GATE_CAPACITY}x at equal pool bytes", file=sys.stderr)
+        return 1
+    for m in ("ttft_p50_s", "tpot_p50_s"):
+        if int8[m] > bf16[m] * GATE_LATENCY:
+            print(f"FAIL: int8 {m} {int8[m]} regresses past "
+                  f"{GATE_LATENCY}x bf16 ({bf16[m]})", file=sys.stderr)
+            return 1
+    if not evidence["short_seq_pin"]["exact_match"]:
+        print("FAIL: short-sequence pin diverged between int8 and bf16",
+              file=sys.stderr)
+        return 1
+    if mean_match < GATE_MATCH:
+        print(f"FAIL: matched-prefix fraction {mean_match:.3f} < "
+              f"{GATE_MATCH}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

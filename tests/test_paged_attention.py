@@ -32,8 +32,10 @@ from triton_kubernetes_tpu.ops.paged_attention import (
     blocks_for,
     gather_pages,
     ragged_paged_attention,
+    resolve_paged_impl,
     scatter_token,
 )
+from triton_kubernetes_tpu.ops.quantization import quantize_kv_pages
 
 
 def test_blocks_for():
@@ -45,12 +47,13 @@ def test_blocks_for():
 
 def _paged_from_contiguous(k, lengths, bs, num_pages, seed):
     """Scatter a contiguous [B, S, H, D] cache into randomly-permuted
-    pages; unused pool pages get garbage. Returns (pages, tables)."""
+    head-major pages ([N, H, bs, D]); unused pool pages get garbage.
+    Returns (pages, tables)."""
     b, s, h, d = k.shape
     t = s // bs
     rng = np.random.default_rng(seed)
     pages = jnp.asarray(
-        rng.standard_normal((num_pages, bs, h, d)), k.dtype)  # garbage pool
+        rng.standard_normal((num_pages, h, bs, d)), k.dtype)  # garbage pool
     # Distinct physical pages per (seq, logical block), never the trash.
     phys = rng.permutation(np.arange(1, num_pages))[:b * t].reshape(b, t)
     tables = np.full((b, t), TRASH_PAGE, np.int32)
@@ -59,7 +62,7 @@ def _paged_from_contiguous(k, lengths, bs, num_pages, seed):
         tables[i, :used] = phys[i, :used]
         split = k[i].reshape(t, bs, h, d)
         for j in range(used):
-            pages = pages.at[phys[i, j]].set(split[j])
+            pages = pages.at[phys[i, j]].set(split[j].transpose(1, 0, 2))
     return pages, jnp.asarray(tables)
 
 
@@ -102,10 +105,241 @@ def test_ragged_paged_attention_matches_contiguous():
             np.asarray(got[i]), np.asarray(want[0]), atol=1e-5, rtol=1e-5)
 
 
+def _ragged_case(seed, lengths, bs=4, hq=4, hkv=2, d=16, num_pages=32):
+    # d=16, not smaller: anchored KV scales key off the slot-0 token's
+    # amax over D, and at tiny D the amax of gaussian data fluctuates
+    # enough between tokens to clamp — at real head dims it concentrates.
+    """One ragged batch: (q, k_pages, v_pages, tables, lengths, k, v)
+    with permuted physical pages and garbage in every unwritten slot."""
+    lengths = np.asarray(lengths)
+    b = len(lengths)
+    s = -(-int(lengths.max()) // bs) * bs
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (b, 1, hq, d))
+    k = jax.random.normal(ks[1], (b, s, hkv, d))
+    v = jax.random.normal(ks[2], (b, s, hkv, d))
+    k_pages, tables = _paged_from_contiguous(k, lengths, bs, num_pages,
+                                             seed=seed + 1)
+    v_pages, _ = _paged_from_contiguous(v, lengths, bs, num_pages,
+                                        seed=seed + 1)
+    return q, k_pages, v_pages, tables, lengths, k, v
+
+
+def _dense_reference(q, k, v, lengths):
+    """Per-sequence dense attention over the exact written prefix — the
+    garbage-free ground truth every impl must match."""
+    outs = []
+    for i in range(len(lengths)):
+        n = int(lengths[i])
+        outs.append(causal_attention(
+            q[i:i + 1], k[i:i + 1, :n], v[i:i + 1, :n],
+            jnp.asarray([[n - 1]], jnp.int32),
+            jnp.asarray([list(range(n))], jnp.int32))[0])
+    return jnp.stack(outs)
+
+
+# --------------------------------------------------- fused Pallas kernel
+def test_pallas_kernel_matches_dense_reference():
+    """The flash playbook for the paged site: the fused kernel
+    (interpret mode — the identical code path that lowers on TPU) must
+    match the dense reference at heterogeneous positions, including an
+    exact-block-boundary length and a single-token sequence."""
+    q, kp, vp, tables, lengths, k, v = _ragged_case(
+        2, lengths=[5, 16, 1, 8])  # mid-block, full, minimal, exact-block
+    want = ragged_paged_attention(
+        q, kp, vp, tables, jnp.asarray(lengths, jnp.int32), impl="dense")
+    got = ragged_paged_attention(
+        q, kp, vp, tables, jnp.asarray(lengths, jnp.int32),
+        impl="pallas-interpret")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    ref = _dense_reference(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pallas_kernel_quantized_matches_dense_quantized():
+    """Int8 pools: the kernel's fused scalar dequant must equal the
+    dense reference's gather-then-dequantize, bit for bit up to f32
+    reassociation — and both must stay within the int8 tolerance of the
+    exact (unquantized) ground truth."""
+    q, kp, vp, tables, lengths, k, v = _ragged_case(3, lengths=[7, 12, 3])
+    qk, ksc = quantize_kv_pages(kp)
+    qv, vsc = quantize_kv_pages(vp)
+    ln = jnp.asarray(lengths, jnp.int32)
+    want = ragged_paged_attention(q, qk, qv, tables, ln, ksc, vsc,
+                                  impl="dense")
+    got = ragged_paged_attention(q, qk, qv, tables, ln, ksc, vsc,
+                                 impl="pallas-interpret")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+    exact = _dense_reference(q, k, v, lengths)
+    # vs the unquantized ground truth: int8 rounding plus the occasional
+    # clamped outlier token (anchored scales) — loose by construction.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exact),
+                               atol=0.2, rtol=0.2)
+
+
+def test_quantized_trash_pages_stay_zero_probability():
+    """The page-0 trash sink under quantization: saturate the trash page
+    AND its scales with enormous garbage — every output must still equal
+    the garbage-free reference exactly (blocks past `length` are
+    predicated out / NEG_INF-masked, so dequantized trash contributes
+    0.0, not approximately 0)."""
+    q, kp, vp, tables, lengths, k, v = _ragged_case(4, lengths=[5, 1])
+    qk, ksc = quantize_kv_pages(kp)
+    qv, vsc = quantize_kv_pages(vp)
+    # Poison the trash page: +-127 everywhere, colossal scales.
+    qk = qk.at[TRASH_PAGE].set(127)
+    qv = qv.at[TRASH_PAGE].set(127)
+    ksc = ksc.at[TRASH_PAGE].set(1e6)
+    vsc = vsc.at[TRASH_PAGE].set(1e6)
+    ln = jnp.asarray(lengths, jnp.int32)
+    ref = _dense_reference(q, k, v, lengths)
+    for impl in ("dense", "pallas-interpret"):
+        got = ragged_paged_attention(q, qk, qv, tables, ln, ksc, vsc,
+                                     impl=impl)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=0.2, rtol=0.2, err_msg=impl)
+    # And the trash poison must not leak between impls either.
+    d = ragged_paged_attention(q, qk, qv, tables, ln, ksc, vsc,
+                               impl="dense")
+    p = ragged_paged_attention(q, qk, qv, tables, ln, ksc, vsc,
+                               impl="pallas-interpret")
+    np.testing.assert_allclose(np.asarray(p), np.asarray(d),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_pallas_kernel_lowers_to_mosaic_custom_call():
+    """The lowered-HLO form of the kernel evidence (the bench's
+    flash_kernel_in_hlo analog, pinned without TPU hardware):
+    cross-platform export for the tpu target must carry the Mosaic
+    custom call — in BOTH the unquantized and int8 forms — proving the
+    fused kernel survives lowering, not just interpretation. Uses real
+    TPU-shaped operands (D=128, bs=16) so Mosaic's tiling checks run
+    for real."""
+    from jax import export as jexport
+
+    q = jnp.zeros((2, 1, 4, 128), jnp.float32)
+    kp = jnp.zeros((8, 2, 16, 128), jnp.float32)
+    vp = jnp.zeros((8, 2, 16, 128), jnp.float32)
+    bt = jnp.zeros((2, 4), jnp.int32)
+    ln = jnp.zeros((2,), jnp.int32)
+
+    def f(q, kp, vp, bt, ln):
+        return ragged_paged_attention(q, kp, vp, bt, ln, impl="pallas")
+
+    txt = jexport.export(jax.jit(f), platforms=["tpu"])(
+        q, kp, vp, bt, ln).mlir_module()
+    assert "tpu_custom_call" in txt or "mosaic" in txt.lower()
+
+    qk, ksc = quantize_kv_pages(kp)
+    qv, vsc = quantize_kv_pages(vp)
+
+    def g(q, kp, vp, bt, ln, ksc, vsc):
+        return ragged_paged_attention(q, kp, vp, bt, ln, ksc, vsc,
+                                      impl="pallas")
+
+    txt = jexport.export(jax.jit(g), platforms=["tpu"])(
+        q, qk, qv, bt, ln, ksc, vsc).mlir_module()
+    assert "tpu_custom_call" in txt or "mosaic" in txt.lower()
+
+
+def test_resolve_paged_impl():
+    assert resolve_paged_impl("dense", "tpu") == "dense"
+    assert resolve_paged_impl("dense", "cpu") == "dense"
+    assert resolve_paged_impl("auto", "tpu") == "pallas"
+    assert resolve_paged_impl("auto", "cpu") == "dense"
+    assert resolve_paged_impl("flash", "tpu") == "pallas"
+    assert resolve_paged_impl("flash", "cpu") == "pallas-interpret"
+    assert resolve_paged_impl("flash-interpret", "tpu") == "pallas-interpret"
+
+
+def test_paged_decode_step_resolves_attention_from_config():
+    """`attention=auto` extends to the paged decode site: the same
+    request decodes identically through the dense resolution (auto on
+    CPU) and the forced interpret-mode kernel (flash-interpret)."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray([[5, 7, 9]], jnp.int32)
+    table = jnp.asarray([1, 2, TRASH_PAGE, TRASH_PAGE], jnp.int32)
+
+    def run(config):
+        cache = init_paged_cache(config, num_blocks=8, block_size=4)
+        padded = jnp.zeros((1, 16), jnp.int32).at[:, :3].set(prompt)
+        logits, cache = paged_prefill(
+            params, padded, jnp.asarray(3, jnp.int32), config, cache,
+            table)
+        toks = [int(jnp.argmax(logits))]
+        length = 3
+        for _ in range(4):
+            logits, cache = paged_decode_step(
+                params, jnp.asarray([toks[-1]], jnp.int32), config, cache,
+                table[None, :], jnp.asarray([length], jnp.int32))
+            toks.append(int(jnp.argmax(logits[0])))
+            length += 1
+        return toks
+
+    auto = run(cfg)  # resolves dense on CPU
+    forced = run(get_config("llama-test", attention="flash-interpret"))
+    assert auto == forced
+
+
+# ------------------------------------------------------ quantized pools
+def test_gather_pages_dequantizes():
+    key = jax.random.PRNGKey(6)
+    k = jax.random.normal(key, (2, 8, 2, 16))
+    pages, tables = _paged_from_contiguous(k, np.array([8, 8]), 4, 16,
+                                           seed=9)
+    qp, sc = quantize_kv_pages(pages)
+    got = gather_pages(qp, tables, sc, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(k),
+                               atol=0.15, rtol=0.15)
+
+
+def test_quantized_paged_greedy_decode_tracks_unquantized():
+    """Model-level quantization contract: int8 pages reproduce the
+    unquantized greedy decode exactly for short continuations (the
+    exact-match pin) across mid-block, exact-block-boundary, and
+    single-token prompts."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    bs, width, n = 4, 16, 3
+    for plen in (3, 4, 1):  # mid-block, exact block boundary, single token
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(10 + plen), (1, plen), 0, cfg.vocab_size,
+            dtype=jnp.int32)
+        padded = jnp.concatenate(
+            [prompt[0], jnp.zeros((width - plen,), jnp.int32)])[None, :]
+        pages = list(range(1, 1 + blocks_for(plen + n, bs)))
+        table = (pages + [TRASH_PAGE] * 8)[:width // bs]
+        outs = {}
+        for kv_dtype in ("auto", "int8"):
+            cache = init_paged_cache(cfg, num_blocks=12, block_size=bs,
+                                     kv_dtype=kv_dtype)
+            logits, cache = paged_prefill(
+                params, padded, jnp.asarray(plen, jnp.int32), cfg, cache,
+                jnp.asarray(table, jnp.int32))[:2]
+            toks = [int(jnp.argmax(logits))]
+            bt = jnp.asarray([(pages + [TRASH_PAGE] * 8)[:6]], jnp.int32)
+            length = plen
+            for _ in range(n - 1):
+                logits, cache = paged_decode_step(
+                    params, jnp.asarray([toks[-1]], jnp.int32), cfg,
+                    cache, bt, jnp.asarray([length], jnp.int32))
+                toks.append(int(jnp.argmax(logits[0])))
+                length += 1
+            outs[kv_dtype] = toks
+        assert outs["int8"] == outs["auto"], (
+            f"int8 decode diverged on the short-sequence pin "
+            f"(plen {plen}): {outs['int8']} vs {outs['auto']}")
+
+
 def test_scatter_token_hits_page_and_trash():
     bs = 4
-    k_pages = jnp.zeros((8, bs, 2, 4))
-    v_pages = jnp.zeros((8, bs, 2, 4))
+    k_pages = jnp.zeros((8, 2, bs, 4))
+    v_pages = jnp.zeros((8, 2, bs, 4))
     k = jnp.ones((2, 1, 2, 4))
     v = 2 * jnp.ones((2, 1, 2, 4))
     # Seq 0 active at position 5 (page idx 1 of its table -> phys 3);
@@ -113,11 +347,11 @@ def test_scatter_token_hits_page_and_trash():
     tables = jnp.asarray([[2, 3], [TRASH_PAGE, TRASH_PAGE]], jnp.int32)
     positions = jnp.asarray([5, 0], jnp.int32)
     k2, v2 = scatter_token(k_pages, v_pages, k, v, tables, positions)
-    assert np.asarray(k2[3, 5 % bs]).sum() == 2 * 4  # ones landed
-    assert np.asarray(v2[3, 5 % bs]).sum() == 2 * 2 * 4
+    assert np.asarray(k2[3, :, 5 % bs]).sum() == 2 * 4  # ones landed
+    assert np.asarray(v2[3, :, 5 % bs]).sum() == 2 * 2 * 4
     # Inactive slot wrote only to the trash page; page 2 untouched.
     assert np.asarray(k2[2]).sum() == 0
-    assert np.asarray(k2[TRASH_PAGE, 0]).sum() != 0
+    assert np.asarray(k2[TRASH_PAGE, :, 0]).sum() != 0
 
 
 @pytest.mark.parametrize("name,over", [
@@ -186,5 +420,5 @@ def test_init_paged_cache_reserves_trash():
         init_paged_cache(cfg, num_blocks=1, block_size=4)
     cache = init_paged_cache(cfg, num_blocks=4, block_size=8)
     assert cache.num_blocks == 4 and cache.block_size == 8
-    assert cache.k.shape == (cfg.num_layers, 4, 8, cfg.num_kv_heads,
+    assert cache.k.shape == (cfg.num_layers, 4, cfg.num_kv_heads, 8,
                              cfg.head_dim)

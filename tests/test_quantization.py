@@ -1,0 +1,175 @@
+"""Int8 quantization: op-level parity per matmul, the anchored-KV-scale
+write-order invariance, and the end-to-end loss-delta pin.
+
+Mirrors how ops/flash_attention.py and ops/fused_ce.py are tested: each
+quantized matmul gets its own parity bound against the f32 operand, and
+one end-to-end pin (the perplexity delta of the quantized forward)
+bounds the compounded effect — so a regression names the layer that
+moved, not just "outputs differ".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.models import get_config, init_params
+from triton_kubernetes_tpu.models.llama import (
+    _QUANT_AXES_LAYERS,
+    forward,
+    quantize_weights,
+    resolve_weight,
+)
+from triton_kubernetes_tpu.ops.quantization import (
+    INT8_MAX,
+    dequantize_int8,
+    kv_quant_error,
+    quantize_int8,
+    quantize_kv_pages,
+    quantize_with_scale,
+    token_kv_scale,
+)
+
+
+# ------------------------------------------------------------- op level
+def test_quantize_int8_roundtrip_bound():
+    """Dequantization error is bounded by scale/2 per element (pure
+    rounding — the scale is exact for weights)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    q, scale = quantize_int8(x, axis=(0,))
+    assert q.dtype == jnp.int8 and scale.shape == (1, 32)
+    dq = dequantize_int8(q, scale, jnp.float32)
+    # 0.505: half-ulp slack for the f32 divide at round-to-even ties.
+    assert np.all(np.abs(np.asarray(dq - x)) < np.asarray(scale) * 0.505)
+    # Symmetric: the amax element maps to +-127 exactly.
+    assert int(np.abs(np.asarray(q)).max()) == int(INT8_MAX)
+
+
+def test_quantize_int8_zero_channel_is_safe():
+    x = jnp.zeros((8, 4))
+    q, scale = quantize_int8(x, axis=(0,))
+    assert np.all(np.asarray(q) == 0) and np.all(np.asarray(scale) > 0)
+
+
+@pytest.mark.parametrize("name", sorted(
+    set(_QUANT_AXES_LAYERS) - {"moe_w1", "moe_w2", "moe_w3"}) + ["lm_head"])
+def test_per_matmul_weight_parity(name):
+    """Each quantized matmul's output stays within ~1% relative error of
+    the f32 matmul — the per-op bound the e2e pin builds on."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams, qcfg = quantize_weights(params, cfg)
+    w = params["layers"][name] if name != "lm_head" else params[name]
+    qw = qparams["layers"][name] if name != "lm_head" else qparams[name]
+    dq = resolve_weight(qw, jnp.float32)
+    assert qw["q"].dtype == jnp.int8
+    assert dq.shape == w.shape
+    # Contract a random activation over the matmul's contraction axes
+    # (exactly what the einsum does), leaving the per-scale output
+    # channels: the parity metric is the output-norm relative error.
+    axes = _QUANT_AXES_LAYERS.get(name, (0,))
+    x = jax.random.normal(
+        jax.random.PRNGKey(1), tuple(w.shape[a] for a in axes))
+    ref = jnp.tensordot(x, w, axes=(tuple(range(len(axes))), axes))
+    got = jnp.tensordot(x, dq, axes=(tuple(range(len(axes))), axes))
+    rel = float(jnp.linalg.norm(got - ref) / (jnp.linalg.norm(ref) + 1e-9))
+    assert rel < 0.02, f"{name}: rel err {rel}"
+    # Elementwise bound: per-channel rounding only.
+    err = np.abs(np.asarray(dq - w))
+    assert err.max() <= float(np.asarray(qw["scale"]).max()) / 2 + 1e-7
+
+
+def test_quantize_weights_structure_and_idempotence():
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams, qcfg = quantize_weights(params, cfg)
+    assert qcfg.weight_quant == "int8"
+    # Untouched leaves: embed (gather), norms; master tree unmodified.
+    assert qparams["embed"] is params["embed"]
+    assert qparams["layers"]["attn_norm"] is params["layers"]["attn_norm"]
+    assert params["layers"]["wq"].dtype == cfg.weight_dtype
+    # Idempotent: quantizing the quantized pair is the identity.
+    again, cfg2 = quantize_weights(qparams, qcfg)
+    assert again is qparams and cfg2 is qcfg
+
+
+def test_weight_quant_loss_delta_pin():
+    """The e2e pin: per-token cross-entropy of the int8-weight forward
+    tracks f32 within a pinned delta (perplexity ratio < e^0.05)."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams, qcfg = quantize_weights(params, cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+
+    def ce(p, c):
+        logits, _ = forward(p, tokens, c)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        tgt = tokens[:, 1:]
+        return -float(jnp.mean(
+            jnp.take_along_axis(logp, tgt[..., None], axis=-1)))
+
+    delta = abs(ce(qparams, qcfg) - ce(params, cfg))
+    assert delta < 0.05, f"loss delta {delta} exceeds the pin"
+
+
+def test_moe_weights_quantize():
+    cfg = get_config("mixtral-test", capacity_factor=2.0)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams, qcfg = quantize_weights(params, cfg)
+    assert qparams["layers"]["moe_w1"]["q"].dtype == jnp.int8
+    # Router stays full precision (tiny, routing-sensitive).
+    assert qparams["layers"]["router"] is params["layers"]["router"]
+    logits, _ = forward(params, jnp.ones((1, 8), jnp.int32), cfg)
+    qlogits, _ = forward(qparams, jnp.ones((1, 8), jnp.int32), qcfg)
+    np.testing.assert_allclose(np.asarray(qlogits), np.asarray(logits),
+                               atol=0.2)
+
+
+# -------------------------------------------------- anchored KV scales
+def test_kv_page_quantization_write_order_invariance():
+    """THE anchored-scale contract: a page quantized whole (prefill's
+    scatter) is bitwise identical to the same page written token by
+    token with :func:`scatter_token`'s rule — first slot anchors the
+    scale, later slots quantize against it. This is what makes
+    preemption's re-prefill reproduce decode's pages exactly."""
+    from triton_kubernetes_tpu.ops.paged_attention import scatter_token
+
+    rng = np.random.default_rng(5)
+    bs, hkv, d = 8, 2, 16
+    content = jnp.asarray(rng.standard_normal((bs, hkv, d)), jnp.float32)
+    # Whole-page quantization takes the head-major page plane.
+    whole_q, whole_s = quantize_kv_pages(content.transpose(1, 0, 2)[None])
+
+    kp = jnp.zeros((4, hkv, bs, d), jnp.int8)
+    vp = jnp.zeros((4, hkv, bs, d), jnp.int8)
+    ks = jnp.zeros((4, hkv), jnp.float32)
+    vs = jnp.zeros((4, hkv), jnp.float32)
+    table = jnp.asarray([[2]], jnp.int32)
+    for pos in range(bs):
+        tok = content[None, None, pos]
+        kp, vp, ks, vs = scatter_token(
+            kp, vp, tok, tok, table, jnp.asarray([pos], jnp.int32), ks, vs)
+    np.testing.assert_array_equal(np.asarray(kp[2]), np.asarray(whole_q[0]))
+    np.testing.assert_array_equal(np.asarray(ks[2]), np.asarray(whole_s[0]))
+
+
+def test_token_kv_scale_headroom_and_floor():
+    tok = jnp.ones((2, 3, 4))
+    s = token_kv_scale(tok)
+    assert s.shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(s), 2.0 / 127.0, rtol=1e-6)
+    assert float(token_kv_scale(jnp.zeros((1, 1, 4)))[0, 0]) > 0
+
+
+def test_quantize_with_scale_clamps():
+    q = quantize_with_scale(jnp.asarray([1000.0, -1000.0, 0.5]),
+                            jnp.asarray(1.0))
+    assert list(np.asarray(q)) == [127, -127, 0]
+
+
+def test_kv_quant_error_scalar():
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 2, 8, 16))
+    q, s = quantize_kv_pages(x)  # [N, Hkv, bs, D] -> scales [N, Hkv]
+    err = float(kv_quant_error(q, s[:, :, None, None], x))
+    assert 0 < err < 0.05  # int8 KV is near-lossless

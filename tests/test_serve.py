@@ -69,8 +69,8 @@ def test_allocator_lowest_first_and_double_free():
 
 
 # -------------------------------------------------------------- engine
-def solo_run(model, prompt, n, **req_over):
-    eng = make_engine(model)
+def solo_run(model, prompt, n, engine=None, **req_over):
+    eng = make_engine(model, **(engine or {}))
     eng.submit(Request("solo", list(prompt), n, **req_over))
     done = eng.run_until_idle()
     assert len(done) == 1 and eng.allocator.in_use == 0
@@ -146,6 +146,93 @@ def test_churn_matches_solo_and_pool_drains(model):
         assert results[f"r{i}"].tokens == solos[i], f"r{i} diverged"
     assert eng.allocator.in_use == 0, "leaked KV pages"
     assert eng.allocator.available == eng.allocator.capacity
+
+
+def test_quantized_churn_preemption_requantizes_identically(model):
+    """The recompute-on-readmit contract under int8 pages: the churn
+    scenario forces a preemption of a sequence whose pages are
+    QUANTIZED; readmission re-prefills prompt + tokens-so-far, and the
+    anchored-scale rule keeps the quantizer write-order invariant (no
+    NEW divergence source on top of the forward-path numerics the
+    unquantized churn pin already bounds) — so every completion still
+    equals its quantized solo run, token for token, and the pool
+    drains."""
+    prompts = [
+        ([5, 7, 9, 11, 2, 4, 6, 8], 16),
+        ([3, 1, 4, 1, 5, 9, 2, 6], 16),
+        ([2, 2, 2], 5),
+        ([9, 8, 7, 6, 5, 4, 3, 2, 1, 0, 1, 2, 3], 7),
+    ]
+    solos = [solo_run(model, p, n, engine=dict(kv_dtype="int8"))
+             for p, n in prompts]
+    eng = make_engine(model, num_blocks=10, max_batch=3, max_model_len=32,
+                      kv_dtype="int8")
+    arrivals = {0: [0], 1: [1, 2], 3: [3]}
+    results = {}
+    step = 0
+    while eng.has_work or step < 5:
+        for idx in arrivals.get(step, []):
+            p, n = prompts[idx]
+            eng.submit(Request(f"r{idx}", p, n))
+        for d in eng.step():
+            results[d.request_id] = d
+        step += 1
+        assert step < 500, "engine failed to drain"
+    assert metrics.counter("tk8s_serve_preemptions_total").value() >= 1
+    assert any(d.preemptions > 0 for d in results.values()), (
+        "scenario no longer preempts — the requant-parity pin is vacuous")
+    for i, _ in enumerate(prompts):
+        assert results[f"r{i}"].tokens == solos[i], f"r{i} diverged"
+    assert eng.allocator.in_use == 0, "leaked KV pages"
+
+
+def test_quantized_engine_matches_unquantized_on_short_pin(model):
+    """The exact-match pin: a short request's greedy output is identical
+    between the int8 and unquantized engines (longer continuations are
+    covered by the tolerance gate in scripts/ci/quant_evidence.py)."""
+    want = solo_run(model, [5, 7, 9, 11, 2], 3)
+    got = solo_run(model, [5, 7, 9, 11, 2], 3,
+                   engine=dict(kv_dtype="int8"))
+    assert got == want
+
+
+def test_quantized_engine_gauges(model):
+    metrics.configure()
+    eng = make_engine(model, kv_dtype="int8")
+    pages = metrics.gauge("tk8s_serve_kv_bytes").value(component="pages")
+    scales = metrics.gauge("tk8s_serve_kv_bytes").value(component="scales")
+    assert pages == eng.cache.pool_bytes > 0
+    assert scales == eng.cache.scale_bytes > 0
+    # int8 pages: a quarter of the f32 pool at the same geometry.
+    metrics.configure()
+    ref = make_engine(model)
+    assert ref.cache.pool_bytes == 4 * eng.cache.pool_bytes
+    assert metrics.gauge("tk8s_serve_quant_error").value(tensor="k") == 0
+    eng.submit(Request("r", [1, 2, 3], 2))
+    eng.run_until_idle()
+    assert metrics.gauge("tk8s_serve_quant_error").value(tensor="k") > 0
+    assert metrics.gauge("tk8s_serve_quant_error").value(tensor="v") > 0
+    assert eng.stats()["kv_dtype"] == "int8"
+    assert eng.stats()["kv_pool_bytes"] == (eng.cache.pool_bytes
+                                            + eng.cache.scale_bytes)
+
+
+def test_weight_quantized_engine_serves(model):
+    """--weight-dtype int8: the engine quantizes per-channel on init
+    (config and params rewritten together) and decodes
+    deterministically; the caller's master params are untouched."""
+    cfg, params = model
+    eng = make_engine(model, weight_dtype="int8")
+    assert eng.config.weight_quant == "int8"
+    assert isinstance(eng.params["layers"]["wq"], dict)
+    assert params["layers"]["wq"].dtype == cfg.weight_dtype  # untouched
+    a = solo_run(model, [4, 5, 6], 4, engine=dict(weight_dtype="int8"))
+    b = solo_run(model, [4, 5, 6], 4, engine=dict(weight_dtype="int8"))
+    assert a == b and len(a) == 4
+    with pytest.raises(KeyError, match="weight_dtype"):
+        make_engine(model, weight_dtype="fp4")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        make_engine(model, kv_dtype="fp8")
 
 
 def test_seeded_sampling_independent_of_batch(model):
@@ -324,11 +411,14 @@ def test_cli_has_serve_verb():
     args = build_parser().parse_args(
         ["serve", "--model", "llama-test", "--port", "0",
          "--block-size", "8", "--num-blocks", "32", "--max-batch", "2",
-         "--sequential"])
+         "--kv-dtype", "int8", "--weight-dtype", "int8", "--sequential"])
     assert args.command == "serve"
     assert args.model == "llama-test"
     assert args.block_size == 8 and args.num_blocks == 32
+    assert args.kv_dtype == "int8" and args.weight_dtype == "int8"
     assert args.sequential
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["serve", "--kv-dtype", "fp8"])
 
 
 def test_serve_port_matches_topology_pin():

@@ -22,6 +22,7 @@ from typing import List, Optional
 
 from .. import __version__
 from ..backends import Backend, LocalBackend, ObjectStoreBackend
+from ..constants import KV_DTYPES, WEIGHT_DTYPES
 from ..backends.objectstore import DirObjectStore
 from ..backends.base import StateLockedError, StateNotFoundError
 from ..backends.gcs import GcsConfigError
@@ -272,6 +273,21 @@ def build_parser() -> argparse.ArgumentParser:
                        metavar="N",
                        help="cap on prompt + generated tokens per sequence "
                             "(default: the model's max_seq_len)")
+    serve.add_argument("--kv-dtype", default="auto",
+                       choices=list(KV_DTYPES), metavar="DTYPE",
+                       help="KV-cache page storage: auto = the model "
+                            "config's activation dtype, bf16 = force "
+                            "bfloat16 pages, int8 = quantized pages with "
+                            "per-page-per-head f32 scales — ~4x fewer "
+                            "pool bytes than f32 (~2x vs bf16), i.e. "
+                            "that many more concurrent sequences per "
+                            "chip (docs/guide/serving.md §Quantization)")
+    serve.add_argument("--weight-dtype", default="auto",
+                       choices=list(WEIGHT_DTYPES), metavar="DTYPE",
+                       help="decode weight storage: int8 = per-channel "
+                            "symmetric quantization of the big matmuls "
+                            "(embed/norms/router stay full precision; "
+                            "the caller's f32 master tree is untouched)")
     serve.add_argument("--sequential", action="store_true",
                        help="serve one request at a time (the continuous-"
                             "batching A/B baseline; scripts/ci/"
@@ -393,13 +409,15 @@ def main(argv: Optional[List[str]] = None,
             model_config,
             block_size=args.block_size, num_blocks=args.num_blocks,
             max_batch=args.max_batch, max_model_len=args.max_model_len,
-            sequential=args.sequential)
+            sequential=args.sequential,
+            kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype)
         server = ServeHTTPServer(engine, host=args.serve_host,
                                  port=args.port)
         host, port = server.address
         logger.info("serving", url=f"http://{host}:{port}",
                     model=args.model, block_size=args.block_size,
-                    num_blocks=args.num_blocks, max_batch=args.max_batch)
+                    num_blocks=args.num_blocks, max_batch=args.max_batch,
+                    kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype)
         print(f"serving {args.model} on http://{host}:{port} "
               f"(POST /generate, GET /metrics, GET /healthz)", flush=True)
         try:

@@ -49,3 +49,12 @@ EXIT_CONFIG = 2
 EXIT_ANOMALY = 4
 EXIT_UNSUPPORTED = 69
 EXIT_RESUME = 75
+
+# Serving quantization knobs (`tk8s serve --kv-dtype/--weight-dtype`).
+# They cross the jax boundary the same way the ports do: the CLI parser
+# registers them on jax-less machines while models/paged.py
+# (init_paged_cache) and train/precision.py (quantize_for_decode)
+# validate them at runtime — one tuple here keeps argparse and the
+# engine from ever drifting.
+KV_DTYPES = ("auto", "bf16", "int8")
+WEIGHT_DTYPES = ("auto", "int8")

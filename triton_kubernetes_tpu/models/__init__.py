@@ -13,7 +13,7 @@ from .config import (
     ModelConfig,
     get_config,
 )
-from .llama import forward, init_params, logical_axes
+from .llama import forward, init_params, logical_axes, quantize_weights
 from .generate import (
     KVCache,
     decode_step,
@@ -23,6 +23,7 @@ from .generate import (
     sample_token,
 )
 from .paged import (
+    KV_DTYPES,
     PagedKVCache,
     init_paged_cache,
     paged_decode_step,
@@ -37,6 +38,7 @@ __all__ = [
     "forward",
     "init_params",
     "logical_axes",
+    "quantize_weights",
     "mixtral",
     "KVCache",
     "init_cache",
@@ -44,6 +46,7 @@ __all__ = [
     "decode_step",
     "generate",
     "sample_token",
+    "KV_DTYPES",
     "PagedKVCache",
     "init_paged_cache",
     "paged_prefill",

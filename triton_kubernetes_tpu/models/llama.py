@@ -66,6 +66,70 @@ def resolve_attention(config: ModelConfig,
     return auto_attention(platform) if platform is not None else None
 
 
+def resolve_weight(w: Any, ad: jnp.dtype) -> jnp.ndarray:
+    """The matmul operand for a (possibly quantized) weight leaf.
+
+    Plain arrays cast to the activation dtype as ever; an int8 leaf
+    (the ``{"q", "scale"}`` pair :func:`quantize_weights` produces)
+    dequantizes per-channel at its point of use — XLA fuses the scale
+    multiply into the consuming matmul, and on TPU the HBM read is the
+    int8 tensor, which is the whole win. Keying off the leaf structure
+    (not a config flag) means a params tree can never be half-honored:
+    whatever tree arrives is computed correctly.
+    """
+    if isinstance(w, dict):
+        return (w["q"].astype(jnp.float32) * w["scale"]).astype(ad)
+    return w.astype(ad)
+
+
+# Weight leaf -> axes its matmul contracts over (per-channel int8 scales
+# reduce exactly these, keeping one scale per OUTPUT channel per layer).
+# Stacked layer weights carry a leading L axis, hence the +1 offsets.
+_QUANT_AXES_LAYERS: Dict[str, Tuple[int, ...]] = {
+    "wq": (1,), "wk": (1,), "wv": (1,),   # [L, d, h, k]: contract d
+    "wo": (1, 2),                          # [L, h, k, d]: contract h, k
+    "w1": (1,), "w3": (1,),                # [L, d, f]: contract d
+    "w2": (1,),                            # [L, f, d]: contract f
+    "moe_w1": (2,), "moe_w3": (2,),        # [L, e, d, f]: contract d
+    "moe_w2": (2,),                        # [L, e, f, d]: contract f
+}
+
+
+def quantize_weights(params: Params, config: ModelConfig,
+                     ) -> Tuple[Params, ModelConfig]:
+    """Per-channel symmetric int8 for the big decode matmuls.
+
+    Returns a NEW ``(params, config)`` pair: every weight named in
+    :data:`_QUANT_AXES_LAYERS` plus ``lm_head`` becomes a
+    ``{"q": int8, "scale": f32}`` leaf, and the config records
+    ``weight_quant="int8"`` — the two rewrites travel together (the
+    apply-policy shape from train/precision.py), so a half-applied
+    state cannot exist. The caller's f32 master tree is untouched
+    (pure function); ``embed`` (a gather, not a matmul), the MoE
+    router (tiny, routing-sensitive), and the norms stay full
+    precision. Idempotent: quantizing twice is the identity.
+    """
+    from dataclasses import replace
+
+    from ..ops.quantization import quantize_int8
+
+    if config.weight_quant == "int8":
+        return params, config
+
+    def qleaf(w, axes):
+        q, scale = quantize_int8(w, axes)
+        return {"q": q, "scale": scale}
+
+    layers = dict(params["layers"])
+    for name, axes in _QUANT_AXES_LAYERS.items():
+        if name in layers:
+            layers[name] = qleaf(layers[name], axes)
+    new = dict(params)
+    new["layers"] = layers
+    new["lm_head"] = qleaf(params["lm_head"], (0,))  # [d, v]: contract d
+    return new, replace(config, weight_quant="int8")
+
+
 def remat_block(body: Callable, config: ModelConfig) -> Callable:
     """Apply the configured rematerialization policy to a block body —
     the single source of the remat knob for the sequential stack and the
@@ -161,9 +225,9 @@ def _qkv(x: jnp.ndarray, layer: Params, config: ModelConfig,
     """Projected + rotary-encoded q/k/v for a block input ([B, S, ...])."""
     ad = config.activation_dtype
     h = rms_norm(x, layer["attn_norm"], config.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(ad))
-    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(ad))
-    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(ad))
+    q = jnp.einsum("bsd,dhk->bshk", h, resolve_weight(layer["wq"], ad))
+    k = jnp.einsum("bsd,dhk->bshk", h, resolve_weight(layer["wk"], ad))
+    v = jnp.einsum("bsd,dhk->bshk", h, resolve_weight(layer["wv"], ad))
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
     return q, k, v
@@ -176,7 +240,7 @@ def _mlp(x: jnp.ndarray, layer: Params, config: ModelConfig,
     ad = config.activation_dtype
 
     def w(name):
-        return layer[name].astype(ad)
+        return resolve_weight(layer[name], ad)
 
     h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
     if config.is_moe:
@@ -282,7 +346,7 @@ def final_norm_hidden(x: jnp.ndarray, params: Params,
 def head_weights(params: Params, config: ModelConfig) -> jnp.ndarray:
     """The lm head matrix in activation dtype — the exact operand
     ``unembed`` contracts with."""
-    return params["lm_head"].astype(config.activation_dtype)
+    return resolve_weight(params["lm_head"], config.activation_dtype)
 
 
 def unembed(x: jnp.ndarray, params: Params, config: ModelConfig):
@@ -298,4 +362,4 @@ def project_out(x: jnp.ndarray, attn: jnp.ndarray, layer: Params,
     """Attention output projection + residual add."""
     return x + jnp.einsum(
         "bshk,hkd->bsd", attn,
-        layer["wo"].astype(config.activation_dtype))
+        resolve_weight(layer["wo"], config.activation_dtype))

@@ -12,7 +12,8 @@ tests/test_paged_attention.py). What changes is the cache layout:
   (every sequence pays ``max_len``, and batch membership is baked into
   the array).
 * :class:`PagedKVCache` is a static pool of fixed-size pages
-  (``[L, num_blocks, block_size, Hkv, Dh]``) plus per-sequence block
+  (``[L, num_blocks, Hkv, block_size, Dh]`` — head-major, the TPU
+  kernel's tiling-friendly page plane) plus per-sequence block
   tables owned by the scheduler (``serve/``). Admitting, growing, or
   evicting a sequence mutates *table entries*, never array shapes, so
   the batched decode step compiles exactly once.
@@ -25,16 +26,37 @@ takes real lengths as *data* (int32 operands), never as Python ints.
 Page 0 is the shared trash page (``ops.paged_attention.TRASH_PAGE``):
 padded table entries and inactive batch slots scatter/gather there, and
 position masking keeps its garbage out of every real sequence's support.
+
+Quantized pools (``kv_dtype="int8"``): pages hold int8 K/V and the cache
+carries per-page-per-head f32 scales (``[L, num_blocks, Hkv]``) —
+roughly ``block_size * Dh / 1`` data bytes per 4 scale bytes, so pool
+memory drops by ~4x vs f32 pages (~2x vs bf16), which is that many more
+concurrent sequences per chip. Writes quantize (anchored scales,
+``ops/quantization.py`` — the quantizer is write-order invariant, so
+preemption's re-prefill adds no quantization-order divergence on top of
+the forward-path numerics); reads dequantize fused into the attention
+compute. The full-precision pool never exists.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple, Union
 
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.paged_attention import ragged_paged_attention, scatter_token
+# The serve/CLI-facing page-storage knob (``tk8s serve --kv-dtype``):
+# "auto" stores pages in the model's activation dtype (the pre-quant
+# behavior), "bf16" forces bfloat16 pages, "int8" turns on quantized
+# pages + scales. Pinned in constants.py (the CLI registers the choices
+# on jax-less machines; this module validates them at runtime).
+from ..constants import KV_DTYPES
+from ..ops.paged_attention import (
+    ragged_paged_attention,
+    resolve_paged_impl,
+    scatter_token,
+)
+from ..ops.quantization import kv_quant_error, quantize_kv_pages
 from ..ops.rotary import rotary_tables
 from .config import ModelConfig
 from . import llama
@@ -43,10 +65,14 @@ from .generate import init_cache, prefill
 
 class PagedKVCache(NamedTuple):
     """The static page pool. Per-sequence block tables live with the
-    scheduler, not here — the pool is just memory."""
+    scheduler, not here — the pool is just memory. ``k_scale``/
+    ``v_scale`` are present exactly when the pool is int8 (per-page-
+    per-head anchored scales)."""
 
-    k: jnp.ndarray  # [L, num_blocks, block_size, Hkv, Dh]
-    v: jnp.ndarray  # [L, num_blocks, block_size, Hkv, Dh]
+    k: jnp.ndarray  # [L, num_blocks, Hkv, block_size, Dh]
+    v: jnp.ndarray  # [L, num_blocks, Hkv, block_size, Dh]
+    k_scale: Optional[jnp.ndarray] = None  # [L, num_blocks, Hkv] f32
+    v_scale: Optional[jnp.ndarray] = None  # [L, num_blocks, Hkv] f32
 
     @property
     def num_blocks(self) -> int:
@@ -54,22 +80,53 @@ class PagedKVCache(NamedTuple):
 
     @property
     def block_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k.dtype == jnp.int8
+
+    @property
+    def pool_bytes(self) -> int:
+        """Device bytes of the K/V page arrays (scales excluded)."""
+        return self.k.nbytes + self.v.nbytes
+
+    @property
+    def scale_bytes(self) -> int:
+        if self.k_scale is None:
+            return 0
+        return self.k_scale.nbytes + self.v_scale.nbytes
 
 
 def init_paged_cache(config: ModelConfig, num_blocks: int,
-                     block_size: int) -> PagedKVCache:
+                     block_size: int,
+                     kv_dtype: str = "auto") -> PagedKVCache:
     if num_blocks < 2:
         raise ValueError(
             f"num_blocks must be >= 2 (page 0 is the reserved trash page), "
             f"got {num_blocks}")
-    shape = (config.num_layers, num_blocks, block_size,
-             config.num_kv_heads, config.head_dim)
-    # Two distinct buffers, never one aliased zeros array: the engine
-    # donates k and v to its jitted steps, and XLA rejects donating the
-    # same buffer twice.
-    return PagedKVCache(k=jnp.zeros(shape, config.activation_dtype),
-                        v=jnp.zeros(shape, config.activation_dtype))
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+    shape = (config.num_layers, num_blocks, config.num_kv_heads,
+             block_size, config.head_dim)
+    if kv_dtype == "int8":
+        dtype: jnp.dtype = jnp.dtype(jnp.int8)
+    elif kv_dtype == "bf16":
+        dtype = jnp.dtype(jnp.bfloat16)
+    else:
+        dtype = config.activation_dtype
+    # Distinct buffers, never one aliased zeros array: the engine
+    # donates every pool array to its jitted steps, and XLA rejects
+    # donating the same buffer twice.
+    if kv_dtype == "int8":
+        sshape = (config.num_layers, num_blocks, config.num_kv_heads)
+        return PagedKVCache(
+            k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+            k_scale=jnp.zeros(sshape, jnp.float32),
+            v_scale=jnp.zeros(sshape, jnp.float32))
+    return PagedKVCache(k=jnp.zeros(shape, dtype),
+                        v=jnp.zeros(shape, dtype))
 
 
 def paged_prefill(
@@ -79,10 +136,17 @@ def paged_prefill(
     config: ModelConfig,
     cache: PagedKVCache,
     block_table: jnp.ndarray,  # [P // block_size] int32 physical pages
-) -> Tuple[jnp.ndarray, PagedKVCache]:
+    with_quant_error: bool = False,
+) -> Union[Tuple[jnp.ndarray, PagedKVCache],
+           Tuple[jnp.ndarray, PagedKVCache, Tuple[jnp.ndarray,
+                                                  jnp.ndarray]]]:
     """Run one right-padded prompt and land its K/V in pages.
 
-    Returns (logits [V] f32 at the last *real* token, updated pool).
+    Returns (logits [V] f32 at the last *real* token, updated pool) —
+    plus a ``(k_err, v_err)`` pair of device scalars (mean relative
+    dequantization error of the scattered pages, the
+    ``tk8s_serve_quant_error`` gauge's source) when ``with_quant_error``
+    is set on a quantized pool.
 
     Right-padding is the load-bearing choice: with causal masking, pad
     tokens sit at positions > length-1 and cannot perturb any real
@@ -103,21 +167,53 @@ def paged_prefill(
         raise ValueError(
             f"block_table must cover the padded prompt: expected shape "
             f"({t},), got {block_table.shape}")
+    if with_quant_error and not cache.quantized:
+        raise ValueError("with_quant_error only applies to int8 pools")
     contiguous = init_cache(config, 1, p)
     # Unembed only the last real position: the full padded-width logits
     # would be the admission's largest buffer (generate.prefill docstring).
     logits, contiguous = prefill(params, tokens, config, contiguous,
                                  last_position=(length - 1)[None])
     last = logits[0, 0]  # [V]
-    # [L, 1, P, Hkv, Dh] -> [L, T, bs, Hkv, Dh], scattered to this
-    # sequence's pages. Padded table entries (trash) take pad garbage;
-    # partially-filled last pages carry pad garbage above `length` until
-    # decode overwrites those slots one token at a time.
+    # [L, 1, P, Hkv, Dh] -> [L, T, Hkv, bs, Dh] (the head-major page
+    # plane: split tokens into pages, then swap heads ahead of slots),
+    # scattered to this sequence's pages. Padded table entries (trash)
+    # take pad garbage; partially-filled last pages carry pad garbage
+    # above `length` until decode overwrites those slots one at a time.
     ll = config.num_layers
-    k = contiguous.k.reshape(ll, t, bs, *contiguous.k.shape[3:])
-    v = contiguous.v.reshape(ll, t, bs, *contiguous.v.shape[3:])
-    return last, PagedKVCache(k=cache.k.at[:, block_table].set(k),
-                              v=cache.v.at[:, block_table].set(v))
+    k = jnp.transpose(
+        contiguous.k.reshape(ll, t, bs, *contiguous.k.shape[3:]),
+        (0, 1, 3, 2, 4))
+    v = jnp.transpose(
+        contiguous.v.reshape(ll, t, bs, *contiguous.v.shape[3:]),
+        (0, 1, 3, 2, 4))
+    if not cache.quantized:
+        # Explicit cast: kv_dtype="bf16" pools under an f32 activation
+        # config downcast on write, exactly as the decode scatter does.
+        return last, cache._replace(
+            k=cache.k.at[:, block_table].set(k.astype(cache.k.dtype)),
+            v=cache.v.at[:, block_table].set(v.astype(cache.v.dtype)))
+    # Anchored whole-page quantization: identical, slot for slot, to
+    # what token-at-a-time decode writes produce for the same token
+    # values — the quantizer's contribution to the recompute-on-readmit
+    # (preemption) parity contract (ops/quantization.py docstring).
+    qk, sk = quantize_kv_pages(k)
+    qv, sv = quantize_kv_pages(v)
+    new = PagedKVCache(
+        k=cache.k.at[:, block_table].set(qk),
+        v=cache.v.at[:, block_table].set(qv),
+        k_scale=cache.k_scale.at[:, block_table].set(sk),
+        v_scale=cache.v_scale.at[:, block_table].set(sv))
+    if not with_quant_error:
+        return last, new
+    # Error over REAL slots only: pad garbage above `length` and
+    # trash-table pages would otherwise dominate the gauge.
+    slot = (jnp.arange(t, dtype=jnp.int32)[:, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)[None, :])  # [T, bs]
+    mask = (slot < length)[None, :, None, :, None]
+    err = (kv_quant_error(qk, sk[:, :, :, None, None], k, mask),
+           kv_quant_error(qv, sv[:, :, :, None, None], v, mask))
+    return last, new, err
 
 
 def paged_decode_step(
@@ -127,6 +223,7 @@ def paged_decode_step(
     cache: PagedKVCache,
     block_tables: jnp.ndarray,  # [B, T] int32
     lengths: jnp.ndarray,  # [B] int32 — tokens already written per seq
+    attention_impl: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """One ragged decode step: returns (logits [B, V] f32, updated pool).
 
@@ -135,25 +232,54 @@ def paged_decode_step(
     batch slots ride along with an all-trash table and length 0 — their
     logits are garbage the scheduler discards, their writes hit only the
     trash page, and their cost is what static shapes buy us.
+
+    ``attention_impl`` picks the ragged-attention implementation
+    ("dense" reference einsum, "pallas" fused kernel,
+    "pallas-interpret"); None resolves it from ``config.attention`` and
+    the current backend (``ops.paged_attention.resolve_paged_impl``) —
+    the paged-decode site of the ``attention=auto`` contract.
     """
+    if attention_impl is None:
+        attention_impl = resolve_paged_impl(config.attention)
     b = token.shape[0]
     ad = config.activation_dtype
     positions = lengths[:, None].astype(jnp.int32)  # [B, 1] — ragged!
     cos, sin = rotary_tables(
         config.head_dim, config.max_seq_len, config.rope_theta)
     x = params["embed"].astype(ad)[token[:, None]]  # [B, 1, D]
+    quantized = cache.quantized
 
     def body(carry, layer_and_pages):
         x = carry
-        layer, kp, vp = layer_and_pages
+        if quantized:
+            layer, kp, vp, ks, vs = layer_and_pages
+        else:
+            layer, kp, vp = layer_and_pages
+            ks = vs = None
         q, k, v = llama._qkv(x, layer, config, cos, sin, positions)
-        kp, vp = scatter_token(kp, vp, k, v, block_tables, lengths)
+        written = scatter_token(kp, vp, k, v, block_tables, lengths,
+                                ks, vs)
+        if quantized:
+            kp, vp, ks, vs = written
+        else:
+            kp, vp = written
         attn = ragged_paged_attention(
-            q, kp, vp, block_tables, lengths + 1)
+            q, kp, vp, block_tables, lengths + 1, ks, vs,
+            impl=attention_impl)
         x = llama.project_out(x, attn, layer, config)
         y, _ = llama._mlp(x, layer, config)
+        if quantized:
+            return x + y, (kp, vp, ks, vs)
         return x + y, (kp, vp)
 
-    x, (kp, vp) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    if quantized:
+        x, (kp, vp, ks, vs) = lax.scan(
+            body, x, (params["layers"], cache.k, cache.v,
+                      cache.k_scale, cache.v_scale))
+        new_cache = PagedKVCache(k=kp, v=vp, k_scale=ks, v_scale=vs)
+    else:
+        x, (kp, vp) = lax.scan(
+            body, x, (params["layers"], cache.k, cache.v))
+        new_cache = PagedKVCache(k=kp, v=vp)
     logits = llama.unembed(x, params, config)[:, 0, :]
-    return logits, PagedKVCache(k=kp, v=vp)
+    return logits, new_cache

@@ -1,7 +1,9 @@
 """Ragged paged-attention decode over a block-paged KV cache.
 
 The serving engine (``serve/``) keeps K/V in fixed-size **pages** drawn
-from one static pool (``[num_blocks, block_size, Hkv, Dh]`` per layer)
+from one static pool (``[num_blocks, Hkv, block_size, Dh]`` per layer —
+head-major, so one (page, head) tile is a ``[block_size, Dh]`` plane
+whose trailing dims are exactly what Mosaic's (8, 128) tiling wants)
 instead of one contiguous ``[B, max_len, ...]`` strip per sequence. A
 per-sequence **block table** maps logical block ``j`` (tokens
 ``j*block_size .. (j+1)*block_size-1``) to a physical page, so sequences
@@ -14,38 +16,68 @@ This module is the op layer of that design, kept at the same altitude as
 ``ops/attention.py``:
 
 * :func:`gather_pages` — K or V for a batch of sequences, gathered
-  through their block tables into logical-token order;
+  through their block tables into logical-token order (dequantizing when
+  the pool is int8);
 * :func:`ragged_paged_attention` — one decode step of attention for a
   batch at **heterogeneous** positions (each query at its own
-  ``length-1``), reusing :func:`~.attention.causal_attention`'s explicit
-  position masking so logical slots past a sequence's length — including
-  whole table entries that still point at the shared trash page —
-  contribute *exactly zero* (``exp(NEG_INF - m)`` underflows to 0.0), not
-  approximately zero.
+  ``length-1``). The **dense impl is the reference**: it reuses
+  :func:`~.attention.causal_attention`'s explicit position masking so
+  logical slots past a sequence's length — including whole table entries
+  that still point at the shared trash page — contribute *exactly zero*
+  (``exp(NEG_INF - m)`` underflows to 0.0), not approximately zero.
+* the **fused Pallas kernel** (``impl="pallas"``) — the "Ragged Paged
+  Attention" TPU shape (PAPERS.md): the block table rides as a
+  scalar-prefetch operand, so each grid step's BlockSpec index map reads
+  ``table[b, t]`` and Mosaic DMAs exactly that physical page HBM->VMEM —
+  gather and flash-style online-softmax attention in ONE kernel, no
+  ``[B, T*bs, ...]`` gathered intermediate in HBM. Blocks past a
+  sequence's length are predicated out with ``pl.when`` (their FLOPs
+  never issue — which is also what makes trash-page garbage *exactly*
+  zero probability, matching the dense reference), and their index maps
+  all resolve to the trash page, so the block-fetch pipeline sees the
+  same index on every skipped step and elides the refetch — a short
+  sequence in a wide table pays one trash-page fetch, not T. Int8 pools dequantize
+  inside the kernel: the per-page-per-head scale is constant across a
+  page, so it fuses into the logits/output as one scalar multiply per
+  (page, head) — the full-precision pool never materializes anywhere.
+  ``impl="pallas-interpret"`` runs the same kernel in the Pallas
+  interpreter, which is how the CPU parity suite pins it against the
+  dense reference (the flash-attention playbook).
 
 Pool-sharing convention (pinned in tests/test_paged_attention.py):
 **page 0 is the trash page**. Allocators never hand it out; unused block-
 table entries point at it; batched scatters of inactive batch slots land
 in it. Correctness never depends on its contents.
 
-On TPU the gather lowers to HBM loads driven by the (SMEM-resident) block
-table — the shape the "Ragged Paged Attention" kernel literature
-prescribes (PAPERS.md); a Pallas kernel that fuses the gather with the
-flash inner loop can swap in underneath this interface without touching
-callers, exactly like ``ops/flash_attention.py`` under ``auto_attention``.
+Quantized pools (``--kv-dtype int8``) carry a per-page-per-head f32
+scale tensor next to the int8 pages. Scales are **anchored**: a page's
+scale derives from its slot-0 token only (``ops/quantization.py``), so
+for the same token values, prefill's whole-page scatter and decode's
+token-at-a-time writes produce bitwise-identical pages — the quantizer
+adds no write-order dependence on top of the forward-path numerics the
+engine's preemption (recompute-on-readmit) contract already manages.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+import functools
+from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
-from .attention import causal_attention
+from ..utils.jaxcompat import pallas_tpu
+# NEG_INF is shared with the dense reference on purpose: the exact-zero
+# masking contract (`exp(NEG_INF - m)` underflows to 0.0) must mean the
+# same thing in both impls, or dense/pallas parity silently weakens.
+from .attention import NEG_INF, causal_attention
+from .quantization import quantize_with_scale, token_kv_scale
 
 # Physical page every allocator must reserve: the scatter/gather sink for
 # padded block-table entries and inactive batch slots.
 TRASH_PAGE = 0
+
+PAGED_IMPLS = ("dense", "pallas", "pallas-interpret")
 
 
 def blocks_for(length: int, block_size: int) -> int:
@@ -55,27 +87,58 @@ def blocks_for(length: int, block_size: int) -> int:
     return -(-length // block_size)
 
 
+def resolve_paged_impl(mode: str, platform: Optional[str] = None) -> str:
+    """``ModelConfig.attention`` -> paged-decode impl name.
+
+    The paged twin of ``models.llama.resolve_attention``: "dense" forces
+    the reference einsum; "flash" forces the fused kernel (interpret
+    mode off-TPU, so the SAME code path is CPU-testable);
+    "flash-interpret" interprets everywhere (tests); "auto" picks the
+    kernel on TPU and the dense reference elsewhere.
+    """
+    if mode == "dense":
+        return "dense"
+    if mode == "flash-interpret":
+        return "pallas-interpret"
+    platform = platform or jax.default_backend()
+    if mode == "flash":
+        return "pallas" if platform == "tpu" else "pallas-interpret"
+    return "pallas" if platform == "tpu" else "dense"
+
+
 def gather_pages(
-    pages: jnp.ndarray,  # [N, bs, Hkv, D] — the physical pool
+    pages: jnp.ndarray,  # [N, Hkv, bs, D] — the physical pool
     block_tables: jnp.ndarray,  # [B, T] int32 physical page ids
+    scale: Optional[jnp.ndarray] = None,  # [N, Hkv] f32 (int8 pools)
+    dtype: Optional[jnp.dtype] = None,
 ) -> jnp.ndarray:
     """K or V in logical token order: [B, T*bs, Hkv, D].
 
-    Row ``b``, token ``t`` is ``pages[block_tables[b, t // bs], t % bs]``.
-    Entries past a sequence's written length (trash-page refs included)
-    gather garbage by design — the caller masks by position.
+    Row ``b``, token ``t`` is ``pages[block_tables[b, t // bs], :,
+    t % bs]``. Entries past a sequence's written length (trash-page refs
+    included) gather garbage by design — the caller masks by position.
+    Int8 pools pass their ``scale`` and dequantize after the gather
+    (only the gathered rows, never the whole pool).
     """
-    n, bs, hkv, d = pages.shape
+    n, hkv, bs, d = pages.shape
     b, t = block_tables.shape
-    return pages[block_tables].reshape(b, t * bs, hkv, d)
+    out = pages[block_tables]  # [B, T, Hkv, bs, D]
+    if scale is not None:
+        s = scale[block_tables]  # [B, T, Hkv]
+        out = out.astype(jnp.float32) * s[:, :, :, None, None]
+        out = out.astype(dtype or jnp.float32)
+    return jnp.transpose(out, (0, 1, 3, 2, 4)).reshape(b, t * bs, hkv, d)
 
 
 def ragged_paged_attention(
     q: jnp.ndarray,  # [B, 1, Hq, D] — this step's query per sequence
-    k_pages: jnp.ndarray,  # [N, bs, Hkv, D]
-    v_pages: jnp.ndarray,  # [N, bs, Hkv, D]
+    k_pages: jnp.ndarray,  # [N, Hkv, bs, D] (activation dtype or int8)
+    v_pages: jnp.ndarray,  # [N, Hkv, bs, D]
     block_tables: jnp.ndarray,  # [B, T] int32
     lengths: jnp.ndarray,  # [B] int32 — tokens written, incl. this one
+    k_scale: Optional[jnp.ndarray] = None,  # [N, Hkv] f32 (int8 pools)
+    v_scale: Optional[jnp.ndarray] = None,
+    impl: str = "dense",
 ) -> jnp.ndarray:
     """One decode step of attention for a ragged batch: [B, 1, Hq, D].
 
@@ -83,12 +146,22 @@ def ragged_paged_attention(
     to every written slot of its own pages (the current token's K/V must
     already be scattered in — same contract as ``generate.decode_step``,
     which writes the cache before attending). GQA comes along for free
-    from ``causal_attention``.
+    from ``causal_attention``. ``impl`` picks the dense reference or the
+    fused Pallas kernel (see :func:`resolve_paged_impl`).
     """
+    if impl not in PAGED_IMPLS:
+        raise ValueError(
+            f"impl must be one of {PAGED_IMPLS}, got {impl!r}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if impl != "dense":
+        return _ragged_paged_attention_pallas(
+            q, k_pages, v_pages, block_tables, lengths, k_scale, v_scale,
+            interpret=(impl == "pallas-interpret"))
     b, t = block_tables.shape
-    bs = k_pages.shape[1]
-    k = gather_pages(k_pages, block_tables)
-    v = gather_pages(v_pages, block_tables)
+    bs = k_pages.shape[2]
+    k = gather_pages(k_pages, block_tables, k_scale, q.dtype)
+    v = gather_pages(v_pages, block_tables, v_scale, q.dtype)
     # Logical key positions 0..T*bs-1; the causal test q_pos >= k_pos
     # excludes both future slots and everything past length-1 — garbage
     # in padded/trash pages never reaches the softmax support.
@@ -99,23 +172,221 @@ def ragged_paged_attention(
 
 
 def scatter_token(
-    k_pages: jnp.ndarray,  # [N, bs, Hkv, D]
+    k_pages: jnp.ndarray,  # [N, Hkv, bs, D]
     v_pages: jnp.ndarray,
     k: jnp.ndarray,  # [B, 1, Hkv, D] — this step's K per sequence
     v: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, T] int32
     positions: jnp.ndarray,  # [B] int32 — slot each token lands in
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Write one token's K/V per sequence into its page: (k_pages, v_pages).
+    k_scale: Optional[jnp.ndarray] = None,  # [N, Hkv] f32 (int8 pools)
+    v_scale: Optional[jnp.ndarray] = None,
+):
+    """Write one token's K/V per sequence into its page.
+
+    Returns ``(k_pages, v_pages)`` — or ``(k_pages, v_pages, k_scale,
+    v_scale)`` when the pool is quantized. Quantized writes follow the
+    anchored-scale rule: a token landing in a page's slot 0 *sets* the
+    page's scale from its own amplitude; any other slot quantizes
+    against the stored scale (clamped) — so, for the same token values,
+    incremental decode writes reproduce exactly what a whole-page
+    prefill re-quantization produces (``ops/quantization.py``).
 
     Inactive batch slots must carry an all-trash block table (and any
     position): their writes land in the trash page, colliding only with
     each other, never with an allocated page.
     """
     b = positions.shape[0]
-    bs = k_pages.shape[1]
+    bs = k_pages.shape[2]
     page = block_tables[jnp.arange(b), positions // bs]  # [B]
     offset = positions % bs  # [B]
-    k_pages = k_pages.at[page, offset].set(k[:, 0])
-    v_pages = v_pages.at[page, offset].set(v[:, 0])
-    return k_pages, v_pages
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if k_scale is None:
+        k_pages = k_pages.at[page, :, offset].set(
+            k[:, 0].astype(k_pages.dtype))
+        v_pages = v_pages.at[page, :, offset].set(
+            v[:, 0].astype(v_pages.dtype))
+        return k_pages, v_pages
+    first = (offset == 0)[:, None]  # [B, 1] — this token anchors its page
+    new_ks = jnp.where(first, token_kv_scale(k[:, 0]), k_scale[page])
+    new_vs = jnp.where(first, token_kv_scale(v[:, 0]), v_scale[page])
+    k_pages = k_pages.at[page, :, offset].set(
+        quantize_with_scale(k[:, 0], new_ks[:, :, None]))
+    v_pages = v_pages.at[page, :, offset].set(
+        quantize_with_scale(v[:, 0], new_vs[:, :, None]))
+    return (k_pages, v_pages,
+            k_scale.at[page].set(new_ks), v_scale.at[page].set(new_vs))
+
+
+# ---------------------------------------------------------------------------
+# Fused Pallas kernel
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _pallas_ns():
+    """(pl, pltpu, CompilerParams) — resolved lazily so importing the
+    dense path (every model import) never touches jax.experimental."""
+    return pallas_tpu()
+
+
+def _round_up(x: int, m: int) -> int:
+    # Local copy (not flash_attention's): importing that module here
+    # would eagerly load jax.experimental.pallas on every model import.
+    return ((x + m - 1) // m) * m
+
+
+def _ragged_decode_kernel(bt_ref, len_ref, *rest,
+                          bs: int, num_blocks: int, sm_scale: float,
+                          quantized: bool):
+    """Grid (B, Hkv, T), T innermost/arbitrary: online-softmax over the
+    logical blocks of one sequence for one KV head's query group.
+
+    ``bt_ref``/``len_ref`` are the scalar-prefetch operands,
+    SMEM-resident — the block table already steered this step's
+    ``k_ref``/``v_ref`` BlockSpecs at the physical page, so the kernel
+    body only ever sees [bs, D] tiles of its own sequence. The int8
+    pool's per-(page, head) scales arrive as (1, 1, 1, 1) blocks steered
+    by the SAME index map — a 4-byte fetch per grid step, never the
+    whole [num_blocks, Hkv] tensor in SMEM (which would scale with pool
+    size, not batch size).
+    """
+    pl, _, _ = _pallas_ns()
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, \
+            acc_ref = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b]
+
+    # Blocks at or past the sequence's length hold pad/trash garbage and
+    # their COMPUTE is skipped outright — contribution exactly zero, the
+    # same contract the dense reference meets via NEG_INF masking. (The
+    # pipeline's block fetch is steered to the trash page by the index
+    # map instead, where consecutive same-index steps elide the DMA —
+    # pl.when predicates the kernel body, never the fetch.)
+    @pl.when(t * bs < length)
+    def _compute():
+        q = q_ref[0, 0]  # [G8, D]
+        k = k_ref[0, 0]  # [bs, D] (int8 when quantized)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [G8, bs]
+        if quantized:
+            # Per-page-per-head scale is constant over the tile: the
+            # dequant collapses to one scalar on the logits, steered
+            # here by the same block-table index map as the page DMA.
+            s = s * ks_ref[0, 0, 0, 0]
+        k_pos = t * bs + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:]                           # [G8, 128]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [G8, 1]
+        m_new = jnp.maximum(m_prev, m_cur)          # [G8, 128]
+        p = jnp.exp(s - m_new[:, :1])               # [G8, bs] f32
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        vf = v.astype(jnp.float32 if quantized else q.dtype)
+        pv = jax.lax.dot_general(
+            p.astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [G8, D]
+        if quantized:
+            pv = pv * vs_ref[0, 0, 0, 0]
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + pv
+        m_ref[:] = m_new
+
+    @pl.when(t == num_blocks - 1)
+    def _finish():
+        # l == 0 only for an inactive slot (length 0, every block
+        # skipped): its output is defined-zero garbage the scheduler
+        # discards; the guard keeps it NaN-free.
+        l = l_ref[:, :1]
+        o_ref[0, 0] = jnp.where(
+            l > 0, acc_ref[:] / l, 0.0).astype(o_ref.dtype)
+
+
+def _ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                   lengths, k_scale, v_scale,
+                                   interpret: bool) -> jnp.ndarray:
+    pl, pltpu, CompilerParams = _pallas_ns()
+    b, _, hq, d = q.shape
+    n, hkv, bs, _ = k_pages.shape
+    t = block_tables.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    # Head h = kv_head * group + g (the causal_attention grouping): fold
+    # the group onto the sublane axis, padded to the f32 tile height so
+    # Mosaic gets a legal [G8, D] row block. Padded rows are zero
+    # queries — finite softmax, garbage output, sliced off below.
+    g8 = _round_up(group, 8)
+    q4 = q[:, 0].reshape(b, hkv, group, d)
+    if g8 != group:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, g8 - group), (0, 0)))
+
+    quantized = k_scale is not None
+    kernel = functools.partial(
+        _ragged_decode_kernel, bs=bs, num_blocks=t,
+        sm_scale=d ** -0.5, quantized=quantized)
+
+    # Index maps receive (grid..., *scalar_prefetch_refs); the page
+    # lookup bt[b, t] is THE fused gather — Mosaic's pipeline DMAs that
+    # page (and only that page) into VMEM for grid step (b, h, t).
+    # Blocks past the sequence's length (whose compute the kernel
+    # predicates out) are steered to the trash page so every skipped
+    # step presents the SAME block index and the pipeline elides the
+    # refetch. The head-major pool layout makes each (page, head) block
+    # a clean [bs, D] trailing plane (the Mosaic tiling constraint).
+    # Int8 scales ride as (1, 1, 1, 1) blocks through the same index
+    # map: the per-step fetch is one f32, and the footprint never
+    # scales with num_blocks (a scalar-prefetched [N, Hkv] tensor
+    # would — SMEM is KBs, production pools are millions of pages).
+    def kv_index(b, h, t, *refs):
+        live = t * bs < refs[1][b]
+        return (jnp.where(live, refs[0][b, t], TRASH_PAGE), h, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g8, d), lambda b, h, t, *refs: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [q4, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, 1, 1), kv_index),
+            pl.BlockSpec((1, 1, 1, 1), kv_index),
+        ]
+        operands += [k_scale[:, :, None, None], v_scale[:, :, None, None]]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, t),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, g8, d), lambda b, h, t, *refs: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g8, 128), jnp.float32),  # m, lane-replicated
+            pltpu.VMEM((g8, 128), jnp.float32),  # l
+            pltpu.VMEM((g8, d), jnp.float32),    # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g8, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      *operands)
+    return out[:, :, :group, :].reshape(b, hq, d)[:, None]

@@ -1,0 +1,134 @@
+"""Int8 quantization primitives shared by the weight and KV-cache paths.
+
+Two consumers, one math module (the ops-layer altitude of
+``ops/attention.py``):
+
+* **Weights** (``models.llama.quantize_weights``): per-channel symmetric
+  int8 over the contraction axis of each big matmul — the scale is exact
+  (computed from the full weight once, at quantize time), so
+  dequantization error is pure rounding, bounded by ``scale/2`` per
+  element.
+* **KV pages** (``ops.paged_attention`` / ``models.paged``): per-page-
+  per-head scales with the **anchored-scale** rule: a page's scale is a
+  function of the page's FIRST token slot only. That makes the
+  quantizer *write-order invariant* — given the same token K/V values,
+  a page filled by one whole-page prefill scatter and the same page
+  filled token-by-token by decode steps hold bitwise-identical int8
+  values, because every token is quantized independently against the
+  same anchor scale. A max-over-written-slots scale would NOT have this
+  property (growing the scale re-rounds already-written slots through
+  their dequantized values, making the result depend on arrival order).
+  This is what the serving engine's preemption contract leans on: a
+  preempted sequence is re-prefilled from prompt + tokens-so-far, and
+  anchoring removes the quantizer itself as a divergence source — the
+  only residual difference is the one the *unquantized* engine already
+  carries (re-prefill's dense forward vs decode's ragged forward differ
+  in f32 reduction order), which the pinned churn tests bound at
+  argmax level (tests/test_serve.py). The cost is clamp risk when a
+  later token's amplitude exceeds the anchor's; :data:`KV_SCALE_HEADROOM`
+  trades one bit of precision for headroom against it.
+
+Symmetric quantization throughout (no zero point): K/V and weight
+distributions are near-zero-mean, and symmetric int8 keeps
+dequantization a single multiply — fusable into the attention logits as
+one scalar per (page, head) because the scale is constant across the
+page (``(q . k_int8) * scale == q . (k_int8 * scale)``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+# Symmetric int8 range: +-127, never -128 (keeps abs() exact and the
+# scale math symmetric).
+INT8_MAX = 127.0
+# Anchored KV scales quantize later tokens against the first token's
+# amplitude; 2x headroom halves the clamp probability at the cost of
+# one effective bit (|q| <= 63 for the anchor token itself).
+KV_SCALE_HEADROOM = 2.0
+# Floor on every scale: an all-zero anchor must not produce a 0 scale
+# (division blows up); with the floor, later tokens simply saturate —
+# deterministic on both the prefill and decode write paths.
+MIN_SCALE = 1e-8
+
+
+def quantize_with_scale(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Symmetric int8 with a caller-supplied (broadcastable) scale:
+    ``clip(round(x / scale), -127, 127)``. The one quantizer every
+    write path shares — bitwise agreement between prefill and decode
+    writes reduces to agreeing on ``scale``."""
+    q = jnp.round(x.astype(jnp.float32) / scale)
+    return jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+
+
+def quantize_int8(x: jnp.ndarray,
+                  axis: Union[int, Tuple[int, ...]],
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-channel symmetric int8: scale = amax over ``axis`` / 127.
+
+    Returns (int8 values, f32 scale with ``axis`` kept as size-1 dims —
+    broadcastable straight back onto the values). The weight-quant
+    primitive: exact amax, no headroom.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / INT8_MAX, MIN_SCALE)
+    return quantize_with_scale(x, scale), scale
+
+
+def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray,
+                    dtype: jnp.dtype) -> jnp.ndarray:
+    """``q * scale`` in f32, cast to ``dtype`` (int8 -> f32 is exact;
+    the cast to bf16 matches the precision of the unquantized
+    weight-at-use cast)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def token_kv_scale(kv: jnp.ndarray) -> jnp.ndarray:
+    """Anchor scale of one token's K or V: [..., Hkv, D] -> f32 [..., Hkv].
+
+    ``amax over D * HEADROOM / 127``, floored — the scale a page adopts
+    when this token lands in its slot 0, and the same formula
+    :func:`quantize_kv_pages` applies to slot 0 of every page, so both
+    write paths derive identical scales from identical token values.
+    """
+    amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
+    return jnp.maximum(amax * KV_SCALE_HEADROOM / INT8_MAX, MIN_SCALE)
+
+
+def quantize_kv_pages(pages: jnp.ndarray,
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Whole-page anchored quantization: [..., Hkv, bs, D] exact K or V
+    (the head-major page layout of ``ops.paged_attention``) ->
+    (int8 pages, f32 scales [..., Hkv]).
+
+    The scale comes from slot 0 only (every *allocated* page's slot 0
+    holds a real token — allocators hand out ``ceil(length/bs)`` pages,
+    so a page with no real slot-0 token is never allocated); slots past
+    the written length quantize pad garbage with the same scale, exactly
+    as decode will overwrite them later.
+    """
+    scale = token_kv_scale(pages[..., :, 0, :])  # [..., Hkv]
+    q = quantize_with_scale(pages, scale[..., :, None, None])
+    return q, scale
+
+
+def kv_quant_error(q: jnp.ndarray, scale: jnp.ndarray,
+                   exact: jnp.ndarray,
+                   mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Scalar mean relative dequantization error (device scalar, jit-
+    safe): mean |dequant - exact| / mean |exact|, over the elements
+    ``mask`` selects (all, when None). What the
+    ``tk8s_serve_quant_error`` gauge reports per quantized prefill —
+    callers mask to the *real* token slots, or pad garbage and
+    trash-page writes (quantized against garbage anchors) would dominate
+    the number an operator reads for quantization health."""
+    exact = exact.astype(jnp.float32)
+    dq = q.astype(jnp.float32) * scale
+    if mask is None:
+        return (jnp.mean(jnp.abs(dq - exact))
+                / (jnp.mean(jnp.abs(exact)) + 1e-12))
+    mask = mask.astype(jnp.float32)
+    return (jnp.sum(jnp.abs(dq - exact) * mask)
+            / (jnp.sum(jnp.abs(exact) * mask) + 1e-12))

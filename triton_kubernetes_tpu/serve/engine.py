@@ -42,11 +42,13 @@ import jax.numpy as jnp
 from ..models.config import ModelConfig
 from ..models.generate import sample_token
 from ..models.paged import (
+    KV_DTYPES,
     init_paged_cache,
     paged_decode_step,
     paged_prefill,
 )
 from ..ops.paged_attention import TRASH_PAGE, blocks_for
+from ..train.precision import quantize_for_decode
 from ..utils import metrics
 from .blocks import BlockAllocator, OutOfBlocksError
 
@@ -144,12 +146,23 @@ class ServeEngine:
         max_batch: int = 4,
         max_model_len: Optional[int] = None,
         sequential: bool = False,
+        kv_dtype: str = "auto",
+        weight_dtype: str = "auto",
         clock: Callable[[], float] = time.monotonic,
     ):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+        # Decode weight policy first: params and config are rewritten as
+        # one (the apply-policy shape) BEFORE the jit closures below
+        # capture either, so a half-quantized engine cannot exist.
+        params, config = quantize_for_decode(params, config, weight_dtype)
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
         self.config = config
         self.params = params
         self.block_size = block_size
@@ -163,31 +176,47 @@ class ServeEngine:
         self.blocks_per_seq = blocks_for(self.max_model_len, block_size)
         self.prefill_width = self.blocks_per_seq * block_size
         self.allocator = BlockAllocator(num_blocks)
-        self.cache = init_paged_cache(config, num_blocks, block_size)
+        self.cache = init_paged_cache(config, num_blocks, block_size,
+                                      kv_dtype=kv_dtype)
         self.waiting: Deque[_Sequence] = deque()
         self.slots: List[Optional[_Sequence]] = [None] * max_batch
         self._admit_counter = 0
         self._steps = 0
         cfg = config
-        # The page pool is donated: the scatter writes then alias the
-        # input buffers instead of copying the whole pool every token
+        quantized = self.cache.quantized
+        # Pool-byte accounting: what --kv-dtype actually buys. int8
+        # pages quarter the f32 pool (halve bf16) at a few scale bytes
+        # per page — the operator trades the saving for more num_blocks,
+        # i.e. more concurrent sequences (scripts/ci/quant_evidence.py
+        # gates the exchange rate).
+        metrics.gauge("tk8s_serve_kv_bytes").set(
+            self.cache.pool_bytes, component="pages")
+        metrics.gauge("tk8s_serve_kv_bytes").set(
+            self.cache.scale_bytes, component="scales")
+        # The page pool rides as ONE tuple operand — (k, v) or
+        # (k, v, k_scale, v_scale) — so both kv dtypes share one jit per
+        # op (donating a pytree argnum donates every array in it). The
+        # pool is donated: the scatter writes then alias the input
+        # buffers instead of copying the whole pool every token
         # (self.cache is unconditionally replaced by the result, so the
         # consumed operands are never read again).
-        # tk8s: donate-safe(k/v come from init_paged_cache's device
-        # zeros — distinct buffers, never host-aliased — and self.cache
-        # is rebound to the jit result every call, so the donated pool
-        # is dead on return)
+        # tk8s: donate-safe(every pool array comes from
+        # init_paged_cache's device zeros — distinct buffers, never
+        # host-aliased — and self.cache is rebound to the jit result
+        # every call, so the donated pool is dead on return)
         self._prefill = jax.jit(
-            lambda p, toks, length, k, v, table: paged_prefill(
+            lambda p, toks, length, pool, table: paged_prefill(
                 p, toks, length, cfg,
-                _cache_like(self.cache, k, v), table),
-            donate_argnums=(3, 4))
+                _cache_like(self.cache, *pool), table,
+                with_quant_error=quantized),
+            donate_argnums=(3,))
         # tk8s: donate-safe(same pool-ownership contract as _prefill:
-        # device-allocated k/v, rebound from the result each decode step)
+        # device-allocated pool arrays, rebound from the result each
+        # decode step)
         self._decode = jax.jit(
-            lambda p, tok, k, v, bt, lens: paged_decode_step(
-                p, tok, cfg, _cache_like(self.cache, k, v), bt, lens),
-            donate_argnums=(2, 3))
+            lambda p, tok, pool, bt, lens: paged_decode_step(
+                p, tok, cfg, _cache_like(self.cache, *pool), bt, lens),
+            donate_argnums=(2,))
 
     # ------------------------------------------------------------ intake
     def validate_request(self, request: Request) -> None:
@@ -284,16 +313,35 @@ class ServeEngine:
             if self._maybe_finish(slot, finished):
                 continue
 
+    def _pool(self) -> tuple:
+        """The cache's arrays as the jit pool operand: (k, v), plus the
+        scale tensors when quantized."""
+        c = self.cache
+        if c.quantized:
+            return (c.k, c.v, c.k_scale, c.v_scale)
+        return (c.k, c.v)
+
     def _prefill_sequence(self, seq: _Sequence, prompt: List[int]) -> None:
         padded = prompt + [0] * (self.prefill_width - len(prompt))
         table = seq.pages + [TRASH_PAGE] * (self.blocks_per_seq
                                             - len(seq.pages))
-        logits, cache = self._prefill(
+        quantized = self.cache.quantized
+        out = self._prefill(
             self.params,
             jnp.asarray([padded], jnp.int32),
             jnp.asarray(len(prompt), jnp.int32),
-            self.cache.k, self.cache.v,
+            self._pool(),
             jnp.asarray(table, jnp.int32))
+        if quantized:
+            logits, cache, (k_err, v_err) = out
+            # The error scalars ride the same host sync the sampled
+            # logits force — no extra device round trip.
+            metrics.gauge("tk8s_serve_quant_error").set(
+                float(k_err), tensor="k")
+            metrics.gauge("tk8s_serve_quant_error").set(
+                float(v_err), tensor="v")
+        else:
+            logits, cache = out
         self.cache = cache
         tok = self._sample(seq, logits[None, :])
         seq.generated.append(tok)
@@ -349,7 +397,7 @@ class ServeEngine:
         logits, cache = self._decode(
             self.params,
             jnp.asarray(tokens, jnp.int32),
-            self.cache.k, self.cache.v,
+            self._pool(),
             jnp.asarray(tables, jnp.int32),
             jnp.asarray(lengths, jnp.int32))
         self.cache = cache
@@ -426,10 +474,14 @@ class ServeEngine:
             "waiting": len(self.waiting),
             "steps": self._steps,
             "sequential": self.sequential,
+            "kv_dtype": self.kv_dtype,
+            "weight_dtype": self.weight_dtype,
+            "kv_pool_bytes": self.cache.pool_bytes + self.cache.scale_bytes,
         }
 
 
-def _cache_like(template, k, v):
+def _cache_like(template, k, v, k_scale=None, v_scale=None):
     """Rebuild the NamedTuple from jit operands (jit flattens pytrees;
-    passing k/v explicitly keeps the signature donation-friendly)."""
-    return type(template)(k=k, v=v)
+    passing the arrays explicitly keeps the signature
+    donation-friendly)."""
+    return type(template)(k=k, v=v, k_scale=k_scale, v_scale=v_scale)

@@ -34,6 +34,7 @@ from typing import Any, Dict, Union
 import jax
 import jax.numpy as jnp
 
+from ..constants import WEIGHT_DTYPES
 from ..models.config import ModelConfig
 
 
@@ -108,6 +109,41 @@ def remat_policy_of(config: ModelConfig) -> str:
     disabled either way) — the single normalization bench.py and the
     trainer log share, matching models.llama.remat_block's gating."""
     return "none" if not config.remat else config.remat_policy
+
+
+# ---------------------------------------------------------------------
+# Decode-time quantization policies (the serving counterpart of the
+# training policies above; `tk8s serve --kv-dtype/--weight-dtype`).
+# The KV-page dtype knob lives with the cache it configures
+# (models.paged.KV_DTYPES); both tuples are pinned in constants.py so
+# the jax-less CLI parser registers the same choices the engine
+# validates.
+# ---------------------------------------------------------------------
+
+# Decode weight storage (WEIGHT_DTYPES, imported above): "auto" leaves
+# the params tree exactly as handed in; "int8" applies
+# models.llama.quantize_weights.
+
+
+def quantize_for_decode(params: Any, config: ModelConfig,
+                        weight_dtype: str) -> tuple:
+    """Apply a decode weight policy: returns ``(params, config)``.
+
+    The quantization twin of :func:`apply_policy`, with the same
+    cannot-be-half-applied shape: "auto" is the identity on BOTH params
+    and config, "int8" rewrites both together via
+    ``models.llama.quantize_weights`` (per-channel symmetric int8 for
+    the big matmuls; the caller's f32 master tree is untouched).
+    """
+    if weight_dtype not in WEIGHT_DTYPES:
+        raise KeyError(
+            f"unknown weight_dtype {weight_dtype!r}; know "
+            f"{list(WEIGHT_DTYPES)}")
+    if weight_dtype == "auto":
+        return params, config
+    from ..models.llama import quantize_weights
+
+    return quantize_weights(params, config)
 
 
 def grads_all_finite(grads: Any) -> jnp.ndarray:

@@ -196,6 +196,15 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
     "tk8s_serve_preemptions_total": (
         "counter", "Running sequences evicted to free KV pages "
         "(recompute-on-readmit)", (), None),
+    "tk8s_serve_kv_bytes": (
+        "gauge", "Device bytes of the paged KV pool by component "
+        "(pages = the K/V page arrays at the configured --kv-dtype; "
+        "scales = the per-page-per-head f32 quantization scales, 0 "
+        "unless --kv-dtype int8)", ("component",), None),
+    "tk8s_serve_quant_error": (
+        "gauge", "Mean relative dequantization error of the most "
+        "recent quantized prefill's scattered KV pages, by tensor "
+        "(k/v); stays 0 when the pool is unquantized", ("tensor",), None),
     "tk8s_serve_http_requests_total": (
         "counter", "Serving HTTP requests by route, method, and "
         "response code", ("route", "method", "code"), None),
