@@ -25,6 +25,7 @@ from triton_kubernetes_tpu.models import (
     init_params,
     paged_decode_step,
     paged_prefill,
+    paged_prefill_chunk,
 )
 from triton_kubernetes_tpu.ops.attention import causal_attention
 from triton_kubernetes_tpu.ops.paged_attention import (
@@ -422,3 +423,127 @@ def test_init_paged_cache_reserves_trash():
     assert cache.num_blocks == 4 and cache.block_size == 8
     assert cache.k.shape == (cfg.num_layers, 4, cfg.num_kv_heads, 8,
                              cfg.head_dim)
+
+
+# ------------------------------------------------------ chunked prefill
+def _chunked_prefill(params, cfg, prompt, cache, table, chunk):
+    """Drive paged_prefill_chunk over absolute windows; returns the last
+    window's logits and the final pool."""
+    logits = None
+    off = 0
+    while off < len(prompt):
+        clen = min(chunk, len(prompt) - off)
+        toks = prompt[off:off + clen] + [0] * (chunk - clen)
+        out = paged_prefill_chunk(
+            params, jnp.asarray([toks], jnp.int32),
+            jnp.asarray(off, jnp.int32), jnp.asarray(clen, jnp.int32),
+            cfg, cache, table)
+        logits, cache = out[0], out[1]
+        off += clen
+    return logits, cache
+
+
+def test_paged_prefill_chunk_bitwise_matches_full_prefill():
+    """The chunked-prefill parity contract (f32 pools): walking a prompt
+    in absolute C-token windows produces BITWISE the logits and page
+    contents of the one-shot paged_prefill — same per-token math, same
+    fixed-width gathered attention, masked slots exactly zero. This is
+    the identity that makes prefix sharing invisible in outputs."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    bs, t = 4, 16  # table width 64 tokens
+    rng = np.random.default_rng(11)
+    prompt = [int(x) for x in rng.integers(1, cfg.vocab_size, size=37)]
+    pages = list(range(1, t + 1))
+    table = jnp.asarray(pages, jnp.int32)
+
+    full_cache = init_paged_cache(cfg, 40, bs)
+    padded = prompt + [0] * (t * bs - len(prompt))
+    want, full_cache = paged_prefill(
+        params, jnp.asarray([padded], jnp.int32),
+        jnp.asarray(len(prompt), jnp.int32), cfg, full_cache, table)
+
+    for chunk in (16, 64):  # multi-window and single-window
+        got, cache = _chunked_prefill(
+            params, cfg, prompt, init_paged_cache(cfg, 40, bs), table,
+            chunk)
+        assert np.array_equal(np.asarray(want), np.asarray(got)), (
+            f"chunk={chunk}: last-token logits diverge from one-shot "
+            f"prefill")
+        nfull = len(prompt) // bs  # full pages: immutable, comparable
+        assert np.array_equal(
+            np.asarray(full_cache.k[:, pages[:nfull]]),
+            np.asarray(cache.k[:, pages[:nfull]]))
+        assert np.array_equal(
+            np.asarray(full_cache.v[:, pages[:nfull]]),
+            np.asarray(cache.v[:, pages[:nfull]]))
+
+
+def test_paged_prefill_chunk_window_invariance():
+    """Chunk-boundary independence *within* the chunked path: a prefix
+    computed via C=8 windows leaves bitwise the same full pages as via
+    C=16 windows — page contents are a function of the tokens alone,
+    which is what lets a cache populated by one writer serve readers
+    with any (window-aligned) reuse point."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    bs, t = 4, 16
+    rng = np.random.default_rng(5)
+    prompt = [int(x) for x in rng.integers(1, cfg.vocab_size, size=32)]
+    table = jnp.asarray(list(range(1, t + 1)), jnp.int32)
+    _, c8 = _chunked_prefill(params, cfg, prompt,
+                             init_paged_cache(cfg, 40, bs), table, 8)
+    _, c16 = _chunked_prefill(params, cfg, prompt,
+                              init_paged_cache(cfg, 40, bs), table, 16)
+    nfull = len(prompt) // bs
+    assert np.array_equal(np.asarray(c8.k[:, 1:nfull + 1]),
+                          np.asarray(c16.k[:, 1:nfull + 1]))
+    assert np.array_equal(np.asarray(c8.v[:, 1:nfull + 1]),
+                          np.asarray(c16.v[:, 1:nfull + 1]))
+
+
+def test_paged_prefill_chunk_quantized_pages_consistent():
+    """int8 pools through the chunked path: the anchored-scale rule
+    keeps a window's quantized pages bitwise identical however the
+    window was reached (one chunk vs two), and greedy argmax tracks the
+    full-prefill path."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    bs, t = 4, 16
+    rng = np.random.default_rng(7)
+    prompt = [int(x) for x in rng.integers(1, cfg.vocab_size, size=24)]
+    table = jnp.asarray(list(range(1, t + 1)), jnp.int32)
+    la, ca = _chunked_prefill(
+        params, cfg, prompt,
+        init_paged_cache(cfg, 40, bs, kv_dtype="int8"), table, 8)
+    lb, cb = _chunked_prefill(
+        params, cfg, prompt,
+        init_paged_cache(cfg, 40, bs, kv_dtype="int8"), table, 16)
+    nfull = len(prompt) // bs
+    assert np.array_equal(np.asarray(ca.k[:, 1:nfull + 1]),
+                          np.asarray(cb.k[:, 1:nfull + 1]))
+    assert np.array_equal(np.asarray(ca.k_scale[:, 1:nfull + 1]),
+                          np.asarray(cb.k_scale[:, 1:nfull + 1]))
+    assert int(np.argmax(np.asarray(la))) == int(np.argmax(np.asarray(lb)))
+
+
+def test_paged_prefill_chunk_validates_shapes():
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    cache = init_paged_cache(cfg, num_blocks=8, block_size=4)
+    with pytest.raises(ValueError, match="multiple of the block size"):
+        paged_prefill_chunk(params, jnp.zeros((1, 6), jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(6, jnp.int32), cfg, cache,
+                            jnp.asarray([1, 2, 3, 4], jnp.int32))
+    with pytest.raises(ValueError, match="table width"):
+        paged_prefill_chunk(params, jnp.zeros((1, 8), jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(8, jnp.int32), cfg, cache,
+                            jnp.asarray([1, 2, 3], jnp.int32))
+    with pytest.raises(ValueError, match="int8"):
+        paged_prefill_chunk(params, jnp.zeros((1, 8), jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(8, jnp.int32), cfg, cache,
+                            jnp.asarray([1, 2], jnp.int32),
+                            with_quant_error=True)

@@ -1,0 +1,316 @@
+"""Session-affine router: hash ring, routing policy, and the fleet
+robustness pin.
+
+The load-bearing test is replica death mid-decode (ISSUE 12 /
+ROADMAP item 5's first workload fault): when a replica's engine loop
+dies, its in-flight requests must re-land on a healthy replica through
+the existing 503-on-death semantics and complete with IDENTICAL
+outputs — generation is seeded per request, so a re-landed request is
+a pure recompute, never a different answer.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from triton_kubernetes_tpu.models import get_config, init_params
+from triton_kubernetes_tpu.serve import (
+    HashRing,
+    Request,
+    Router,
+    RouterHTTPServer,
+    ServeEngine,
+    ServeHTTPServer,
+    SessionSchedule,
+    SharedPrefixSchedule,
+)
+from triton_kubernetes_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    metrics.configure()
+    yield
+    metrics.configure()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = get_config("llama-test")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def make_engine(model, **over):
+    cfg, params = model
+    kw = dict(block_size=4, num_blocks=64, max_batch=4, max_model_len=64,
+              prefill_chunk=8, prefix_cache=True)
+    kw.update(over)
+    return ServeEngine(params, cfg, **kw)
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ------------------------------------------------------------ hash ring
+def test_hash_ring_deterministic_and_consistent():
+    ring = HashRing(["r0", "r1", "r2"], virtual_nodes=64)
+    keys = [f"session:{i}" for i in range(200)]
+    owners = [ring.owner(k) for k in keys]
+    assert owners == [ring.owner(k) for k in keys]  # deterministic
+    assert set(owners) == {"r0", "r1", "r2"}  # every replica owns some
+    # The consistent-hashing contract: excluding one replica remaps ONLY
+    # its keys; everyone else's sessions keep their warm replica.
+    without = [ring.owner(k, frozenset({"r1"})) for k in keys]
+    for k, a, b in zip(keys, owners, without):
+        if a != "r1":
+            assert b == a, f"key {k} moved although its owner is alive"
+        else:
+            assert b in ("r0", "r2")
+    with pytest.raises(LookupError):
+        ring.owner("x", frozenset({"r0", "r1", "r2"}))
+    with pytest.raises(ValueError):
+        HashRing([])
+    with pytest.raises(ValueError):
+        HashRing(["r0"], virtual_nodes=0)
+
+
+def test_route_key_prefers_session_then_prompt():
+    assert Router.route_key({"session_id": "s1", "tokens": [1, 2]}) \
+        == Router.route_key({"session_id": "s1", "tokens": [9, 9]})
+    assert Router.route_key({"tokens": [1, 2, 3]}) \
+        == Router.route_key({"tokens": [1, 2, 3]})
+    assert Router.route_key({"tokens": [1, 2, 3]}) \
+        != Router.route_key({"tokens": [1, 2, 4]})
+
+
+def test_router_pick_affine_spill_eject():
+    """The three routing reasons, driven through state directly (no
+    HTTP): the affine owner wins while healthy and under the spill
+    threshold; over it, the least-loaded healthy replica takes the
+    request; ejected, the next ring choice does."""
+    router = Router([f"http://127.0.0.1:{9000 + i}" for i in range(3)],
+                    spill_threshold=2)
+    key = "session:abc"
+    owner, reason = router.pick(key)
+    assert reason == "affine"
+    # Load the owner to the threshold: spill to least-loaded.
+    router.replicas[owner.name].in_flight = 2
+    spilled, reason = router.pick(key)
+    assert reason == "spill" and spilled.name != owner.name
+    assert spilled.in_flight == 0
+    # Eject the owner: consistent rehash away from it.
+    router.replicas[owner.name].in_flight = 0
+    router.replicas[owner.name].healthy = False
+    other, reason = router.pick(key)
+    assert reason == "eject" and other.name != owner.name
+    # All down: loud, typed.
+    for r in router.replicas.values():
+        r.healthy = False
+    with pytest.raises(LookupError, match="no healthy replica"):
+        router.pick(key)
+    with pytest.raises(ValueError):
+        Router([])
+    with pytest.raises(ValueError):
+        Router(["http://x"], spill_threshold=0)
+
+
+def test_session_schedule_orders_turns_and_grows_prefixes():
+    sched = SessionSchedule(rate=5.0, num_sessions=3, turns=4,
+                            vocab_size=50, prefix_len=8, seed=3)
+    assert len(sched) == 12
+    by_session = {}
+    for r in sched:
+        by_session.setdefault(r.session_id, []).append(r)
+    assert set(by_session) == {"sess-0", "sess-1", "sess-2"}
+    for turns in by_session.values():
+        turns.sort(key=lambda r: r.at)
+        for a, b in zip(turns, turns[1:]):
+            assert b.at > a.at
+            assert b.tokens[:len(a.tokens)] == a.tokens, (
+                "turn N+1 must extend turn N's prompt")
+    # Seeded: identical replay.
+    again = SessionSchedule(rate=5.0, num_sessions=3, turns=4,
+                            vocab_size=50, prefix_len=8, seed=3)
+    assert [(r.at, r.tokens) for r in sched] \
+        == [(r.at, r.tokens) for r in again]
+
+
+def test_shared_prefix_schedule_shares_prefixes():
+    sched = SharedPrefixSchedule(rate=10.0, n=12, vocab_size=50,
+                                 num_prefixes=2, prefix_len=16, seed=9)
+    assert len(sched.prefixes) == 2 and len(sched) == 12
+    for r, k in zip(sched, sched.prefix_of):
+        assert r.tokens[:16] == sched.prefixes[k]
+        assert len(r.tokens) > 16
+
+
+# ----------------------------------------------------------- HTTP fleet
+def test_router_affinity_and_identical_outputs(model):
+    """Two replicas behind the router: every session's turns land on ONE
+    replica (affinity 1.0 with no spill pressure) and outputs equal the
+    single-engine reference — routing must never change tokens."""
+    reference = make_engine(model)
+    srvs = [ServeHTTPServer(make_engine(model)).start() for _ in range(2)]
+    try:
+        with RouterHTTPServer([s.url for s in srvs],
+                              health_interval_s=0.2) as router:
+            sched = SessionSchedule(rate=50.0, num_sessions=3, turns=3,
+                                    vocab_size=50, prefix_len=8,
+                                    max_new_tokens=4, seed=4)
+            landed = {}
+            for tr in sched:  # sequential: affinity, not throughput
+                out = _post(router.url, {
+                    "tokens": tr.tokens, "max_new_tokens": tr.max_new_tokens,
+                    "session_id": tr.session_id})
+                landed.setdefault(tr.session_id, set()).add(out["replica"])
+                reference.submit(Request(tr.request_id, list(tr.tokens),
+                                         tr.max_new_tokens))
+                want = reference.run_until_idle()[0].tokens
+                assert out["tokens"] == want, (
+                    f"{tr.request_id} diverged through the router")
+            assert all(len(reps) == 1 for reps in landed.values()), (
+                f"sessions split across replicas: {landed}")
+            # Both reasons observable: affine everywhere, zero ejects.
+            affine = sum(
+                metrics.counter("tk8s_route_requests_total").value(
+                    replica=f"r{i}", reason="affine") for i in range(2))
+            assert affine == len(sched)
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_router_replica_death_relands_requests(model):
+    """Kill a replica's engine loop mid-decode: its in-flight request
+    must 503 out of the dead replica (PR 6's loop-death semantics),
+    re-land on a healthy one via the eject path, and complete with the
+    exact tokens the dead replica would have produced. Later traffic for
+    that session stays on the living replica; the router's own /healthz
+    stays 200."""
+    reference = make_engine(model)
+    srvs = [ServeHTTPServer(make_engine(model)).start() for _ in range(3)]
+    try:
+        with RouterHTTPServer([s.url for s in srvs],
+                              health_interval_s=10.0) as router:
+            probe = {"tokens": [7, 3, 9, 1], "max_new_tokens": 2,
+                     "session_id": "victim-session"}
+            first = _post(router.url, probe)
+            victim_name = first["replica"]
+            victim = next(
+                s for s in srvs
+                if s.url == router.router.replicas[victim_name].url)
+
+            # A long generation in flight on the victim...
+            slow = {"tokens": [7, 3, 9, 1, 5, 5], "max_new_tokens": 24,
+                    "session_id": "victim-session"}
+            reference.submit(Request("slow", list(slow["tokens"]), 24))
+            want = reference.run_until_idle()[0].tokens
+            got = {}
+
+            def fire():
+                got["out"] = _post(router.url, slow, timeout=90)
+
+            t = threading.Thread(target=fire)
+            t.start()
+            # ...dies mid-decode: next step() call raises, the loop
+            # records the death, blocked clients get 503, /healthz 503.
+            victim.engine.step = None  # type: ignore[assignment]
+            t.join(timeout=90)
+            assert not t.is_alive(), "re-landed request never completed"
+
+            assert got["out"]["tokens"] == want, (
+                "re-landed request diverged from the reference")
+            assert got["out"]["replica"] != victim_name
+            ejects = sum(
+                metrics.counter("tk8s_route_requests_total").value(
+                    replica=f"r{i}", reason="eject") for i in range(3))
+            assert ejects >= 1
+            assert metrics.gauge("tk8s_route_replica_healthy").value(
+                replica=victim_name) == 0
+            # The fleet itself is still healthy and still affine for the
+            # session — on a LIVING replica, with unchanged outputs.
+            with urllib.request.urlopen(router.url + "/healthz",
+                                        timeout=10) as r:
+                assert r.status == 200
+            again = _post(router.url, probe)
+            assert again["tokens"] == first["tokens"]
+            assert again["replica"] != victim_name
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_router_http_surface(model):
+    """/stats, /metrics, and 400 passthrough for malformed bodies."""
+    srv = ServeHTTPServer(make_engine(model)).start()
+    try:
+        with RouterHTTPServer([srv.url]) as router:
+            with urllib.request.urlopen(router.url + "/stats") as r:
+                stats = json.loads(r.read())
+            assert stats["replicas"]["r0"]["healthy"] is True
+            with urllib.request.urlopen(router.url + "/metrics") as r:
+                prom = r.read().decode()
+            assert "tk8s_route_replica_healthy" in prom
+            # A replica-side validation error passes through as the 400
+            # it is (it would fail identically on every replica).
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(router.url, {"tokens": [1, -4], "max_new_tokens": 2})
+            assert err.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(router.url, {"tokens": [1], "max_new_tokens": 2,
+                                   "session_id": 7})
+            assert err.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_router_imports_without_jax():
+    """The route verb's deployment story: a router box has no
+    accelerator stack. Importing the router (and the serve package's
+    eager slice) must not drag jax in — serve/__init__ resolves the
+    engine/server/blocks names lazily (PEP 562)."""
+    import subprocess
+    import sys as _sys
+    out = subprocess.run(
+        [_sys.executable, "-c",
+         "import sys; "
+         "from triton_kubernetes_tpu.serve.router import RouterHTTPServer; "
+         "from triton_kubernetes_tpu.serve import Router, SessionSchedule; "
+         "print('jax' in sys.modules)"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "False", (
+        "importing the router loaded jax")
+
+
+def test_router_passes_replica_timeout_through_without_eject(model):
+    """A replica answering 504 (its own per-request timeout) is slow,
+    not dead: the router must return the 504, keep the replica in
+    rotation, count no placement, and surface the timeout in /stats —
+    ejecting would re-run the same long generation on every peer."""
+    srv = ServeHTTPServer(make_engine(model), request_timeout_s=0.01)
+    srv.start()
+    try:
+        with RouterHTTPServer([srv.url], health_interval_s=10.0) as router:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(router.url, {"tokens": [1, 2, 3],
+                                   "max_new_tokens": 16})
+            assert err.value.code == 504
+            assert router.router.replicas["r0"].healthy is True
+            assert router.router.replicas["r0"].timeouts == 1
+            assert metrics.gauge("tk8s_route_replica_healthy").value(
+                replica="r0") == 1
+            # No placement recorded for the timed-out attempt.
+            assert metrics.counter("tk8s_route_requests_total").value(
+                replica="r0", reason="affine") == 0
+    finally:
+        srv.stop()

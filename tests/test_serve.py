@@ -429,3 +429,230 @@ def test_serve_port_matches_topology_pin():
     from triton_kubernetes_tpu.topology.serving import SERVE_PORT as rendered
 
     assert runtime == rendered
+
+
+# --------------------------------------- chunked prefill + prefix cache
+def test_chunked_engine_matches_legacy_solo(model):
+    """Cross-path pin: chunked prefill (any window size) reproduces the
+    legacy whole-prompt engine's tokens exactly — same per-token math,
+    fixed-width masked attention (tests/test_paged_attention.py pins the
+    logits bitwise; this pins it end to end through the scheduler)."""
+    prompt, n = [5, 7, 9, 11, 2, 4, 6, 8, 1, 3, 12, 14, 9], 8
+    legacy = solo_run(model, prompt, n)
+    for chunk in (4, 8, 16):
+        assert solo_run(model, prompt, n,
+                        engine={"prefill_chunk": chunk}) == legacy
+
+
+def test_prefix_sharing_on_off_bitwise_parity_under_eviction(model):
+    """The acceptance pin: shared-prefix churn with a pool tight enough
+    to force BOTH a preemption and prefix-cache eviction; every
+    completion with sharing ON equals the sharing-OFF run token for
+    token, and after release_prefix_cache() the pool drains to zero
+    (no leaked references)."""
+    sys_a = [5, 7, 9, 11, 2, 4, 6, 8]      # "system prompt" A (2 pages)
+    sys_b = [3, 1, 4, 1, 5, 9, 2, 6]       # "system prompt" B
+    prompts = [
+        (sys_a + [10, 11], 14),
+        (sys_a + [12], 12),
+        (sys_a + [13, 14, 15], 8),
+        (sys_b + [1, 2, 3, 4, 5], 10),
+        (sys_b + [9], 6),
+        (sys_a + [2, 2], 5),
+        # A late cold stranger: by now the cache holds the earlier
+        # prompts' pages unreferenced, and this admission's shortfall
+        # must come out of them — the eviction path under test.
+        ([8] * 16, 8),
+    ]
+    arrivals = {0: [0], 1: [1], 2: [2, 3], 4: [4], 6: [5], 24: [6]}
+
+    def run(prefix_cache):
+        metrics.configure()
+        eng = make_engine(model, num_blocks=10, max_batch=3,
+                          max_model_len=32, prefill_chunk=8,
+                          prefix_cache=prefix_cache)
+        evicted = [0]
+        if prefix_cache:
+            # Count pages the cache actually gave back under pressure —
+            # the ON arm must exercise the eviction path, or "parity
+            # under eviction" is a vacuous claim.
+            orig = eng.prefix.evict
+
+            def counting_evict(n):
+                freed = orig(n)
+                evicted[0] += freed
+                return freed
+
+            eng.prefix.evict = counting_evict  # type: ignore[method-assign]
+        results = {}
+        step = 0
+        while eng.has_work or step <= 24:
+            for idx in arrivals.get(step, []):
+                p, n = prompts[idx]
+                eng.submit(Request(f"r{idx}", p, n))
+            for d in eng.step():
+                results[d.request_id] = d.tokens
+            step += 1
+            assert step < 500, "engine failed to drain"
+        preempts = metrics.counter("tk8s_serve_preemptions_total").value()
+        hits = metrics.counter(
+            "tk8s_serve_prefix_hit_tokens_total").value()
+        eng.release_prefix_cache()
+        assert eng.allocator.in_use == 0, "leaked KV pages"
+        assert eng.allocator.available == eng.allocator.capacity
+        return results, preempts, hits, evicted[0]
+
+    off, preempts_off, _, _ = run(prefix_cache=False)
+    on, preempts_on, hits, cache_evicted = run(prefix_cache=True)
+    assert on == off, "prefix sharing changed outputs"
+    assert preempts_off >= 1, "scenario must force a preemption"
+    assert cache_evicted >= 1, "scenario must force a cache eviction"
+    assert hits > 0, "scenario must exercise prefix reuse"
+
+
+def test_prefix_cache_hit_accounting(model):
+    """A repeated system prompt prefills once: the second request's
+    full-window prefix rides the cache (hit counter moves by exactly the
+    reused tokens) and the gauge tracks indexed pages."""
+    metrics.configure()
+    eng = make_engine(model, prefill_chunk=8, prefix_cache=True)
+    prompt = [5, 7, 9, 11, 2, 4, 6, 8, 1, 3]  # 2 full pages + tail
+    eng.submit(Request("a", prompt, 4))
+    eng.run_until_idle()
+    assert metrics.counter(
+        "tk8s_serve_prefix_hit_tokens_total").value() == 0
+    eng.submit(Request("b", prompt, 4))
+    eng.run_until_idle()
+    # 8 of b's 10 prompt tokens (one whole 8-token window) were cached.
+    assert metrics.counter(
+        "tk8s_serve_prefix_hit_tokens_total").value() == 8
+    assert eng.prefix.pages >= 2
+    s = eng.stats()
+    assert s["prefix_cache"] is True and s["prefix_cache_pages"] >= 2
+    assert s["prefill_chunk"] == 8
+    assert metrics.gauge("tk8s_serve_prefix_cache_pages").value() \
+        == eng.prefix.pages
+
+
+def test_chunked_prefill_does_not_stall_decode(model):
+    """The TPOT-ceiling pin: while a long prompt chunk-prefills, an
+    already-decoding sequence keeps generating EVERY step — the stall
+    chunked prefill exists to remove (a 48-token prompt at chunk 8 is 6
+    windows; the legacy engine would freeze decodes for all of them)."""
+    eng = make_engine(model, num_blocks=40, max_batch=2, max_model_len=64,
+                      prefill_chunk=8)
+    eng.submit(Request("short", [5, 7, 9], 20))
+    eng.step()  # short admits, prefills (1 window), decodes its first
+    long_prompt = [(i * 7) % 50 + 1 for i in range(48)]
+    eng.submit(Request("long", long_prompt, 4))
+    for _ in range(4):  # long is mid-prefill for >= 6 steps
+        before = len(eng.slots[0].generated)
+        eng.step()
+        slot_long = next(s for s in eng.slots
+                         if s is not None and s.request.request_id == "long")
+        assert slot_long.prefilled < slot_long.target, (
+            "long prompt finished prefill too early for this pin")
+        after = len(eng.slots[0].generated)
+        assert after == before + 1, (
+            "decode stalled behind a chunked prefill")
+
+
+def test_engine_validates_chunk_and_prefix_args(model):
+    with pytest.raises(ValueError, match="multiple of the block"):
+        make_engine(model, prefill_chunk=6)  # block_size=4
+    with pytest.raises(ValueError, match="prefix_cache requires"):
+        make_engine(model, prefix_cache=True)
+
+
+def test_prefix_eviction_under_pool_pressure(model):
+    """A cold cache page is reclaimed before anyone is preempted: fill
+    the cache, then admit a stranger needing more pages than are free —
+    admission must succeed by evicting LRU cache leaves, without
+    touching the preemption counter."""
+    metrics.configure()
+    eng = make_engine(model, num_blocks=7, max_batch=2,
+                      max_model_len=24, prefill_chunk=8,
+                      prefix_cache=True)
+    eng.submit(Request("warm", [5, 7, 9, 11, 2, 4, 6, 8, 1], 3))
+    eng.run_until_idle()
+    assert eng.prefix.pages == 2
+    # 6 allocatable, the cache holds 2: the stranger needs 5 at admit
+    # (ceil(17/4)) and 6 by the end (17+4 tokens) — both shortfalls must
+    # come out of the cache, not out of anyone's decode slot.
+    eng.submit(Request("cold", [(i * 3) % 50 + 1 for i in range(17)], 4))
+    done = eng.run_until_idle()
+    assert done[0].finish_reason in ("eos", "length")
+    # The stranger's admission had to reclaim warm's cold cache pages
+    # (LRU leaves first): warm's prefix is no longer fully indexed,
+    # though the stranger's own completed prompt now is.
+    assert len(eng.prefix.lookup([5, 7, 9, 11, 2, 4, 6, 8])) < 2, (
+        "pool pressure must evict cache pages")
+    assert metrics.counter("tk8s_serve_preemptions_total").value() == 0
+    eng.release_prefix_cache()
+    assert eng.allocator.in_use == 0
+
+
+def test_prefix_cache_evictable_respects_pinned_chains():
+    """evictable() is the admission path's don't-drain-for-nothing
+    guard: a refcount-1 node above a sequence-held descendant is
+    pinned (eviction works leaf-up), so only the fully-unmapped
+    subtree counts — and evict() can reclaim exactly that many."""
+    from triton_kubernetes_tpu.serve import BlockAllocator, PrefixCache
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, 2)
+    tokens = [1, 2, 3, 4, 5, 6, 7, 8]  # 4 full pages, one chain
+    pages = alloc.alloc(4)
+    cache.insert(tokens, pages)
+    alloc.free(pages)  # writer finished; cache holds all 4
+    assert cache.evictable() == 4
+    # A live sequence maps the first 3 pages: the chain's tail page is
+    # the only evictable one (pages 1-2 are pinned below... above it).
+    held = cache.lookup(tokens[:6])
+    alloc.incref(held)
+    assert len(held) == 3
+    assert cache.evictable() == 1
+    assert cache.evict(4) == 1  # asks for 4, can only ever free 1
+    assert cache.pages == 3
+    alloc.free(held)
+    assert cache.evictable() == 3
+    cache.clear()
+    assert alloc.in_use == 0
+
+
+def test_prefix_eviction_true_lru_after_partial_lookup():
+    """The LRU-order pin: a lookup matching only a PREFIX of a path
+    bumps the parent but not its leaf, so mid-eviction a newly exposed
+    parent can be colder than an unrelated newer leaf — evict() must
+    re-select after every removal, not free a pre-collected batch."""
+    from triton_kubernetes_tpu.serve import BlockAllocator, PrefixCache
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, 2)
+    a_b = [1, 2, 3, 4]   # path [A][B], inserted at t1
+    pages = alloc.alloc(2)
+    cache.insert(a_b, pages)
+    alloc.free(pages)
+    cache.lookup([1, 2])             # t2: bumps A only, B stays t1
+    c = alloc.alloc(1)
+    cache.insert([9, 9], c)          # t3: unrelated leaf C
+    alloc.free(c)
+    assert cache.evictable() == 3
+    assert cache.evict(2) == 2       # true LRU: B (t1) then A (t2)
+    assert cache.lookup([9, 9]), "hotter leaf C was evicted before A"
+    assert cache.pages == 1
+    cache.clear()
+    assert alloc.in_use == 0
+
+
+def test_http_request_timeout_is_504_not_503(model):
+    """A per-request timeout must be distinguishable from engine death:
+    503 means the loop died (the router ejects on it), 504 means "slow,
+    still computing" (the router passes it through) — conflating them
+    turns one long prompt into a fleet-wide eject storm."""
+    srv = ServeHTTPServer(make_engine(model), request_timeout_s=0.01)
+    with srv:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(srv.url, {"tokens": [1, 2, 3], "max_new_tokens": 16})
+        assert err.value.code == 504
+        # The engine loop is alive and well: liveness stays 200.
+        with urllib.request.urlopen(srv.url + "/healthz") as r:
+            assert r.status == 200

@@ -179,3 +179,118 @@ def test_wavefront_state_saves_bitwise_stable_under_interleaving():
         prints[name] = tw._fingerprint(doc)
     assert prints["stress-par8a"] == prints["stress-serial"]
     assert prints["stress-par8b"] == prints["stress-serial"]
+
+
+# ------------------------------------------- refcounted allocator/radix
+
+def test_refcounted_allocator_no_free_while_referenced():
+    """Refcount discipline under interleaved sharing (externally
+    serialized, as the engine loop serializes it): a page with
+    outstanding references is NEVER handed out by alloc, every free
+    drops exactly one reference, and the pool drains to full after the
+    churn — the prefix-sharing safety contract."""
+    alloc = BlockAllocator(num_blocks=N_THREADS * 4 + 1)
+    lock = threading.Lock()
+    refs_held: dict = {}  # page -> live references we handed out
+
+    def work(i):
+        for k in range(N_OPS // 8):
+            shares = 1 + (i + k) % 3
+            with lock:
+                try:
+                    pages = alloc.alloc(1 + k % 2)
+                except OutOfBlocksError:
+                    continue
+                for p in pages:
+                    assert p not in refs_held, (
+                        f"page {p} re-allocated while referenced")
+                    refs_held[p] = 1
+                alloc.incref(pages * shares)
+                for p in pages:
+                    refs_held[p] += shares
+                    assert alloc.refcount(p) == refs_held[p]
+            # interleave point: other threads alloc/share/free here
+            for _ in range(shares + 1):
+                with lock:
+                    for p in pages:
+                        assert alloc.refcount(p) == refs_held[p], (
+                            "foreign thread moved our refcount")
+                    alloc.free(pages)
+                    for p in pages:
+                        refs_held[p] -= 1
+                        if refs_held[p] == 0:
+                            del refs_held[p]
+
+    _run_workers(work)
+    assert alloc.in_use == 0
+    assert alloc.available == alloc.capacity
+    assert alloc.alloc(3) == [1, 2, 3]  # determinism survives churn
+
+
+def test_radix_index_agrees_with_pool_under_churn():
+    """Seeded property churn over the full PrefixCache lifecycle —
+    insert / lookup+map / evict / sequence-free in random order. After
+    EVERY operation the radix index and the allocator must agree: every
+    indexed page allocated with refcount >= 1, no page indexed twice,
+    eviction only ever reclaims pages no sequence maps, and the pool
+    drains exactly when the last holder (cache or sequence) lets go."""
+    import random as _random
+
+    from triton_kubernetes_tpu.serve.blocks import PrefixCache
+
+    rng = _random.Random(1234)
+    bs = 4
+    alloc = BlockAllocator(num_blocks=64)
+    cache = PrefixCache(alloc, bs)
+    vocab = 6  # tiny vocab: collisions (shared prefixes) are the point
+    live_seqs: list = []  # (pages_held,) per live sequence
+
+    def check_agreement():
+        indexed = cache.indexed_pages()
+        assert len(indexed) == len(set(indexed)) == cache.pages, (
+            "radix index holds duplicate or miscounted pages")
+        for p in indexed:
+            assert alloc.refcount(p) >= 1, (
+                f"indexed page {p} is not allocated")
+        held = set(indexed)
+        for pages in live_seqs:
+            held.update(pages)
+        assert alloc.in_use == len(held), (
+            f"pool says {alloc.in_use} pages in use, holders say "
+            f"{len(held)}")
+
+    for step in range(400):
+        op = rng.randrange(4)
+        if op == 0 and alloc.available >= 8:  # new sequence + insert
+            prompt = [rng.randrange(vocab)
+                      for _ in range(rng.randint(bs, 5 * bs))]
+            matched = cache.lookup(prompt)
+            usable = min(len(matched) * bs, len(prompt) - 1) // bs
+            reuse = matched[:usable]
+            alloc.incref(reuse)
+            need = -(-len(prompt) // bs) - len(reuse)
+            pages = reuse + alloc.alloc(need)
+            cache.insert(prompt, pages)
+            live_seqs.append(pages)
+        elif op == 1 and live_seqs:  # a sequence finishes
+            alloc.free(live_seqs.pop(rng.randrange(len(live_seqs))))
+        elif op == 2:  # pool pressure: evict some cold cache pages
+            before = {p: alloc.refcount(p) for p in cache.indexed_pages()}
+            cache.evict(rng.randint(1, 4))
+            for p, r in before.items():
+                if r > 1:  # mapped by a live sequence: must survive
+                    assert alloc.refcount(p) >= r - 1
+                    assert alloc.refcount(p) >= 1
+        else:  # lookups alone must not perturb accounting
+            cache.lookup([rng.randrange(vocab)
+                          for _ in range(rng.randint(1, 3 * bs))])
+        check_agreement()
+
+    for pages in live_seqs:
+        alloc.free(pages)
+    live_seqs.clear()
+    check_agreement()
+    cache.clear()
+    assert cache.pages == 0
+    assert alloc.in_use == 0
+    assert alloc.available == alloc.capacity

@@ -268,3 +268,66 @@ def test_daemonset_variants_distinct_across_shapes():
     env = {e["name"]: e["value"] for e in
            p_p["spec"]["template"]["spec"]["containers"][0]["env"]}
     assert env["TPU_CHIP_COUNT"] == "2"
+
+
+def test_router_deployment_and_service_render():
+    """The fleet front door (ISSUE 12): a CPU-only router Deployment
+    fronting the replica set, its VIP Service, and the headless replica
+    Service that gives the router per-pod addresses — affinity only
+    means something when the router can name a specific replica's KV."""
+    from triton_kubernetes_tpu.constants import ROUTE_PORT
+    from triton_kubernetes_tpu.topology import (
+        render_router_deployment, render_router_service,
+        render_serving_service)
+    from triton_kubernetes_tpu.topology.serving import (
+        APP_LABEL, ROLE_LABEL, default_route_command)
+    from triton_kubernetes_tpu.topology.validate import validate_manifest
+
+    urls = [f"http://llm-serve-{i}.llm-serve:8000" for i in range(3)]
+    dep = render_router_deployment(
+        "llm-route", image="tk8s/jax-tpu-runtime:0.1.0",
+        replica_urls=urls, replicas=2)
+    svc = render_router_service("llm-route")
+    validate_manifest(dep)
+    validate_manifest(svc)
+
+    assert dep["spec"]["replicas"] == 2
+    pod = dep["spec"]["template"]["spec"]
+    assert "nodeSelector" not in pod  # CPU plumbing: schedules anywhere
+    c = pod["containers"][0]
+    assert "resources" not in c  # no TPU limits on the router
+    assert c["command"] == default_route_command(urls)
+    assert c["command"].count("--replica") == 3
+    for url in urls:
+        assert url in c["command"]
+    assert "--route-host" in c["command"] and "0.0.0.0" in c["command"]
+    assert c["ports"][0]["containerPort"] == ROUTE_PORT
+    assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    assert svc["spec"]["selector"] == {APP_LABEL: "llm-route",
+                                       ROLE_LABEL: "router"}
+    assert svc["spec"]["ports"][0]["port"] == ROUTE_PORT
+    # The router must never be selected by a replica Service (and vice
+    # versa): the role label disambiguates a shared app name.
+    assert dep["spec"]["template"]["metadata"]["labels"][ROLE_LABEL] \
+        == "router"
+
+    headless = render_serving_service("llm-serve", headless=True)
+    validate_manifest(headless)
+    assert headless["spec"]["clusterIP"] == "None"
+    plain = render_serving_service("llm-serve")
+    assert "clusterIP" not in plain["spec"]
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="at least one replica"):
+        render_router_deployment("r", image="img", replica_urls=[])
+
+
+def test_route_port_matches_constants_pin():
+    """ROUTE_PORT crosses the jax boundary exactly like SERVE_PORT:
+    rendered jax-free here, bound at runtime by serve/router.py through
+    the CLI default (TK8S104's agreement contract)."""
+    from triton_kubernetes_tpu.constants import ROUTE_PORT, SERVE_PORT
+    assert ROUTE_PORT != SERVE_PORT  # shared pod netns must not collide
+    from triton_kubernetes_tpu.topology import render_router_service
+    assert render_router_service("x")["spec"]["ports"][0]["port"] \
+        == ROUTE_PORT

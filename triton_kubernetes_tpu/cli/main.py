@@ -22,7 +22,7 @@ from typing import List, Optional
 
 from .. import __version__
 from ..backends import Backend, LocalBackend, ObjectStoreBackend
-from ..constants import KV_DTYPES, WEIGHT_DTYPES
+from ..constants import KV_DTYPES, ROUTE_PORT, WEIGHT_DTYPES
 from ..backends.objectstore import DirObjectStore
 from ..backends.base import StateLockedError, StateNotFoundError
 from ..backends.gcs import GcsConfigError
@@ -292,9 +292,68 @@ def build_parser() -> argparse.ArgumentParser:
                        help="serve one request at a time (the continuous-"
                             "batching A/B baseline; scripts/ci/"
                             "serving_evidence.py)")
+    serve.add_argument("--prefill-chunk", type=int, default=None,
+                       metavar="N",
+                       help="chunked prefill: split prompts into N-token "
+                            "windows interleaved with decode steps so a "
+                            "long prompt cannot stall in-flight decodes; "
+                            "must be a multiple of --block-size; 0 = "
+                            "legacy whole-prompt prefill at admission "
+                            "(default: 256, adapted to --block-size; "
+                            "docs/guide/serving.md §Chunked prefill)")
+    serve.add_argument("--prefix-cache", dest="prefix_cache",
+                       action="store_true", default=None,
+                       help="share full-page-aligned prompt prefixes "
+                            "across requests via the refcounted radix KV "
+                            "index — a common system prompt prefills "
+                            "once, not once per user (default: on "
+                            "whenever chunked prefill is; requires "
+                            "--prefill-chunk > 0; docs/guide/serving.md "
+                            "§Prefix caching)")
+    serve.add_argument("--no-prefix-cache", dest="prefix_cache",
+                       action="store_false",
+                       help="disable shared-prefix KV reuse (outputs are "
+                            "identical either way — the cache is a pure "
+                            "prefill-compute save)")
     serve.add_argument("--seed", type=int, default=0, metavar="N",
                        help="parameter-init seed for the randomly "
                             "initialized model (default: 0)")
+
+    route = sub.add_parser(
+        "route",
+        help="run the session-affine router over N serving replicas: "
+             "consistent-hash affinity, least-loaded spill, health-aware "
+             "ejection (docs/guide/serving.md §Router)")
+    route.add_argument("--replica", action="append", required=True,
+                       metavar="URL", dest="replicas",
+                       help="replica base URL (repeatable), e.g. "
+                            "http://10.0.0.7:8000")
+    route.add_argument("--route-host", default="127.0.0.1", metavar="ADDR",
+                       help="bind address (default: 127.0.0.1; manifests "
+                            "use 0.0.0.0)")
+    route.add_argument("--port", type=int, default=ROUTE_PORT, metavar="N",
+                       help=f"bind port (default: {ROUTE_PORT}; "
+                            "0 = ephemeral)")
+    route.add_argument("--spill-threshold", type=int, default=4,
+                       metavar="N",
+                       help="router-tracked in-flight requests at the "
+                            "affine replica beyond which a request "
+                            "spills to the least-loaded healthy replica "
+                            "(default: 4)")
+    route.add_argument("--virtual-nodes", type=int, default=64,
+                       metavar="N",
+                       help="consistent-hash ring points per replica — "
+                            "more points, smoother key spread (default: "
+                            "64)")
+    route.add_argument("--health-interval", type=float, default=0.5,
+                       metavar="SECONDS",
+                       help="background /healthz probe period; a probe "
+                            "failure ejects the replica, a later 200 "
+                            "re-admits it (default: 0.5)")
+    route.add_argument("--request-timeout", type=float, default=120.0,
+                       metavar="SECONDS",
+                       help="per-attempt timeout for proxied /generate "
+                            "calls (default: 120)")
 
     sub.add_parser("version", help="print version")
     return p
@@ -404,24 +463,91 @@ def main(argv: Optional[List[str]] = None,
         _metrics.get_registry().register_catalog()
         logger.info("initializing model", model=args.model,
                     backend=_jax.default_backend())
+        if args.prefill_chunk is None:
+            # The default adapts to the block size; an EXPLICIT value is
+            # validated strictly below — a silently rewritten chunk size
+            # is a benchmark run measuring something the operator did
+            # not ask for.
+            prefill_chunk = max(args.block_size, 256 - 256 % args.block_size)
+        elif args.prefill_chunk < 0:
+            # Only 0 is the legacy sentinel; a negative value is a typo
+            # that would otherwise silently benchmark the wrong engine.
+            logger.error(
+                f"--prefill-chunk must be >= 0, got {args.prefill_chunk}",
+                kind="ValueError")
+            return 2
+        else:
+            prefill_chunk = args.prefill_chunk or None
+        if prefill_chunk is not None and (
+                prefill_chunk % args.block_size != 0):
+            logger.error(
+                f"--prefill-chunk {prefill_chunk} is not a multiple of "
+                f"--block-size {args.block_size}", kind="ValueError")
+            return 2
+        if args.prefix_cache and prefill_chunk is None:
+            logger.error(
+                "--prefix-cache requires chunked prefill: prefix reuse "
+                "skips whole chunk windows (set --prefill-chunk > 0)",
+                kind="ValueError")
+            return 2
+        prefix_cache = (prefill_chunk is not None
+                        if args.prefix_cache is None
+                        else args.prefix_cache)
         engine = ServeEngine(
             init_params(model_config, _jax.random.PRNGKey(args.seed)),
             model_config,
             block_size=args.block_size, num_blocks=args.num_blocks,
             max_batch=args.max_batch, max_model_len=args.max_model_len,
             sequential=args.sequential,
-            kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype)
+            kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+            prefill_chunk=prefill_chunk,
+            prefix_cache=prefix_cache)
         server = ServeHTTPServer(engine, host=args.serve_host,
                                  port=args.port)
         host, port = server.address
         logger.info("serving", url=f"http://{host}:{port}",
                     model=args.model, block_size=args.block_size,
                     num_blocks=args.num_blocks, max_batch=args.max_batch,
-                    kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype)
+                    kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+                    prefill_chunk=prefill_chunk,
+                    prefix_cache=prefix_cache)
         print(f"serving {args.model} on http://{host}:{port} "
               f"(POST /generate, GET /metrics, GET /healthz)", flush=True)
         try:
             server.serve_forever()
+        except KeyboardInterrupt:
+            print("\nstopped", file=sys.stderr)
+        finally:
+            if trace is not None:
+                trace.write(args.trace_out)
+        return 0
+
+    if args.command == "route":
+        # The router is jax-free on purpose: it speaks HTTP to replicas
+        # and runs fine on a machine with no accelerator stack at all.
+        from ..serve.router import RouterHTTPServer
+        from ..utils import metrics as _metrics
+
+        _metrics.get_registry().register_catalog()
+        try:
+            router = RouterHTTPServer(
+                args.replicas, host=args.route_host, port=args.port,
+                health_interval_s=args.health_interval,
+                spill_threshold=args.spill_threshold,
+                virtual_nodes=args.virtual_nodes,
+                request_timeout_s=args.request_timeout)
+        except ValueError as e:
+            logger.error(str(e), kind="ValueError")
+            return 2
+        host, port = router.address
+        logger.info("routing", url=f"http://{host}:{port}",
+                    replicas=len(args.replicas),
+                    spill_threshold=args.spill_threshold)
+        print(f"routing {len(args.replicas)} replicas on "
+              f"http://{host}:{port} (POST /generate, GET /metrics, "
+              f"GET /healthz, GET /stats)", flush=True)
+        try:
+            router.serve_forever()
         except KeyboardInterrupt:
             print("\nstopped", file=sys.stderr)
         finally:

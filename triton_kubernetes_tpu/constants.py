@@ -28,6 +28,12 @@ COORDINATOR_PORT = 8476
 # (topology/serving.py), bound by serve/server.py.
 SERVE_PORT = 8000
 
+# The router endpoint port (`tk8s route`): rendered into the router
+# Deployment/Service (topology/serving.py), bound by serve/router.py's
+# HTTP server. Distinct from SERVE_PORT so a router and a replica can
+# share a pod network namespace during local runs.
+ROUTE_PORT = 8001
+
 # Process exit codes — bounded and machine-readable so launchers, the
 # JobSet podFailurePolicy, and CI classify terminations without parsing
 # logs:

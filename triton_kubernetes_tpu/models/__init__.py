@@ -28,6 +28,7 @@ from .paged import (
     init_paged_cache,
     paged_decode_step,
     paged_prefill,
+    paged_prefill_chunk,
 )
 from . import mixtral
 
@@ -50,5 +51,6 @@ __all__ = [
     "PagedKVCache",
     "init_paged_cache",
     "paged_prefill",
+    "paged_prefill_chunk",
     "paged_decode_step",
 ]

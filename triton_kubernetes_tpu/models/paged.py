@@ -51,9 +51,12 @@ from jax import lax
 # pages + scales. Pinned in constants.py (the CLI registers the choices
 # on jax-less machines; this module validates them at runtime).
 from ..constants import KV_DTYPES
+from ..ops.attention import causal_attention
 from ..ops.paged_attention import (
+    gather_pages,
     ragged_paged_attention,
     resolve_paged_impl,
+    scatter_chunk,
     scatter_token,
 )
 from ..ops.quantization import kv_quant_error, quantize_kv_pages
@@ -214,6 +217,134 @@ def paged_prefill(
     err = (kv_quant_error(qk, sk[:, :, :, None, None], k, mask),
            kv_quant_error(qv, sv[:, :, :, None, None], v, mask))
     return last, new, err
+
+
+def paged_prefill_chunk(
+    params,
+    tokens: jnp.ndarray,  # [1, C] int32 — one window, right-padded
+    offset: jnp.ndarray,  # [] int32 — tokens already in pages (C-aligned)
+    chunk_len: jnp.ndarray,  # [] int32 — real tokens this window (1..C)
+    config: ModelConfig,
+    cache: PagedKVCache,
+    block_table: jnp.ndarray,  # [T] int32 — the sequence's FULL table
+    with_quant_error: bool = False,
+) -> Union[Tuple[jnp.ndarray, PagedKVCache],
+           Tuple[jnp.ndarray, PagedKVCache, Tuple[jnp.ndarray,
+                                                  jnp.ndarray]]]:
+    """One chunk of an incremental prefill: run ``C`` prompt tokens at
+    positions ``offset .. offset+C-1``, land their K/V in this window's
+    pages, and attend to everything written so far (earlier chunks,
+    prefix-cache pages, and this chunk itself, causally).
+
+    This is the trace chunked prefill and prefix-cache reuse both ride
+    (docs/guide/serving.md): the engine walks a prompt window by window
+    — reused windows are *skipped outright* (their pages already hold
+    this exact prefix's K/V), computed windows all share this ONE
+    ``[1, C]`` trace, so a 32k-token prompt costs many small steps the
+    scheduler interleaves with decode instead of one batch-freezing
+    monolith. Returns (logits [V] f32 at row ``chunk_len - 1``, updated
+    pool) — the logits only matter on the final window, where that row
+    is the prompt's last real token. Plus the ``(k_err, v_err)`` device
+    scalars over this window's real slots when ``with_quant_error`` is
+    set on a quantized pool.
+
+    Contract with the engine (all static-shape or host-enforced):
+    ``C % block_size == 0``; ``offset`` is a multiple of ``C`` (windows
+    are *absolute* — window ``j`` always covers tokens
+    ``[j*C, (j+1)*C)`` whatever was reused, which is what makes outputs
+    with prefix sharing ON bitwise equal to OFF: every computed window
+    presents the identical trace and identical page contents either
+    way); ``T * block_size % C == 0`` so every window's pages sit inside
+    the table.
+
+    Numerics: per-token math is the same ``llama._qkv`` /
+    ``causal_attention`` / ``llama._mlp`` chain as ``paged_prefill``'s
+    dense forward; attention keys are gathered at the table's fixed
+    ``T * block_size`` width with explicit positions, so masked slots
+    (future tokens, pad garbage, trash pages) contribute exactly zero.
+    """
+    _, c = tokens.shape
+    bs = cache.block_size
+    t = block_table.shape[0]
+    if c % bs != 0:
+        raise ValueError(
+            f"chunk width {c} must be a multiple of the block size {bs}")
+    if (t * bs) % c != 0:
+        raise ValueError(
+            f"table width {t * bs} tokens must be a multiple of the "
+            f"chunk width {c} (pad the table, not the chunk)")
+    if with_quant_error and not cache.quantized:
+        raise ValueError("with_quant_error only applies to int8 pools")
+    w = c // bs
+    ad = config.activation_dtype
+    quantized = cache.quantized
+    positions = (offset + jnp.arange(c, dtype=jnp.int32))[None]  # [1, C]
+    k_positions = jnp.arange(t * bs, dtype=jnp.int32)[None]  # [1, T*bs]
+    cos, sin = rotary_tables(
+        config.head_dim, config.max_seq_len, config.rope_theta)
+    x = params["embed"].astype(ad)[tokens]  # [1, C, D]
+    window = lax.dynamic_slice(block_table, (offset // bs,), (w,))
+
+    def body(carry, layer_and_pages):
+        x = carry
+        if quantized:
+            layer, kp, vp, ks, vs = layer_and_pages
+        else:
+            layer, kp, vp = layer_and_pages
+            ks = vs = None
+        q, k, v = llama._qkv(x, layer, config, cos, sin, positions)
+        written = scatter_chunk(kp, vp, k, v, window, ks, vs)
+        if quantized:
+            kp, vp, ks, vs = written
+        else:
+            kp, vp = written
+        kk = gather_pages(kp, block_table[None], ks, q.dtype)
+        vv = gather_pages(vp, block_table[None], vs, q.dtype)
+        attn = causal_attention(q, kk, vv, positions, k_positions)
+        x = llama.project_out(x, attn, layer, config)
+        y, _ = llama._mlp(x, layer, config)
+        ys = (kp, vp, ks, vs) if quantized else (kp, vp)
+        if with_quant_error:
+            # Exact window K/V ride out as ys so the error is computed
+            # once over all layers (ratio of sums, not mean of ratios).
+            ys = ys + (k, v)
+        return x + y, ys
+
+    xs = ((params["layers"], cache.k, cache.v, cache.k_scale,
+           cache.v_scale) if quantized
+          else (params["layers"], cache.k, cache.v))
+    x, out = lax.scan(body, x, xs)
+    if quantized:
+        kp, vp, ks, vs = out[:4]
+        new = PagedKVCache(k=kp, v=vp, k_scale=ks, v_scale=vs)
+    else:
+        kp, vp = out[:2]
+        new = PagedKVCache(k=kp, v=vp)
+    # Unembed only the last real row of the window (the admission-logit
+    # parsimony rule generate.prefill's last_position established).
+    idx = jnp.reshape(chunk_len - 1, (1, 1, 1)).astype(jnp.int32)
+    h = jnp.take_along_axis(x, idx, axis=1)  # [1, 1, D]
+    logits = llama.unembed(h, params, config)[0, 0]  # [V]
+    if not with_quant_error:
+        return logits, new
+    exact_k, exact_v = out[-2], out[-1]  # [L, 1, C, Hkv, Dh]
+    ll = config.num_layers
+    hkv, dh = config.num_kv_heads, config.head_dim
+    # Window page plane per layer, same transform scatter_chunk applied.
+    pk = jnp.transpose(exact_k.reshape(ll, w, bs, hkv, dh),
+                       (0, 1, 3, 2, 4))
+    pv = jnp.transpose(exact_v.reshape(ll, w, bs, hkv, dh),
+                       (0, 1, 3, 2, 4))
+    qk = kp[:, window]
+    qv = vp[:, window]
+    sk = ks[:, window][:, :, :, None, None]
+    sv = vs[:, window][:, :, :, None, None]
+    slot = (jnp.arange(w, dtype=jnp.int32)[:, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)[None, :])  # [w, bs]
+    mask = (slot < chunk_len)[None, :, None, :, None]
+    err = (kv_quant_error(qk, sk, pk, mask),
+           kv_quant_error(qv, sv, pv, mask))
+    return logits, new, err
 
 
 def paged_decode_step(

@@ -71,7 +71,11 @@ from ..utils.jaxcompat import pallas_tpu
 # masking contract (`exp(NEG_INF - m)` underflows to 0.0) must mean the
 # same thing in both impls, or dense/pallas parity silently weakens.
 from .attention import NEG_INF, causal_attention
-from .quantization import quantize_with_scale, token_kv_scale
+from .quantization import (
+    quantize_kv_pages,
+    quantize_with_scale,
+    token_kv_scale,
+)
 
 # Physical page every allocator must reserve: the scatter/gather sink for
 # padded block-table entries and inactive batch slots.
@@ -216,6 +220,56 @@ def scatter_token(
         quantize_with_scale(v[:, 0], new_vs[:, :, None]))
     return (k_pages, v_pages,
             k_scale.at[page].set(new_ks), v_scale.at[page].set(new_vs))
+
+
+def scatter_chunk(
+    k_pages: jnp.ndarray,  # [N, Hkv, bs, D]
+    v_pages: jnp.ndarray,
+    k: jnp.ndarray,  # [1, C, Hkv, D] — a page-aligned chunk's K
+    v: jnp.ndarray,
+    window_table: jnp.ndarray,  # [C // bs] int32 physical pages
+    k_scale: Optional[jnp.ndarray] = None,  # [N, Hkv] f32 (int8 pools)
+    v_scale: Optional[jnp.ndarray] = None,
+):
+    """Write one page-aligned chunk's K/V into its ``C // bs`` pages.
+
+    The chunked-prefill sibling of :func:`scatter_token`: a whole
+    window of ``C`` tokens (``C`` a multiple of the block size) lands
+    page-plane-transposed in the pages ``window_table`` names. Returns
+    ``(k_pages, v_pages)`` — or ``(k_pages, v_pages, k_scale, v_scale)``
+    when the pool is quantized, where every written page's scale is
+    re-anchored from its own slot-0 token (``quantize_kv_pages``), the
+    exact rule decode's incremental writes follow, so chunked and
+    whole-prompt prefill produce bitwise-identical quantized pages for
+    the same token values.
+
+    Chunk tokens past the real length (a right-padded final window)
+    scatter pad garbage exactly as whole-prompt prefill does: masked out
+    of every later attention's support, then overwritten slot by slot by
+    decode.
+    """
+    n, hkv, bs, d = k_pages.shape
+    w = window_table.shape[0]
+    _, c, _, _ = k.shape
+    if c != w * bs:
+        raise ValueError(
+            f"chunk of {c} tokens does not cover window_table's "
+            f"{w} pages of {bs} slots")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    # [1, C, Hkv, D] -> [w, Hkv, bs, D]: split tokens into pages, then
+    # swap heads ahead of slots (the head-major page plane).
+    kw = jnp.transpose(k[0].reshape(w, bs, hkv, d), (0, 2, 1, 3))
+    vw = jnp.transpose(v[0].reshape(w, bs, hkv, d), (0, 2, 1, 3))
+    if k_scale is None:
+        return (k_pages.at[window_table].set(kw.astype(k_pages.dtype)),
+                v_pages.at[window_table].set(vw.astype(v_pages.dtype)))
+    qk, sk = quantize_kv_pages(kw)
+    qv, sv = quantize_kv_pages(vw)
+    return (k_pages.at[window_table].set(qk),
+            v_pages.at[window_table].set(qv),
+            k_scale.at[window_table].set(sk),
+            v_scale.at[window_table].set(sv))
 
 
 # ---------------------------------------------------------------------------

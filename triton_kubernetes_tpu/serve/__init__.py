@@ -13,26 +13,70 @@ training stack is cut:
   way cloudsim is;
 * **entrypoint** (:mod:`.server`): ``tk8s serve`` — stdlib HTTP with
   ``/generate``, ``/healthz``, and Prometheus ``/metrics`` exporting the
-  ``tk8s_serve_*`` families.
+  ``tk8s_serve_*`` families;
+* **fleet** (:mod:`.router`): ``tk8s route`` — a session-affine
+  consistent-hash router over N replicas with least-loaded spill and
+  health-aware ejection, exporting the ``tk8s_route_*`` families.
 
-:mod:`.loadgen` is the Poisson open-loop load generator that doubles as
-the provisioned cluster's acceptance test (scripts/ci/serving_evidence.py).
+:mod:`.loadgen` is the seeded open-loop load generator — Poisson,
+shared-prefix-heavy, and multi-turn-session traces — that doubles as
+the provisioned cluster's acceptance test (scripts/ci/
+serving_evidence.py, scripts/ci/prefix_router_evidence.py).
 """
 
-from .blocks import BlockAllocator, OutOfBlocksError
-from .engine import FinishedRequest, ManualClock, Request, ServeEngine
-from .loadgen import PoissonSchedule, percentile
-from .server import SERVE_PORT, ServeHTTPServer
+from importlib import import_module
+
+# The jax-free slice imports eagerly: the router and loadgen run on
+# machines with no accelerator stack at all (a router box has no TPU),
+# and SERVE_PORT comes straight from the dependency-free constants
+# module. Everything touching the model stack (engine/server/blocks —
+# blocks pulls ops.paged_attention for the trash-page pin) resolves
+# lazily via PEP 562 so `from ..serve.router import RouterHTTPServer`
+# never drags jax in.
+from ..constants import SERVE_PORT
+from .loadgen import (
+    PoissonSchedule,
+    SessionSchedule,
+    SharedPrefixSchedule,
+    percentile,
+)
+from .router import HashRing, Router, RouterHTTPServer
+
+_LAZY = {
+    "BlockAllocator": ".blocks",
+    "OutOfBlocksError": ".blocks",
+    "PrefixCache": ".blocks",
+    "FinishedRequest": ".engine",
+    "ManualClock": ".engine",
+    "Request": ".engine",
+    "ServeEngine": ".engine",
+    "ServeHTTPServer": ".server",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    return getattr(import_module(mod, __name__), name)
+
 
 __all__ = [
     "SERVE_PORT",
     "ServeHTTPServer",
     "BlockAllocator",
     "FinishedRequest",
+    "HashRing",
     "ManualClock",
     "OutOfBlocksError",
     "PoissonSchedule",
+    "PrefixCache",
     "Request",
+    "Router",
+    "RouterHTTPServer",
     "ServeEngine",
+    "SessionSchedule",
+    "SharedPrefixSchedule",
     "percentile",
 ]

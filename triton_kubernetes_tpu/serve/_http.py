@@ -1,0 +1,51 @@
+"""Shared stdlib-HTTP plumbing for the serving and router endpoints.
+
+jax-free on purpose: ``serve/server.py`` (which pulls the engine and
+therefore jax) and ``serve/router.py`` (which must import on a box with
+no accelerator stack at all) both build on this, so a fix to the JSON
+response shape, the debug-log gate, or the route labeling lands in both
+surfaces at once instead of drifting apart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict
+
+# Both endpoints expose the same wire surface; unknown paths are
+# bucketed as "other" in the HTTP counters so label cardinality cannot
+# be driven by scanners.
+ROUTES = ("/healthz", "/metrics", "/stats", "/generate")
+
+
+def route_label(path: str) -> str:
+    return path if path in ROUTES else "other"
+
+
+class JSONHandler(BaseHTTPRequestHandler):
+    """Request-handler base: JSON responses, Prometheus text responses,
+    and per-request logging gated behind TK8S_SERVE_DEBUG (stdlib's
+    default stderr line per request would swamp serving logs)."""
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        if os.environ.get("TK8S_SERVE_DEBUG"):
+            super().log_message(fmt, *args)
+
+    def _json(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _prometheus(self, text: str) -> None:
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)

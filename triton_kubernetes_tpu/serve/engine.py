@@ -3,18 +3,41 @@
 One :meth:`ServeEngine.step` is one scheduler tick, vLLM-style:
 
 1. **admit** — pop waiting requests while a decode slot and enough KV
-   pages exist; each admit runs the (right-padded, single-trace) paged
-   prefill and samples the request's first token — TTFT is measured to
-   *here*, not to completion;
-2. **grow/preempt** — every running sequence gets the page its next
-   token needs; when the pool is dry, the latest-admitted sequence is
-   preempted: pages freed, sequence pushed back to the queue front, to
-   be re-prefilled later from prompt + tokens-so-far (recompute, not
-   swap). Output is unaffected — teacher-forced re-prefill of its own
-   greedy/seeded continuation reproduces the same next token;
-3. **decode** — ONE batched ragged decode step for all running
-   sequences (always ``max_batch`` wide; inactive slots ride the trash
-   page), then per-sequence sampling, completion checks, page frees.
+   pages exist. In the default (legacy) mode each admit runs the
+   (right-padded, single-trace) paged prefill and samples the request's
+   first token — TTFT is measured to *here*, not to completion. With
+   ``prefill_chunk`` set, admission only *allocates* (pages + a prefix-
+   cache lookup when sharing is on) and prefill compute moves to step 2;
+2. **chunked prefill** (``prefill_chunk=C``) — ONE ``C``-token window
+   of the oldest still-prefilling sequence runs per tick, interleaved
+   with decode, so a 32k-token prompt can no longer freeze every
+   in-flight decode for its whole prefill: the TPOT ceiling per tick is
+   one chunk + one decode. Windows are *absolute* (window ``j`` covers
+   prompt tokens ``[j*C, (j+1)*C)``); with ``prefix_cache=True``,
+   windows whose pages the radix index already holds are skipped
+   outright — the request maps the same immutable pages (refcounted,
+   ``serve/blocks.py``) and pays zero prefill for them, which is what
+   turns a shared system prompt from O(users) prefill into O(1). The
+   first token samples when the last window lands (TTFT stops there);
+3. **grow/preempt** — every decoding sequence gets the page its next
+   token needs; when the pool is dry, unreferenced prefix-cache pages
+   are evicted (LRU leaves) first, then the latest-admitted sequence is
+   preempted: pages freed (refcounts dropped), sequence pushed back to
+   the queue front, to be re-prefilled later from prompt +
+   tokens-so-far (recompute, not swap). Output is unaffected —
+   teacher-forced re-prefill of its own greedy/seeded continuation
+   reproduces the same next token;
+4. **decode** — ONE batched ragged decode step for all fully-prefilled
+   sequences (always ``max_batch`` wide; inactive and still-prefilling
+   slots ride the trash page), then per-sequence sampling, completion
+   checks, page frees.
+
+Prefix sharing is bitwise-invisible in the outputs (pinned in
+tests/test_serve.py): computed windows present the identical trace and
+identical page contents whether the prefix came from the cache or was
+just computed, because cached pages were written by these exact windows
+of these exact tokens. Generated tokens always land in pages the
+sequence exclusively owns, so copy-on-write never arises.
 
 Determinism is the design axis, exactly like cloudsim: the clock is
 injectable (:class:`ManualClock` for tests), allocation is
@@ -46,11 +69,12 @@ from ..models.paged import (
     init_paged_cache,
     paged_decode_step,
     paged_prefill,
+    paged_prefill_chunk,
 )
 from ..ops.paged_attention import TRASH_PAGE, blocks_for
 from ..train.precision import quantize_for_decode
 from ..utils import metrics
-from .blocks import BlockAllocator, OutOfBlocksError
+from .blocks import BlockAllocator, OutOfBlocksError, PrefixCache
 
 
 class ManualClock:
@@ -121,6 +145,13 @@ class _Sequence:
     preemptions: int = 0
     pages: List[int] = field(default_factory=list)
     admit_seq: int = -1  # admission order; preemption evicts the highest
+    # Chunked-prefill progress: tokens of the teacher-forced prompt
+    # already in pages vs its full length. prefilled == target means the
+    # sequence is decoding (legacy whole-prompt prefill sets both at
+    # admission); prefilled < target means it still owns a decode slot
+    # but rides the trash page in decode batches.
+    prefilled: int = 0
+    target: int = 0
 
     @property
     def length(self) -> int:
@@ -148,6 +179,8 @@ class ServeEngine:
         sequential: bool = False,
         kv_dtype: str = "auto",
         weight_dtype: str = "auto",
+        prefill_chunk: Optional[int] = None,
+        prefix_cache: bool = False,
         clock: Callable[[], float] = time.monotonic,
     ):
         if block_size < 1:
@@ -157,6 +190,17 @@ class ServeEngine:
         if kv_dtype not in KV_DTYPES:
             raise ValueError(
                 f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+        if prefill_chunk is not None and (
+                prefill_chunk < block_size
+                or prefill_chunk % block_size != 0):
+            raise ValueError(
+                f"prefill_chunk must be a positive multiple of the block "
+                f"size {block_size}, got {prefill_chunk}")
+        if prefix_cache and prefill_chunk is None:
+            raise ValueError(
+                "prefix_cache requires prefill_chunk: prefix reuse skips "
+                "whole chunk windows (the absolute-window alignment is "
+                "what keeps sharing ON/OFF outputs identical)")
         # Decode weight policy first: params and config are rewritten as
         # one (the apply-policy shape) BEFORE the jit closures below
         # capture either, so a half-quantized engine cannot exist.
@@ -170,12 +214,21 @@ class ServeEngine:
         self.max_model_len = min(max_model_len or config.max_seq_len,
                                  config.max_seq_len)
         self.sequential = sequential
+        self.prefill_chunk = prefill_chunk
         self.clock = clock
         # One table width serves prefill and decode: enough pages for a
-        # full-length sequence, prompt width padded up to whole pages.
+        # full-length sequence, prompt width padded up to whole pages —
+        # and, under chunked prefill, up to whole chunk windows, so
+        # every absolute window sits inside the table.
         self.blocks_per_seq = blocks_for(self.max_model_len, block_size)
+        if prefill_chunk is not None:
+            per_window = prefill_chunk // block_size
+            self.blocks_per_seq = (
+                -(-self.blocks_per_seq // per_window) * per_window)
         self.prefill_width = self.blocks_per_seq * block_size
         self.allocator = BlockAllocator(num_blocks)
+        self.prefix = (PrefixCache(self.allocator, block_size)
+                       if prefix_cache else None)
         self.cache = init_paged_cache(config, num_blocks, block_size,
                                       kv_dtype=kv_dtype)
         self.waiting: Deque[_Sequence] = deque()
@@ -210,6 +263,15 @@ class ServeEngine:
                 _cache_like(self.cache, *pool), table,
                 with_quant_error=quantized),
             donate_argnums=(3,))
+        # tk8s: donate-safe(same pool-ownership contract as _prefill:
+        # device-allocated pool arrays, rebound from the result each
+        # chunk)
+        self._prefill_chunk_fn = jax.jit(
+            lambda p, toks, off, clen, pool, table: paged_prefill_chunk(
+                p, toks, off, clen, cfg,
+                _cache_like(self.cache, *pool), table,
+                with_quant_error=quantized),
+            donate_argnums=(4,))
         # tk8s: donate-safe(same pool-ownership contract as _prefill:
         # device-allocated pool arrays, rebound from the result each
         # decode step)
@@ -267,8 +329,11 @@ class ServeEngine:
         """One scheduler tick; returns requests that completed in it."""
         finished: List[FinishedRequest] = []
         self._admit(finished)
+        if self.prefill_chunk is not None:
+            self._prefill_tick(finished)
         self._ensure_growth_pages()
-        if self.num_running:
+        if any(s is not None and s.prefilled >= s.target
+               for s in self.slots):
             self._decode_once(finished)
         self._steps += 1
         self._update_gauges()
@@ -300,18 +365,116 @@ class ServeEngine:
             seq = self.waiting[0]
             prompt = list(seq.request.tokens) + list(seq.generated)
             need = blocks_for(len(prompt), self.block_size)
-            if need > self.allocator.available:
+            reuse: List[int] = []
+            if self.prefix is not None:
+                reuse = self._reusable_pages(prompt)
+                # Hold the reused pages BEFORE eviction can run: a page
+                # at refcount 1 (cache-only) is eviction's prey.
+                self.allocator.incref(reuse)
+            fresh = need - len(reuse)
+            shortfall = fresh - self.allocator.available
+            if shortfall > 0 and self.prefix is not None \
+                    and self.prefix.evictable() >= shortfall:
+                # Evict only when eviction actually closes the gap —
+                # otherwise a stuck head-of-queue request would drain
+                # the hot cache tick after tick while still not
+                # admitting (the pages it really waits for belong to
+                # running sequences).
+                self.prefix.evict(shortfall)
+            if fresh > self.allocator.available:
+                if reuse:
+                    self.allocator.free(reuse)
                 return  # pool pressure: wait for frees, keep FIFO order
             self.waiting.popleft()
-            seq.pages = self.allocator.alloc(need)
+            seq.pages = reuse + self.allocator.alloc(fresh)
             seq.admit_seq = self._admit_counter
             self._admit_counter += 1
+            seq.target = len(prompt)
+            seq.prefilled = len(reuse) * self.block_size
             self.slots[slot] = seq
-            self._prefill_sequence(seq, prompt)
-            metrics.counter("tk8s_serve_tokens_total").inc(
-                len(prompt), kind="prefill")
-            if self._maybe_finish(slot, finished):
-                continue
+            if seq.prefilled:
+                # Tokens whose prefill compute the radix cache absorbed —
+                # the O(users) -> O(1) system-prompt win, measured.
+                metrics.counter(
+                    "tk8s_serve_prefix_hit_tokens_total").inc(seq.prefilled)
+            if self.prefill_chunk is None:
+                self._prefill_sequence(seq, prompt)
+                metrics.counter("tk8s_serve_tokens_total").inc(
+                    len(prompt), kind="prefill")
+                if self._maybe_finish(slot, finished):
+                    continue
+
+    def _reusable_pages(self, prompt: List[int]) -> List[int]:
+        """Prefix-cache pages this prompt can map: the longest indexed
+        full-page prefix, rounded DOWN to whole chunk windows (computed
+        windows must stay absolute — the sharing ON==OFF parity rule)
+        and capped so at least the final window is computed (its last
+        row is where the first token's logits come from)."""
+        matched = self.prefix.lookup(prompt)
+        usable = min(len(matched) * self.block_size, len(prompt) - 1)
+        usable -= usable % self.prefill_chunk
+        return matched[:usable // self.block_size]
+
+    # --------------------------------------------------- chunked prefill
+    def _prefill_tick(self, finished: List[FinishedRequest]) -> None:
+        """Run ONE prefill window for the oldest still-prefilling
+        sequence (FIFO by admission). One chunk per tick is the TPOT
+        ceiling: however long the prompt, every tick still runs a full
+        decode for the sequences already generating."""
+        cands = [i for i, s in enumerate(self.slots)
+                 if s is not None and s.prefilled < s.target]
+        if not cands:
+            return
+        i = min(cands, key=lambda j: self.slots[j].admit_seq)
+        seq = self.slots[i]
+        prompt = list(seq.request.tokens) + list(seq.generated)
+        c = self.prefill_chunk
+        off = seq.prefilled
+        clen = min(c, seq.target - off)
+        toks = prompt[off:off + clen] + [0] * (c - clen)
+        table = seq.pages + [TRASH_PAGE] * (self.blocks_per_seq
+                                            - len(seq.pages))
+        out = self._prefill_chunk_fn(
+            self.params,
+            jnp.asarray([toks], jnp.int32),
+            jnp.asarray(off, jnp.int32),
+            jnp.asarray(clen, jnp.int32),
+            self._pool(),
+            jnp.asarray(table, jnp.int32))
+        if self.cache.quantized:
+            logits, cache, (k_err, v_err) = out
+        else:
+            logits, cache = out
+            k_err = v_err = None
+        self.cache = cache
+        seq.prefilled = off + clen
+        metrics.counter("tk8s_serve_tokens_total").inc(
+            clen, kind="prefill")
+        if seq.prefilled < seq.target:
+            return
+        if k_err is not None:
+            # Gauge update only on the FINAL window: float() forces a
+            # host-device sync, and a long prompt's intermediate values
+            # would be overwritten anyway — per-chunk syncs would
+            # serialize exactly the tick path chunking exists to keep
+            # short. (The sampled first token below syncs regardless,
+            # so this ride-along is free, as in _prefill_sequence.)
+            metrics.gauge("tk8s_serve_quant_error").set(
+                float(k_err), tensor="k")
+            metrics.gauge("tk8s_serve_quant_error").set(
+                float(v_err), tensor="v")
+        if self.prefix is not None:
+            # Index every full prompt page (reused prefixes dedupe to
+            # their existing nodes). Generated tokens land in later,
+            # exclusively-owned pages and are teacher-forced-prompt
+            # material only after a preemption — in which case they are
+            # just as deterministic and shareable.
+            self.prefix.insert(prompt, seq.pages)
+        tok = self._sample(seq, logits[None, :])
+        seq.generated.append(tok)
+        if seq.first_token_at is None:
+            seq.first_token_at = self.clock()
+        self._maybe_finish(i, finished)
 
     def _pool(self) -> tuple:
         """The cache's arrays as the jit pool operand: (k, v), plus the
@@ -343,6 +506,7 @@ class ServeEngine:
         else:
             logits, cache = out
         self.cache = cache
+        seq.prefilled = seq.target = len(prompt)
         tok = self._sample(seq, logits[None, :])
         seq.generated.append(tok)
         if seq.first_token_at is None:
@@ -350,19 +514,25 @@ class ServeEngine:
 
     # ------------------------------------------------- growth/preemption
     def _ensure_growth_pages(self) -> None:
-        """Every running sequence gets the page its next written token
-        needs, preempting latest-admitted sequences when the pool is dry."""
+        """Every decoding sequence gets the page its next written token
+        needs. When the pool is dry: first reclaim unreferenced prefix-
+        cache pages (LRU leaves — colder than any running sequence),
+        then preempt latest-admitted sequences."""
         for i in sorted(range(self.max_batch),
                         key=lambda i: (self.slots[i].admit_seq
                                        if self.slots[i] else -1)):
             seq = self.slots[i]
-            if seq is None:
+            if seq is None or seq.prefilled < seq.target:
+                # Still prefilling: its pages already cover the whole
+                # prompt; growth starts once it decodes.
                 continue
             while blocks_for(seq.length + 1,
                              self.block_size) > len(seq.pages):
                 try:
                     seq.pages.extend(self.allocator.alloc(1))
                 except OutOfBlocksError:
+                    if self.prefix is not None and self.prefix.evict(1):
+                        continue
                     victim = max(
                         (j for j, s in enumerate(self.slots)
                          if s is not None),
@@ -378,6 +548,7 @@ class ServeEngine:
         seq.pages = []
         seq.admit_seq = -1
         seq.preemptions += 1
+        seq.prefilled = seq.target = 0
         self.slots[slot] = None
         self.waiting.appendleft(seq)
         metrics.counter("tk8s_serve_preemptions_total").inc()
@@ -389,8 +560,8 @@ class ServeEngine:
         tables = [[TRASH_PAGE] * self.blocks_per_seq
                   for _ in range(self.max_batch)]
         for i, seq in enumerate(self.slots):
-            if seq is None:
-                continue
+            if seq is None or seq.prefilled < seq.target:
+                continue  # still prefilling: ride the trash page
             tokens[i] = seq.generated[-1]
             lengths[i] = seq.length
             tables[i][:len(seq.pages)] = seq.pages
@@ -403,7 +574,7 @@ class ServeEngine:
         self.cache = cache
         decoded = 0
         for i, seq in enumerate(self.slots):
-            if seq is None:
+            if seq is None or seq.prefilled < seq.target:
                 continue
             seq.generated.append(self._sample(seq, logits[i:i + 1]))
             decoded += 1
@@ -460,6 +631,8 @@ class ServeEngine:
             self.allocator.in_use)
         metrics.gauge("tk8s_serve_kv_block_utilization").set(
             self.allocator.in_use / max(1, self.allocator.capacity))
+        metrics.gauge("tk8s_serve_prefix_cache_pages").set(
+            self.prefix.pages if self.prefix is not None else 0)
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -477,7 +650,21 @@ class ServeEngine:
             "kv_dtype": self.kv_dtype,
             "weight_dtype": self.weight_dtype,
             "kv_pool_bytes": self.cache.pool_bytes + self.cache.scale_bytes,
+            "prefill_chunk": self.prefill_chunk,
+            "prefix_cache": self.prefix is not None,
+            "prefix_cache_pages": (self.prefix.pages
+                                   if self.prefix is not None else 0),
         }
+
+    def release_prefix_cache(self) -> int:
+        """Drop every cache-held page reference (pages still mapped by
+        live sequences stay allocated until those finish). Returns pages
+        the cache released — the drain-accounting hook: after
+        ``run_until_idle()`` + this, ``allocator.in_use`` must be 0 or
+        pages leaked (pinned in tests/test_serve.py)."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.clear()
 
 
 def _cache_like(template, k, v, k_scale=None, v_scale=None):

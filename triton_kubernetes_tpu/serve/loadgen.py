@@ -1,11 +1,25 @@
-"""Seeded Poisson open-loop load for the serving engine.
+"""Seeded open-loop load for the serving engine and the router fleet.
 
 Open-loop is the honest shape for "millions of users": arrivals come
 from the world on their own schedule, not gated on the server's previous
 response, so queueing shows up as queueing (TTFT growth) instead of
-silently throttling offered load the way a closed loop does. The
-schedule is fully determined by the seed — both A/B arms of
-scripts/ci/serving_evidence.py replay the *identical* request stream.
+silently throttling offered load the way a closed loop does. Every
+schedule is fully determined by its seed — both arms of a CI A/B replay
+the *identical* request stream.
+
+Three trace shapes, one per serving claim:
+
+* :class:`PoissonSchedule` — independent ragged requests (PR 6's
+  continuous-batching gate);
+* :class:`SharedPrefixSchedule` — K system prompts × many users, the
+  trace where radix prefix sharing pays: every request is one of K
+  long seeded prefixes plus a short per-user suffix
+  (scripts/ci/prefix_router_evidence.py's throughput arm);
+* :class:`SessionSchedule` — multi-turn sessions with stable
+  ``session_id`` and growing prompts (turn N's prompt extends turn
+  N-1's), which is what makes router affinity *measurable*: a
+  session-affine fleet serves every turn from the replica whose prefix
+  cache already holds the session.
 
 Dependency-free (``random.Random``, like cloudsim's fault plans): no
 numpy on the provisioning-CLI side of the package.
@@ -15,17 +29,19 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 
 @dataclass(frozen=True)
 class TimedRequest:
-    """One scheduled arrival: submit at ``at`` seconds from epoch 0."""
+    """One scheduled arrival: submit at ``at`` seconds from epoch 0.
+    ``session_id`` is the router affinity key (None = sessionless)."""
 
     at: float
     request_id: str
     tokens: List[int]
     max_new_tokens: int
+    session_id: Optional[str] = None
 
 
 class PoissonSchedule:
@@ -47,6 +63,104 @@ class PoissonSchedule:
                 at=t, request_id=f"req-{i}",
                 tokens=[rng.randrange(vocab_size) for _ in range(plen)],
                 max_new_tokens=max_new_tokens))
+
+    def __iter__(self):
+        return iter(self.requests)
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+
+class SharedPrefixSchedule:
+    """K seeded system prompts × many users: Poisson arrivals where each
+    request is ``prefixes[k] + short per-user suffix``.
+
+    The trace the prefix cache is built for — without sharing every
+    request pays O(prefix_len) prefill; with sharing only the first
+    request per prefix does. ``prefix_of`` records which system prompt
+    each request drew (evidence scripts group hit accounting by it).
+    """
+
+    def __init__(self, *, rate: float, n: int, vocab_size: int,
+                 num_prefixes: int = 2, prefix_len: int = 96,
+                 suffix_len_range: Sequence[int] = (2, 8),
+                 max_new_tokens: int = 16, seed: int = 0):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0 req/s, got {rate}")
+        if num_prefixes < 1:
+            raise ValueError(
+                f"num_prefixes must be >= 1, got {num_prefixes}")
+        if prefix_len < 1:
+            raise ValueError(f"prefix_len must be >= 1, got {prefix_len}")
+        rng = random.Random(seed)
+        self.prefixes: List[List[int]] = [
+            [rng.randrange(vocab_size) for _ in range(prefix_len)]
+            for _ in range(num_prefixes)]
+        lo, hi = suffix_len_range
+        t = 0.0
+        self.requests: List[TimedRequest] = []
+        self.prefix_of: List[int] = []
+        for i in range(n):
+            t += rng.expovariate(rate)
+            k = rng.randrange(num_prefixes)
+            suffix = [rng.randrange(vocab_size)
+                      for _ in range(rng.randint(lo, hi))]
+            self.prefix_of.append(k)
+            self.requests.append(TimedRequest(
+                at=t, request_id=f"req-{i}",
+                tokens=list(self.prefixes[k]) + suffix,
+                max_new_tokens=max_new_tokens))
+
+    def __iter__(self):
+        return iter(self.requests)
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+
+class SessionSchedule:
+    """Multi-turn sessions: each session opens with its own seeded
+    prefix, and every later turn's prompt extends the previous turn's by
+    a few synthetic tokens (an open-loop trace cannot know real model
+    outputs — for routing and prefix accounting only the *shared prefix
+    growth* matters, not what the tokens say).
+
+    Arrivals: session starts are Poisson at ``rate``; within a session,
+    turns follow at ``think_time`` expovariate gaps — so turns of one
+    session are strictly ordered in time while sessions interleave, and
+    the stream as a whole still offers open-loop load.
+    """
+
+    def __init__(self, *, rate: float, num_sessions: int, turns: int,
+                 vocab_size: int, prefix_len: int = 24,
+                 turn_len_range: Sequence[int] = (2, 6),
+                 think_time: float = 0.2,
+                 max_new_tokens: int = 8, seed: int = 0):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0 sessions/s, got {rate}")
+        if turns < 1:
+            raise ValueError(f"turns must be >= 1, got {turns}")
+        if think_time <= 0:
+            raise ValueError(
+                f"think_time must be > 0 s, got {think_time}")
+        rng = random.Random(seed)
+        lo, hi = turn_len_range
+        self.requests: List[TimedRequest] = []
+        start = 0.0
+        for s in range(num_sessions):
+            start += rng.expovariate(rate)
+            prompt = [rng.randrange(vocab_size) for _ in range(prefix_len)]
+            at = start
+            for turn in range(turns):
+                if turn:
+                    at += rng.expovariate(1.0 / think_time)
+                    prompt = prompt + [rng.randrange(vocab_size)
+                                       for _ in range(rng.randint(lo, hi))]
+                self.requests.append(TimedRequest(
+                    at=at, request_id=f"sess-{s}-t{turn}",
+                    tokens=list(prompt), max_new_tokens=max_new_tokens,
+                    session_id=f"sess-{s}"))
+        self.requests.sort(key=lambda r: r.at)
 
     def __iter__(self):
         return iter(self.requests)

@@ -1,0 +1,407 @@
+"""Session-affine router in front of N serving replicas.
+
+The fleet layer of the serving stack (docs/guide/serving.md §Router):
+one stable VIP fronting N :class:`~.server.ServeHTTPServer` replicas,
+with three policies stacked in order:
+
+1. **Consistent-hash session affinity.** A request's routing key is its
+   ``session_id`` (multi-turn chat), falling back to a hash of its
+   prompt tokens — so follow-up turns, and independent requests sharing
+   a prompt, land on the replica already holding their KV pages in its
+   radix prefix cache. The hash ring carries ``virtual_nodes`` points
+   per replica, so adding/removing a replica remaps only ~1/N of keys
+   (the classic consistent-hashing contract) instead of reshuffling
+   every session's warm cache.
+2. **Least-loaded spill.** Affinity is a preference, not a prison: when
+   the affine replica already has ``spill_threshold`` router-tracked
+   requests in flight, the request spills to the least-loaded healthy
+   replica — it pays a cold prefill there rather than queueing behind a
+   hot spot.
+3. **Health-aware ejection.** A replica answering 503 (the engine-loop-
+   death semantics ``serve/server.py`` pinned in PR 6) or refusing
+   connections is ejected from rotation and its request retried on the
+   next healthy choice — generation is deterministic and idempotent
+   (seeded per-request sampling), so a re-landed request reproduces the
+   exact tokens the dead replica would have produced. A background
+   probe loop re-admits replicas whose ``/healthz`` recovers. A
+   *per-request timeout* is NOT death: a slow replica is still holding
+   the generation and its sessions' warm KV, so the caller gets a 504
+   and the replica stays in rotation — ejecting on timeout would both
+   drop every session's affinity and re-run the same long generation on
+   a fresh replica (duplicate compute, cascading into a fleet-wide
+   eject storm under a burst of long prompts).
+
+Metrics: ``tk8s_route_requests_total{replica, reason=affine|spill|
+eject}`` and ``tk8s_route_replica_healthy{replica}`` — the scrape
+surface ROADMAP item 1's autoscaler will watch.
+
+Threading shape: handler threads are independent (no single-owner
+engine here); shared state (health flags, in-flight counts) sits behind
+one lock, and no network call or sleep ever happens under it
+(lint rule TK8S103 watches this file).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlparse
+
+from ..utils import metrics
+from ._http import JSONHandler
+
+
+def _digest(key: str) -> int:
+    return int.from_bytes(hashlib.sha256(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over replica names with virtual nodes."""
+
+    def __init__(self, replicas: Sequence[str], virtual_nodes: int = 64):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        if virtual_nodes < 1:
+            raise ValueError(
+                f"virtual_nodes must be >= 1, got {virtual_nodes}")
+        points: List[Tuple[int, str]] = []
+        for name in replicas:
+            for v in range(virtual_nodes):
+                points.append((_digest(f"{name}#{v}"), name))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._names = [n for _, n in points]
+
+    def owner(self, key: str, exclude: frozenset = frozenset()) -> str:
+        """First replica clockwise from ``key``'s point, skipping
+        ``exclude``; raises if every replica is excluded."""
+        start = bisect.bisect(self._hashes, _digest(key))
+        n = len(self._names)
+        for step in range(n):
+            name = self._names[(start + step) % n]
+            if name not in exclude:
+                return name
+        raise LookupError("no replica available (all excluded)")
+
+
+@dataclass
+class ReplicaState:
+    name: str
+    url: str
+    healthy: bool = True
+    # Router-tracked OPEN PROXIED CONNECTIONS, by design: a timed-out
+    # attempt decrements even though the replica may still be chewing on
+    # it (the router cannot observe replica-side completion without its
+    # cooperation). `timeouts` makes that blind spot visible in /stats;
+    # replica-side queue depth is scrapeable from each replica's own
+    # /stats for load decisions that need the truth.
+    in_flight: int = 0
+    requests: int = 0
+    timeouts: int = 0
+
+
+class Router:
+    """Routing core, HTTP-free so tests drive it directly: pick a
+    replica for a payload, forward with eject-and-retry, track health."""
+
+    def __init__(
+        self,
+        replica_urls: Sequence[str],
+        *,
+        spill_threshold: int = 4,
+        virtual_nodes: int = 64,
+        request_timeout_s: float = 120.0,
+        health_timeout_s: float = 2.0,
+    ):
+        if not replica_urls:
+            raise ValueError("need at least one replica URL")
+        if spill_threshold < 1:
+            raise ValueError(
+                f"spill_threshold must be >= 1, got {spill_threshold}")
+        self.request_timeout_s = request_timeout_s
+        self.health_timeout_s = health_timeout_s
+        self.spill_threshold = spill_threshold
+        self._lock = threading.Lock()
+        self.replicas: Dict[str, ReplicaState] = {}
+        for i, url in enumerate(replica_urls):
+            name = f"r{i}"
+            self.replicas[name] = ReplicaState(name=name,
+                                               url=url.rstrip("/"))
+        self.ring = HashRing(sorted(self.replicas), virtual_nodes)
+        for name in self.replicas:
+            metrics.gauge("tk8s_route_replica_healthy").set(1, replica=name)
+
+    # ------------------------------------------------------------ policy
+    @staticmethod
+    def route_key(payload: Dict[str, Any]) -> str:
+        """The affinity key: the session when there is one, else the
+        prompt itself — identical prompts then share a replica's radix
+        cache instead of warming N copies of it."""
+        sid = payload.get("session_id")
+        if isinstance(sid, str) and sid:
+            return f"session:{sid}"
+        tokens = payload.get("tokens") or []
+        return "tokens:" + hashlib.sha256(
+            json.dumps(tokens).encode()).hexdigest()
+
+    def pick(self, key: str,
+             exclude: frozenset = frozenset()) -> Tuple[ReplicaState, str]:
+        """(replica, reason) for one attempt. ``exclude`` carries the
+        replicas this request already saw fail — routing away from the
+        affine owner because it is excluded or unhealthy is an "eject",
+        away because it is overloaded a "spill"."""
+        with self._lock:
+            down = frozenset(n for n, r in self.replicas.items()
+                             if not r.healthy) | exclude
+            candidates = [r for n, r in sorted(self.replicas.items())
+                          if n not in down]
+            if not candidates:
+                raise LookupError("no healthy replica")
+            owner_name = self.ring.owner(key)
+            owner = self.replicas[owner_name]
+            if owner_name in down:
+                # Affine home is gone: consistent-hash to the next live
+                # point so the session still has ONE stable fallback.
+                return self.replicas[self.ring.owner(key, down)], "eject"
+            if owner.in_flight >= self.spill_threshold:
+                least = min(candidates, key=lambda r: (r.in_flight, r.name))
+                # Spill only on a STRICT improvement: moving to an
+                # equally loaded replica pays a cold prefill to stand in
+                # an identical queue — worse than staying affine.
+                if least.in_flight < owner.in_flight:
+                    return least, "spill"
+            return owner, "affine"
+
+    # ----------------------------------------------------------- forward
+    def forward(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """Route one /generate payload: returns (status, body). Retries
+        on a fresh replica after a connection failure or 503, marking
+        the failed one unhealthy; client errors (4xx) pass through —
+        they would fail identically anywhere; a per-attempt timeout is
+        a 504 to the caller, never an ejection (the slow replica is
+        still computing — see the module docstring)."""
+        key = self.route_key(payload)
+        body = json.dumps(payload).encode()
+        tried: set = set()
+        last: Tuple[int, Dict[str, Any]] = (503, {
+            "type": "error", "message": "no healthy replica"})
+        for _ in range(len(self.replicas)):
+            try:
+                replica, reason = self.pick(key, frozenset(tried))
+            except LookupError as e:
+                return 503, {"type": "error", "message": str(e)}
+            tried.add(replica.name)
+            with self._lock:
+                replica.in_flight += 1
+                replica.requests += 1
+            try:
+                status, out = self._post(replica.url + "/generate", body)
+            finally:
+                with self._lock:
+                    replica.in_flight -= 1
+            if status == 503 or status == -1:
+                # Failed attempts are not placements: the counter only
+                # ever records requests a replica actually served.
+                self._set_health(replica.name, False)
+                last = (503, out if status == 503 else {
+                    "type": "error",
+                    "message": f"replica {replica.name} unreachable"})
+                continue
+            if status == -2 or status == 504:
+                # A timeout — ours (-2) or the replica's own 504 — is
+                # not death and not a placement: the replica is still
+                # computing but never answered. Counting it would make
+                # a drowning replica look well-served to the
+                # autoscaler's scrape; ejecting it would re-run the
+                # same long generation fleet-wide.
+                with self._lock:
+                    replica.timeouts += 1
+                return 504, out
+            metrics.counter("tk8s_route_requests_total").inc(
+                replica=replica.name, reason=reason)
+            if isinstance(out, dict):
+                out = dict(out, replica=replica.name)
+            return status, out
+        return last
+
+    def _post(self, url: str, body: bytes) -> Tuple[int, Dict[str, Any]]:
+        """(status, parsed body); -1 means unreachable (eject + retry),
+        -2 means the attempt timed out on a live replica (504, no
+        eject — the generation is still burning compute there)."""
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except (ValueError, OSError):
+                payload = {"type": "error", "message": str(e)}
+            return e.code, payload
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None),
+                          (socket.timeout, TimeoutError)):
+                return -2, {"type": "error", "message":
+                            f"no completion within "
+                            f"{self.request_timeout_s}s"}
+            return -1, {"type": "error", "message": str(e)}
+        except (socket.timeout, TimeoutError):
+            return -2, {"type": "error", "message":
+                        f"no completion within {self.request_timeout_s}s"}
+        except (OSError, ValueError) as e:
+            return -1, {"type": "error", "message": str(e)}
+
+    # ------------------------------------------------------------ health
+    def _set_health(self, name: str, healthy: bool) -> None:
+        with self._lock:
+            self.replicas[name].healthy = healthy
+            # Gauge write INSIDE the lock (it is in-process bookkeeping,
+            # not I/O): written outside, two concurrent flips could land
+            # their gauge writes in the opposite order of their state
+            # writes and strand the scrape surface on the stale value.
+            metrics.gauge("tk8s_route_replica_healthy").set(
+                1 if healthy else 0, replica=name)
+
+    def probe_once(self) -> None:
+        """One /healthz sweep over every replica (no lock held across
+        the network): 200 re-admits, anything else ejects."""
+        for name, url in [(r.name, r.url)
+                          for r in self.replicas.values()]:
+            req = urllib.request.Request(url + "/healthz")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.health_timeout_s) as r:
+                    self._set_health(name, r.status == 200)
+            except (urllib.error.URLError, OSError):
+                self._set_health(name, False)
+
+    @property
+    def any_healthy(self) -> bool:
+        with self._lock:
+            return any(r.healthy for r in self.replicas.values())
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "spill_threshold": self.spill_threshold,
+                "replicas": {
+                    n: {"url": r.url, "healthy": r.healthy,
+                        "in_flight": r.in_flight, "requests": r.requests,
+                        "timeouts": r.timeouts}
+                    for n, r in sorted(self.replicas.items())
+                },
+            }
+
+
+class _Handler(JSONHandler):
+    server_version = "tk8s-route"
+    route: "RouterHTTPServer"  # injected by RouterHTTPServer
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        path = urlparse(self.path).path
+        router = self.route.router
+        if path == "/healthz":
+            # The router is alive iff it can place a request somewhere.
+            if router.any_healthy:
+                self._json(200, {"ok": True,
+                                 "replicas": len(router.replicas)})
+            else:
+                self._json(503, {"ok": False,
+                                 "error": "no healthy replica"})
+        elif path == "/metrics":
+            self._prometheus(metrics.get_registry().render_prometheus())
+        elif path == "/stats":
+            self._json(200, router.stats())
+        else:
+            self._json(404, {"type": "error", "message": "not found"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        if urlparse(self.path).path != "/generate":
+            self._json(404, {"type": "error", "message": "not found"})
+            return
+        n = int(self.headers.get("Content-Length") or 0)
+        try:
+            payload = json.loads(self.rfile.read(n) if n else b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as e:
+            self._json(400, {"type": "error", "message": str(e)})
+            return
+        status, out = self.route.router.forward(payload)
+        self._json(status, out)
+
+
+class RouterHTTPServer:
+    """Embeddable router endpoint: ``with RouterHTTPServer(urls) as url``
+    in tests; ``serve_forever`` under ``tk8s route``. A daemon probe
+    thread sweeps replica health every ``health_interval_s``."""
+
+    def __init__(self, replica_urls: Sequence[str],
+                 host: str = "127.0.0.1", port: int = 0,
+                 health_interval_s: float = 0.5, **router_kw: Any):
+        self.router = Router(replica_urls, **router_kw)
+        self.health_interval_s = health_interval_s
+        self._stop = threading.Event()
+        handler = type("Handler", (_Handler,), {"route": self})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._probe_thread: Optional[threading.Thread] = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            self.router.probe_once()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "RouterHTTPServer":
+        self._probe_thread = threading.Thread(target=self._probe_loop,
+                                              daemon=True)
+        self._probe_thread.start()
+        self._http_thread = threading.Thread(
+            target=lambda: self.httpd.serve_forever(poll_interval=0.05),
+            daemon=True)
+        self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        for t in (self._probe_thread, self._http_thread):
+            if t is not None:
+                t.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        """Foreground mode (``tk8s route``): probes on a daemon thread,
+        HTTP on the caller's thread."""
+        self._probe_thread = threading.Thread(target=self._probe_loop,
+                                              daemon=True)
+        self._probe_thread.start()
+        try:
+            self.httpd.serve_forever()
+        finally:
+            self._stop.set()
+
+    def __enter__(self) -> "RouterHTTPServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

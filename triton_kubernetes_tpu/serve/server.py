@@ -29,15 +29,15 @@ POST      /generate     ``{"tokens": [ids...], "max_new_tokens": N,
 from __future__ import annotations
 
 import json
-import os
 import queue
 import threading
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import urlparse
 
 from ..utils import metrics
+from ._http import JSONHandler, route_label
 from .engine import FinishedRequest, Request, ServeEngine
 
 # Default port for rendered manifests and the CLI (the serving analog of
@@ -45,13 +45,6 @@ from .engine import FinishedRequest, Request, ServeEngine
 # Single-sourced from constants.py; topology/serving.py renders the same
 # value (lint rule TK8S104 keeps every site agreeing).
 from ..constants import SERVE_PORT
-
-_ROUTES = ("/healthz", "/metrics", "/stats", "/generate")
-
-
-def _route_label(path: str) -> str:
-    return path if path in _ROUTES else "other"
-
 
 @dataclass
 class _Waiter:
@@ -61,13 +54,9 @@ class _Waiter:
     fatal: bool = False  # loop death (503), not request rejection (400)
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JSONHandler):
     server_version = "tk8s-serve"
     serve: "ServeHTTPServer"  # injected by ServeHTTPServer
-
-    def log_message(self, fmt: str, *args: Any) -> None:
-        if os.environ.get("TK8S_SERVE_DEBUG"):
-            super().log_message(fmt, *args)
 
     def send_response(self, code: int, message: Optional[str] = None) -> None:
         self._last_code = code
@@ -79,16 +68,8 @@ class _Handler(BaseHTTPRequestHandler):
             handler()
         finally:
             metrics.counter("tk8s_serve_http_requests_total").inc(
-                route=_route_label(urlparse(self.path).path),
+                route=route_label(urlparse(self.path).path),
                 method=self.command, code=str(self._last_code))
-
-    def _json(self, code: int, payload: Dict[str, Any]) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
         self._counted(self._get)
@@ -110,13 +91,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"ok": True,
                              "model": self.serve.engine.config.name})
         elif path == "/metrics":
-            body = metrics.get_registry().render_prometheus().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._prometheus(metrics.get_registry().render_prometheus())
         elif path == "/stats":
             self._json(200, self.serve.engine.stats())
         else:
@@ -136,6 +111,12 @@ class _Handler(BaseHTTPRequestHandler):
                     or not all(isinstance(t, int) for t in tokens)):
                 raise ValueError("'tokens' must be a list of token ids")
             eos_id = d.get("eos_id")
+            sid = d.get("session_id")
+            if sid is not None and not isinstance(sid, str):
+                # The router's affinity key rides along to the replica;
+                # a malformed one is the caller's fault, not ours to
+                # coerce (the engine itself never reads it).
+                raise ValueError("'session_id' must be a string")
             opts = {
                 "max_new_tokens": int(d.get("max_new_tokens", 16)),
                 "temperature": float(d.get("temperature", 0.0)),
@@ -154,7 +135,14 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:  # engine validation: caller's fault
             self._json(400, {"type": "error", "message": str(e)})
             return
-        except (TimeoutError, RuntimeError) as e:
+        except TimeoutError as e:
+            # Per-request timeout, NOT engine death: 504 so the router
+            # can tell "slow" from "dead" — a 503 here would eject this
+            # replica and re-run the same long generation on its peers
+            # (serve/router.py's eject-storm contract).
+            self._json(504, {"type": "error", "message": str(e)})
+            return
+        except RuntimeError as e:  # engine-loop death: liveness event
             self._json(503, {"type": "error", "message": str(e)})
             return
         self._json(200, {

@@ -26,7 +26,12 @@ from .labels import (
     verify_slice_labels,
 )
 from .jobset import render_headless_service, render_jobset
-from .serving import render_serving_deployment, render_serving_service
+from .serving import (
+    render_router_deployment,
+    render_router_service,
+    render_serving_deployment,
+    render_serving_service,
+)
 
 __all__ = [
     "GKE_ACCELERATOR_LABEL",
@@ -40,6 +45,8 @@ __all__ = [
     "parse_accelerator",
     "render_headless_service",
     "render_jobset",
+    "render_router_deployment",
+    "render_router_service",
     "render_serving_deployment",
     "render_serving_service",
     "selector_for_slice",

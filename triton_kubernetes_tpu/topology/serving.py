@@ -23,12 +23,14 @@ from typing import Any, Dict, List, Optional
 from .labels import selector_for_slice
 from .slices import SliceSpec
 
-# Single-sourced with serve.server.SERVE_PORT from the dependency-free
-# constants module (see module docstring; lint rule TK8S104).
-from ..constants import SERVE_PORT
+# Single-sourced with serve.server.SERVE_PORT / serve.router's bind
+# port from the dependency-free constants module (see module docstring;
+# lint rule TK8S104).
+from ..constants import ROUTE_PORT, SERVE_PORT
 
 APP_LABEL = "serve.tk8s.io/name"
 MODEL_LABEL = "serve.tk8s.io/model"
+ROLE_LABEL = "serve.tk8s.io/role"
 
 
 def default_serve_command(model: str, port: int = SERVE_PORT) -> List[str]:
@@ -101,19 +103,118 @@ def render_serving_service(
     name: str,
     namespace: str = "default",
     service_type: str = "ClusterIP",
+    headless: bool = False,
 ) -> Dict[str, Any]:
     """The VIP in front of the serving replicas. ``/metrics`` rides the
     same port, so a Prometheus scrape of the Service endpoints covers
-    every replica with no extra wiring."""
+    every replica with no extra wiring.
+
+    ``headless=True`` renders ``clusterIP: None`` — per-pod DNS instead
+    of one VIP, which is what the session-affine router needs: affinity
+    only means something when the router can address a *specific*
+    replica's KV pages, not whatever endpoint kube-proxy picks.
+    """
+    spec: Dict[str, Any] = {
+        "type": service_type,
+        "selector": {APP_LABEL: name},
+        "ports": [{"name": "http", "port": SERVE_PORT,
+                   "targetPort": SERVE_PORT}],
+    }
+    if headless:
+        spec["clusterIP"] = "None"
     return {
         "apiVersion": "v1",
         "kind": "Service",
         "metadata": {"name": name, "namespace": namespace,
                      "labels": {APP_LABEL: name}},
+        "spec": spec,
+    }
+
+
+def default_route_command(replica_urls: List[str],
+                          port: int = ROUTE_PORT) -> List[str]:
+    """The router container command: the CLI's ``route`` verb bound to
+    all interfaces, one ``--replica`` per serving endpoint."""
+    cmd = ["triton-kubernetes-tpu", "route",
+           "--route-host", "0.0.0.0", "--port", str(port)]
+    for url in replica_urls:
+        cmd += ["--replica", url]
+    return cmd
+
+
+def render_router_deployment(
+    name: str,
+    image: str,
+    replica_urls: List[str],
+    replicas: int = 1,
+    namespace: str = "default",
+    env: Optional[Dict[str, str]] = None,
+    command: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """The router Deployment beside the replica set.
+
+    No TPU limits and no node selector: the router is pure CPU HTTP
+    plumbing and schedules anywhere. ``replica_urls`` are the serving
+    endpoints it fronts — with the replicas behind a headless Service
+    (``render_serving_service(..., headless=True)``) these are the
+    per-pod DNS names, which is what makes session affinity land on the
+    pod actually holding the KV pages.
+    """
+    if not replica_urls:
+        raise ValueError("router needs at least one replica URL")
+    labels = {APP_LABEL: name, ROLE_LABEL: "router"}
+    container = {
+        "name": "router",
+        "image": image,
+        "command": command or default_route_command(replica_urls),
+        "env": [{"name": k, "value": v} for k, v in sorted(
+            (env or {}).items())],
+        "ports": [{"containerPort": ROUTE_PORT, "name": "http"}],
+        # Readiness ONLY: /healthz reflects REPLICA health (503 when
+        # every replica is unreachable), which parks the router out of
+        # its Service during a fleet outage. A liveness probe on the
+        # same endpoint would have kubelet restart-loop perfectly
+        # healthy router processes through that outage — restarting the
+        # router cannot resurrect replicas.
+        "readinessProbe": {
+            "httpGet": {"path": "/healthz", "port": ROUTE_PORT},
+            "periodSeconds": 5,
+        },
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": dict(labels)},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {APP_LABEL: name,
+                                         ROLE_LABEL: "router"}},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {"containers": [container]},
+            },
+        },
+    }
+
+
+def render_router_service(
+    name: str,
+    namespace: str = "default",
+    service_type: str = "ClusterIP",
+) -> Dict[str, Any]:
+    """The fleet's single front door: one VIP over the router pods
+    (the routers are stateless — any of them hashes a session to the
+    same replica, so scaling routers never splits affinity)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": {APP_LABEL: name, ROLE_LABEL: "router"}},
         "spec": {
             "type": service_type,
-            "selector": {APP_LABEL: name},
-            "ports": [{"name": "http", "port": SERVE_PORT,
-                       "targetPort": SERVE_PORT}],
+            "selector": {APP_LABEL: name, ROLE_LABEL: "router"},
+            "ports": [{"name": "http", "port": ROUTE_PORT,
+                       "targetPort": ROUTE_PORT}],
         },
     }

@@ -208,6 +208,24 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
     "tk8s_serve_http_requests_total": (
         "counter", "Serving HTTP requests by route, method, and "
         "response code", ("route", "method", "code"), None),
+    "tk8s_serve_prefix_hit_tokens_total": (
+        "counter", "Prompt tokens served from the shared radix prefix "
+        "cache instead of prefill compute — the O(users) -> O(1) "
+        "system-prompt win, measured", (), None),
+    "tk8s_serve_prefix_cache_pages": (
+        "gauge", "KV pages currently indexed by the radix prefix cache "
+        "(each holds one cache-owned reference; evicted LRU-leaf-first "
+        "under pool pressure)", (), None),
+    # --------------------------------------------- serve/router.py
+    "tk8s_route_requests_total": (
+        "counter", "Requests the router placed, by replica and routing "
+        "reason (affine = consistent-hash owner, spill = owner over the "
+        "in-flight threshold, eject = owner unhealthy/ejected)",
+        ("replica", "reason"), None),
+    "tk8s_route_replica_healthy": (
+        "gauge", "Replica health as the router sees it (1 = in "
+        "rotation, 0 = ejected; /healthz probes re-admit on recovery)",
+        ("replica",), None),
     # --------------------------------- train/resilience.py (anomaly guard)
     "tk8s_train_anomaly_rollbacks_total": (
         "counter", "Loss-anomaly rollbacks taken by the guarded "
