@@ -224,6 +224,9 @@ def _child() -> None:
     projected_8b_v5p = project_mfu(
         achieved_mfu, config, seq_len, cfg_8b, cfg_8b.max_seq_len)
 
+    log("phase=spec_probe")
+    spec_fields = _spec_probe()
+
     print(json.dumps({
         "metric": f"{config.name}_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
@@ -251,6 +254,13 @@ def _child() -> None:
         # (scripts/ci/quant_evidence.py).
         "kv_dtype": config.dtype,
         "weight_dtype": config.param_dtype,
+        # Speculative-decode probe (BENCH_r07+): a bounded serving
+        # micro-run on the benched backend — spec_k, the measured draft
+        # accept rate, and mean tokens emitted per verify step. The
+        # throughput A/B itself is gated separately
+        # (scripts/ci/spec_decode_evidence.py); these fields record the
+        # accept economics alongside the training headline.
+        **spec_fields,
         **mem_fields,
         # Compile-vs-step split (persistent cache makes the warm-attempt
         # compile collapse toward zero) + loop-overlap evidence.
@@ -268,6 +278,77 @@ def _child() -> None:
             / flops_per_token(cfg_8b, cfg_8b.max_seq_len), 1),
         "projected_8b_v5p_mfu": round(projected_8b_v5p, 4),
     }), flush=True)
+
+
+def _spec_probe(spec_k: int = 3) -> dict:
+    """Bounded speculative-decode micro-run for the bench JSON
+    (BENCH_r07+ fields): a tiny llama-test ServeEngine — NOT the bench
+    config; the probe records accept economics, which are
+    model-size-independent, in seconds not minutes — serves a seeded
+    repetition trace closed-loop and reports the measured accept rate
+    and tokens per verify. Failure degrades to null fields: the probe
+    must never cost the bench its training headline."""
+    try:
+        import jax as _jax
+
+        from triton_kubernetes_tpu.models import get_config, init_params
+        from triton_kubernetes_tpu.serve import (
+            RepetitionSchedule,
+            Request,
+            ServeEngine,
+        )
+        from triton_kubernetes_tpu.utils import metrics as _metrics
+
+        cfg = get_config("llama-test")
+        engine = ServeEngine(
+            init_params(cfg, _jax.random.PRNGKey(0)), cfg,
+            block_size=16, num_blocks=96, max_batch=4,
+            max_model_len=128, spec_k=spec_k)
+        _metrics.configure()
+        sched = RepetitionSchedule(rate=1000.0, n=6,
+                                   vocab_size=cfg.vocab_size,
+                                   prompt_len=48, max_new_tokens=48,
+                                   seed=11)
+        for tr in sched:
+            engine.submit(Request(tr.request_id, list(tr.tokens),
+                                  tr.max_new_tokens))
+        # Step manually so verify ticks are attributable: a tick where
+        # the proposed counter moved is a verify; its decode-token
+        # delta is exactly what that verify emitted.
+        prop = _metrics.counter("tk8s_serve_spec_proposed_tokens_total")
+        tps = _metrics.gauge("tk8s_serve_spec_tokens_per_step")
+        verify_ticks = 0
+        tokens_per_seq_sum = 0.0
+        steps = 0
+        while engine.has_work:
+            p0 = prop.value()
+            engine.step()
+            if prop.value() > p0:
+                # The gauge holds this tick's emitted tokens per
+                # decoding sequence (1.0 = plain-decode pace, up to
+                # spec_k + 1): averaging it over verify ticks is the
+                # per-sequence multi-token-verify figure.
+                verify_ticks += 1
+                tokens_per_seq_sum += tps.value()
+            steps += 1
+            if steps > 100_000:
+                raise RuntimeError("spec probe failed to drain")
+        proposed = prop.value()
+        accepted = _metrics.counter(
+            "tk8s_serve_spec_accepted_tokens_total").value()
+        return {
+            "spec_k": spec_k,
+            "accept_rate": (round(accepted / proposed, 4)
+                            if proposed else 0.0),
+            "tokens_per_verify": (
+                round(tokens_per_seq_sum / verify_ticks, 3)
+                if verify_ticks else None),
+        }
+    except Exception as e:  # noqa: BLE001 — the probe is best-effort
+        print(f"[bench-child] spec probe failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        return {"spec_k": spec_k, "accept_rate": None,
+                "tokens_per_verify": None}
 
 
 def _probe() -> None:

@@ -173,3 +173,118 @@ def test_kv_quant_error_scalar():
     q, s = quantize_kv_pages(x)  # [N, Hkv, bs, D] -> scales [N, Hkv]
     err = float(kv_quant_error(q, s[:, :, None, None], x))
     assert 0 < err < 0.05  # int8 KV is near-lossless
+
+
+# --------------------------------------------------------------- fp8
+def _need_fp8():
+    from triton_kubernetes_tpu.ops.quantization import fp8_supported
+
+    if not fp8_supported():
+        pytest.skip("skipped:fp8-unavailable (no float8_e4m3fn in jax)")
+
+
+def test_fp8_quantize_roundtrip_bound():
+    """fp8 (e4m3, 3 mantissa bits) rides the same scale plumbing as
+    int8: per-channel error bounded by a half-ulp relative step (~2^-4
+    of each element), overflow clipped before the cast (e4m3fn has no
+    inf — an unclipped cast would emit NaN)."""
+    _need_fp8()
+    from triton_kubernetes_tpu.ops.quantization import (
+        FP8_MAX,
+        quantize_channelwise,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    q, scale = quantize_channelwise(x, axis=(0,), dtype=jnp.float8_e4m3fn)
+    assert q.dtype == jnp.float8_e4m3fn and scale.shape == (1, 32)
+    dq = np.asarray(q.astype(jnp.float32) * scale)
+    assert np.all(np.isfinite(dq))
+    # Relative half-ulp of e4m3 (2^-4), plus the scale divide's f32 ulp.
+    assert np.all(np.abs(dq - np.asarray(x))
+                  <= np.abs(np.asarray(x)) * (2 ** -4) + 1e-6)
+    big = quantize_with_scale(jnp.asarray([1e6, -1e6]), jnp.asarray(1.0),
+                              jnp.float8_e4m3fn)
+    assert list(np.asarray(big.astype(jnp.float32))) == [FP8_MAX, -FP8_MAX]
+
+
+@pytest.mark.parametrize("name", ["wq", "wo", "w2", "lm_head"])
+def test_fp8_per_matmul_weight_parity(name):
+    """The per-matmul parity-tolerance pin for fp8 weights: ~6% relative
+    output error (3 mantissa bits), against int8's 2% — the dtype trades
+    accuracy for native-float dequant."""
+    _need_fp8()
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams, qcfg = quantize_weights(params, cfg, "fp8")
+    assert qcfg.weight_quant == "fp8"
+    w = params["layers"][name] if name != "lm_head" else params[name]
+    qw = qparams["layers"][name] if name != "lm_head" else qparams[name]
+    assert qw["q"].dtype == jnp.float8_e4m3fn
+    dq = resolve_weight(qw, jnp.float32)
+    axes = _QUANT_AXES_LAYERS.get(name, (0,))
+    x = jax.random.normal(
+        jax.random.PRNGKey(1), tuple(w.shape[a] for a in axes))
+    ref = jnp.tensordot(x, w, axes=(tuple(range(len(axes))), axes))
+    got = jnp.tensordot(x, dq, axes=(tuple(range(len(axes))), axes))
+    rel = float(jnp.linalg.norm(got - ref) / (jnp.linalg.norm(ref) + 1e-9))
+    assert rel < 0.06, f"{name}: rel err {rel}"
+
+
+def test_fp8_weight_quant_loss_delta_pin():
+    """The e2e pin at fp8 tolerance: per-token CE within 0.15 of f32
+    (3x the int8 pin — one mantissa bit fewer than int8's ~7 effective
+    bits on near-gaussian weights costs roughly that)."""
+    _need_fp8()
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams, qcfg = quantize_weights(params, cfg, "fp8")
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+
+    def ce(p, c):
+        logits, _ = forward(p, tokens, c)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        tgt = tokens[:, 1:]
+        return -float(jnp.mean(
+            jnp.take_along_axis(logp, tgt[..., None], axis=-1)))
+
+    delta = abs(ce(qparams, qcfg) - ce(params, cfg))
+    assert delta < 0.15, f"loss delta {delta} exceeds the fp8 pin"
+
+
+def test_fp8_kv_pages_write_order_invariance():
+    """The anchored-scale rule is dtype-generic: fp8 pages filled whole
+    vs token-at-a-time hold bitwise-identical bytes and scales."""
+    _need_fp8()
+    from triton_kubernetes_tpu.ops.paged_attention import scatter_token
+
+    hkv, bs, d = 2, 4, 8
+    fp8 = jnp.dtype(jnp.float8_e4m3fn)
+    content = jax.random.normal(jax.random.PRNGKey(5), (bs, hkv, d))
+    page = jnp.transpose(content, (1, 0, 2))[None]  # [1, Hkv, bs, D]
+    whole_q, whole_s = quantize_kv_pages(page, fp8)
+    kp = jnp.zeros((4, hkv, bs, d), fp8)
+    vp = jnp.zeros((4, hkv, bs, d), fp8)
+    ks = jnp.zeros((4, hkv), jnp.float32)
+    vs = jnp.zeros((4, hkv), jnp.float32)
+    table = jnp.asarray([[2]], jnp.int32)
+    for pos in range(bs):
+        tok = content[None, None, pos]
+        kp, vp, ks, vs = scatter_token(
+            kp, vp, tok, tok, table, jnp.asarray([pos], jnp.int32), ks, vs)
+    np.testing.assert_array_equal(
+        np.asarray(kp[2].astype(jnp.float32)),
+        np.asarray(whole_q[0].astype(jnp.float32)))
+    np.testing.assert_array_equal(np.asarray(ks[2]), np.asarray(whole_s[0]))
+
+
+def test_quantize_weights_rejects_cross_dtype_requant():
+    """int8 -> fp8 re-quantization must raise: compounding two rounding
+    passes silently is how quality regressions hide."""
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams, qcfg = quantize_weights(params, cfg)
+    with pytest.raises(ValueError, match="already"):
+        quantize_weights(qparams, qcfg, "fp8")
+    with pytest.raises(ValueError, match="int8"):
+        quantize_weights(params, cfg, "fp16")

@@ -232,7 +232,34 @@ def test_weight_quantized_engine_serves(model):
     with pytest.raises(KeyError, match="weight_dtype"):
         make_engine(model, weight_dtype="fp4")
     with pytest.raises(ValueError, match="kv_dtype"):
-        make_engine(model, kv_dtype="fp8")
+        make_engine(model, kv_dtype="fp4")
+
+
+def test_fp8_engine_serves_or_skips_loudly(model):
+    """--kv-dtype/--weight-dtype fp8 ride PR 11's scale plumbing: a
+    float8_e4m3fn pool + per-channel fp8 weights serve deterministic
+    greedy output; where this jax build lacks the dtype, engine
+    construction raises the TYPED error (never a silent dtype swap)."""
+    from triton_kubernetes_tpu.ops.quantization import (
+        Fp8UnavailableError,
+        fp8_supported,
+    )
+
+    if not fp8_supported():
+        for kw in (dict(kv_dtype="fp8"), dict(weight_dtype="fp8")):
+            with pytest.raises(Fp8UnavailableError):
+                make_engine(model, **kw)
+        pytest.skip("skipped:fp8-unavailable (no float8_e4m3fn in jax)")
+    eng = make_engine(model, kv_dtype="fp8", weight_dtype="fp8")
+    assert eng.config.weight_quant == "fp8"
+    assert eng.cache.quantized and eng.cache.scale_bytes > 0
+    # fp8 pages: a quarter of the f32 pool at the same geometry.
+    assert make_engine(model).cache.pool_bytes == 4 * eng.cache.pool_bytes
+    a = solo_run(model, [4, 5, 6], 4,
+                 engine=dict(kv_dtype="fp8", weight_dtype="fp8"))
+    b = solo_run(model, [4, 5, 6], 4,
+                 engine=dict(kv_dtype="fp8", weight_dtype="fp8"))
+    assert a == b and len(a) == 4
 
 
 def test_seeded_sampling_independent_of_batch(model):
@@ -417,8 +444,10 @@ def test_cli_has_serve_verb():
     assert args.block_size == 8 and args.num_blocks == 32
     assert args.kv_dtype == "int8" and args.weight_dtype == "int8"
     assert args.sequential
+    assert build_parser().parse_args(
+        ["serve", "--kv-dtype", "fp8", "--spec-k", "4"]).spec_k == 4
     with pytest.raises(SystemExit):
-        build_parser().parse_args(["serve", "--kv-dtype", "fp8"])
+        build_parser().parse_args(["serve", "--kv-dtype", "fp4"])
 
 
 def test_serve_port_matches_topology_pin():

@@ -1,0 +1,418 @@
+"""Speculative self-drafting decode: drafter, acceptance, verify, rewind.
+
+Four altitudes, mirroring how the feature is layered:
+
+* **pure functions** (``serve/speculation.py``): the n-gram drafter's
+  suffix-match properties (deterministic, proposes only tokens from its
+  own history — hence never out-of-vocab — longest-match-first,
+  most-recent-occurrence) and the longest-agreeing-prefix acceptance
+  rule;
+* **op/model level** (``models/paged.py``): every row of the widened
+  ``paged_verify_step`` is BITWISE the ``paged_decode_step`` logits the
+  non-speculative engine would have computed at that position — the
+  identity the whole exact-output contract reduces to — and
+  ``paged_rewind`` restores the pool's bytes exactly after a rejected
+  draft (the poisoned-page pin: pool bytes outside the trash page equal
+  a never-speculated run's, scales included);
+* **kernel parity**: the multi-query ``ragged_verify_attention`` runs
+  the fused Pallas kernel (interpret mode) against the dense reference;
+* **engine level** (``serve/engine.py``): ``spec_k > 0`` outputs are
+  bitwise the ``spec_k = 0`` outputs for greedy AND seeded sampling,
+  across int8/fp8 pools, under churn with forced preemption, and
+  composed with chunked prefill + prefix caching. ``spec_k=0`` IS the
+  PR 12 engine (no verify jits are even built).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from triton_kubernetes_tpu.models import get_config, init_params
+from triton_kubernetes_tpu.models.paged import (
+    init_paged_cache,
+    paged_decode_step,
+    paged_prefill,
+    paged_rewind,
+    paged_verify_step,
+)
+from triton_kubernetes_tpu.ops.paged_attention import (
+    TRASH_PAGE,
+    blocks_for,
+    ragged_verify_attention,
+)
+from triton_kubernetes_tpu.ops.quantization import fp8_supported
+from triton_kubernetes_tpu.serve import (
+    ManualClock,
+    RepetitionSchedule,
+    Request,
+    ServeEngine,
+    draft_ngram,
+    longest_agreeing_prefix,
+)
+from triton_kubernetes_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    metrics.configure()
+    yield
+    metrics.configure()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = get_config("llama-test")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def make_engine(model, **over):
+    cfg, params = model
+    kw = dict(block_size=4, num_blocks=40, max_batch=4, max_model_len=64,
+              clock=ManualClock(tick=0.001))
+    kw.update(over)
+    return ServeEngine(params, cfg, **kw)
+
+
+# ------------------------------------------------------- drafter (pure)
+def test_draft_ngram_deterministic_and_from_history():
+    hist = [3, 1, 4, 1, 5, 9, 2, 6, 5, 9]
+    for k in (1, 2, 4, 8):
+        a = draft_ngram(hist, k)
+        b = draft_ngram(list(hist), k)
+        assert a == b, "same history must draft identically"
+        assert len(a) <= k
+        # Every proposed token is a token of the history — the
+        # structural reason a draft can never be out-of-vocab.
+        assert set(a) <= set(hist)
+
+
+def test_draft_ngram_suffix_match_and_k_cap():
+    # Suffix [5, 9] occurred earlier at index 4, followed by [2, 6].
+    hist = [3, 1, 4, 1, 5, 9, 2, 6, 5, 9]
+    assert draft_ngram(hist, 2) == [2, 6]
+    assert draft_ngram(hist, 1) == [2]  # k caps the proposal
+    assert draft_ngram(hist, 8) == [2, 6, 5, 9]  # runs to history end
+
+
+def test_draft_ngram_prefers_longest_then_most_recent():
+    # 3-gram [1, 2, 3] matches at index 0 (-> 7); the shorter 2-gram
+    # [2, 3] also matches at index 1 (-> 7) and index 5 (-> 9). The
+    # longest match must win over any shorter one.
+    hist = [1, 2, 3, 7, 9, 2, 3, 9, 1, 2, 3]
+    assert draft_ngram(hist, 1) == [7]
+    # With only 2-grams allowed, the MOST RECENT occurrence wins.
+    assert draft_ngram(hist, 1, max_ngram=2) == [9]
+
+
+def test_draft_ngram_empty_cases():
+    assert draft_ngram([1, 2, 3], 0) == []
+    assert draft_ngram([], 4) == []
+    assert draft_ngram([7], 4) == []  # no earlier occurrence possible
+    assert draft_ngram([1, 2, 3, 4], 4) == []  # nothing repeats
+
+
+def test_draft_ngram_property_random_histories():
+    """Seeded property sweep: for ANY history, a draft is (a) at most k
+    tokens, (b) a contiguous slice of the history itself — the
+    structural never-out-of-vocab guarantee — and (c) a pure function
+    of its arguments."""
+    import random
+
+    rng = random.Random(7)
+    for _ in range(300):
+        vocab = rng.randint(4, 32)
+        hist = [rng.randrange(vocab)
+                for _ in range(rng.randint(0, 40))]
+        k = rng.randint(0, 6)
+        d = draft_ngram(hist, k)
+        assert len(d) <= k
+        assert d == draft_ngram(list(hist), k)
+        if d:
+            assert any(hist[i:i + len(d)] == d
+                       for i in range(len(hist))), (
+                "draft is not a slice of its own history")
+
+
+def test_longest_agreeing_prefix():
+    assert longest_agreeing_prefix([], [5]) == 0
+    assert longest_agreeing_prefix([5, 7], [5, 7, 9]) == 2
+    assert longest_agreeing_prefix([5, 7], [5, 8]) == 1
+    assert longest_agreeing_prefix([5, 7], [6]) == 0
+    # Sampled may be shorter (lazy sampling stops at disagreement).
+    assert longest_agreeing_prefix([5, 7, 9], [5]) == 1
+
+
+# --------------------------------------------------- verify step parity
+def _prefilled(model, kv_dtype, prompt=(5, 7, 9, 11, 2)):
+    """A prefilled single-sequence pool + its full block table and the
+    greedy first token — the common setup of the parity pins."""
+    cfg, params = model
+    bs, t = 4, 6
+    cache = init_paged_cache(cfg, 24, bs, kv_dtype=kv_dtype)
+    prompt = list(prompt)
+    n_pages = blocks_for(len(prompt), bs)
+    table = list(range(1, 1 + n_pages)) + [TRASH_PAGE] * (t - n_pages)
+    padded = prompt + [0] * (t * bs - len(prompt))
+    logits, cache = paged_prefill(
+        params, jnp.asarray([padded], jnp.int32),
+        jnp.asarray(len(prompt), jnp.int32), cfg, cache,
+        jnp.asarray(table, jnp.int32))[:2]
+    bt = jnp.asarray([list(range(1, 1 + t))], jnp.int32)
+    return cache, bt, len(prompt), int(jnp.argmax(logits))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+def test_verify_step_rows_match_decode_bitwise(model, kv_dtype):
+    """THE identity the exact-output contract reduces to: verify row j,
+    fed the greedy continuation as its draft, produces bitwise the
+    logits of the j-th sequential decode step."""
+    cfg, params = model
+    cache, bt, plen, tok0 = _prefilled(model, kv_dtype)
+    ref_cache, toks, ref_logits = cache, [tok0], []
+    for step in range(3):
+        lg, ref_cache = paged_decode_step(
+            params, jnp.asarray([toks[-1]], jnp.int32), cfg, ref_cache,
+            bt, jnp.asarray([plen + step], jnp.int32))
+        ref_logits.append(lg[0])
+        toks.append(int(jnp.argmax(lg[0])))
+    vt = jnp.asarray([toks[:3]], jnp.int32)  # last sampled + 2 drafts
+    vlogits, vcache, _ = paged_verify_step(
+        params, vt, cfg, cache, bt, jnp.asarray([plen], jnp.int32))
+    for j in range(3):
+        assert bool(jnp.all(vlogits[0, j] == ref_logits[j])), (
+            f"verify row {j} diverged from the decode step ({kv_dtype})")
+    # The accepted-path pool is also byte-identical (all inputs kept).
+    assert bool(jnp.all(vcache.k[:, 1:] == ref_cache.k[:, 1:]))
+    assert bool(jnp.all(vcache.v[:, 1:] == ref_cache.v[:, 1:]))
+
+
+@pytest.mark.parametrize("kv_dtype", [
+    "auto",
+    pytest.param("int8", marks=pytest.mark.slow),
+    pytest.param("fp8", marks=pytest.mark.slow)])
+def test_verify_rewind_restores_pool_bytes(model, kv_dtype):
+    """The poisoned-page pin: speculate a junk draft, reject everything
+    (keep=1), and the pool — pages AND anchored scales, everywhere but
+    the don't-care trash page — is byte-identical to an engine that
+    only ever ran the plain decode step."""
+    if kv_dtype == "fp8" and not fp8_supported():
+        pytest.skip("skipped:fp8-unavailable (no float8_e4m3fn in jax)")
+    cfg, params = model
+    cache, bt, plen, tok0 = _prefilled(model, kv_dtype)
+    lens = jnp.asarray([plen], jnp.int32)
+    # Reference: ONE plain decode step (the kept input 0).
+    _, ref_cache = paged_decode_step(
+        params, jnp.asarray([tok0], jnp.int32), cfg, cache, bt, lens)
+    # Speculated: the same input 0 + 2 junk draft tokens, all rejected.
+    vt = jnp.asarray([[tok0, 3, 3]], jnp.int32)
+    _, vcache, undo = paged_verify_step(params, vt, cfg, cache, bt, lens)
+    # The junk writes really landed (the pin is not vacuous) ...
+    assert not bool(jnp.all(vcache.k[:, 1:] == ref_cache.k[:, 1:]))
+    rw = paged_rewind(vcache, undo, bt, lens,
+                      jnp.asarray([1], jnp.int32))
+    # ... and the rewind erases every trace of them.
+    for name in ("k", "v"):
+        assert bool(jnp.all(getattr(rw, name)[:, 1:]
+                            == getattr(ref_cache, name)[:, 1:])), name
+    if rw.quantized:
+        for name in ("k_scale", "v_scale"):
+            assert bool(jnp.all(getattr(rw, name)[:, 1:]
+                                == getattr(ref_cache, name)[:, 1:])), name
+
+
+@pytest.mark.slow
+def test_ragged_verify_attention_pallas_interpret_matches_dense(model):
+    """The multi-query widening composes with the fused kernel: the
+    flattened-rows trick must reproduce the dense reference through the
+    SAME Pallas kernel decode uses (interpret mode on CPU)."""
+    cfg, params = model
+    cache, bt, plen, tok0 = _prefilled(model, "auto")
+    vt = jnp.asarray([[tok0, 1, 2]], jnp.int32)
+    lens = jnp.asarray([plen], jnp.int32)
+    # Scatter via the verify step, then compare attention impls on the
+    # written pool directly.
+    _, vcache, _ = paged_verify_step(params, vt, cfg, cache, bt, lens)
+    q = jax.random.normal(
+        jax.random.PRNGKey(3),
+        (1, 3, cfg.num_heads, cfg.head_dim), jnp.float32)
+    want = ragged_verify_attention(
+        q, vcache.k[0], vcache.v[0], bt, lens + 1, impl="dense")
+    got = ragged_verify_attention(
+        q, vcache.k[0], vcache.v[0], bt, lens + 1,
+        impl="pallas-interpret")
+    assert jnp.allclose(want, got, atol=2e-5), (
+        float(jnp.max(jnp.abs(want - got))))
+
+
+# ------------------------------------------------------------- engine
+def solo(model, prompt, n, engine=None, **req_over):
+    eng = make_engine(model, **(engine or {}))
+    eng.submit(Request("solo", list(prompt), n, **req_over))
+    done = eng.run_until_idle()
+    assert len(done) == 1 and eng.allocator.in_use == 0
+    return done[0].tokens
+
+
+# A prompt whose greedy continuation enters the model's cycle within a
+# few tokens (measured) — so the accept-path fires without a long run.
+CYCLING_PROMPT = [169, 201, 77, 56, 201, 85]
+
+
+def test_engine_spec_matches_plain_greedy(model):
+    """The core pin: spec_k > 0 greedy output is bitwise the spec_k = 0
+    output, speculation really fired (proposed AND accepted — not
+    vacuous), and the spec metric families moved coherently."""
+    base = solo(model, CYCLING_PROMPT, 12)
+    eng = make_engine(model, spec_k=3)
+    assert eng.stats()["spec_k"] == 3
+    eng.submit(Request("solo", list(CYCLING_PROMPT), 12))
+    done = eng.run_until_idle()
+    assert done[0].tokens == base and eng.allocator.in_use == 0
+    proposed = metrics.counter(
+        "tk8s_serve_spec_proposed_tokens_total").value()
+    accepted = metrics.counter(
+        "tk8s_serve_spec_accepted_tokens_total").value()
+    assert proposed >= accepted > 0, (
+        "speculation never accepted — the parity pin is vacuous")
+    tps = metrics.gauge("tk8s_serve_spec_tokens_per_step").value()
+    assert 1.0 <= tps <= 4.0
+
+
+@pytest.mark.slow
+def test_engine_spec_matches_plain_seeded(model):
+    """Seeded sampling: acceptance re-samples every position with the
+    request's own (seed, position) key, so even stochastic outputs are
+    bitwise reproduced."""
+    req = dict(temperature=0.8, top_k=8, top_p=0.9, seed=13)
+    want = solo(model, [4, 5, 4, 5, 4, 5], 8, **req)
+    got = solo(model, [4, 5, 4, 5, 4, 5], 8,
+               engine=dict(spec_k=3), **req)
+    assert got == want
+
+
+def test_engine_spec_zero_is_plain_engine(model):
+    """spec_k=0 IS the PR 12 engine: no verify jits exist, the step
+    routes through the identical plain decode, outputs match."""
+    eng = make_engine(model, spec_k=0)
+    assert not hasattr(eng, "_verify") and not hasattr(eng, "_rewind")
+    assert solo(model, [5, 7, 9], 6, engine=dict(spec_k=0)) \
+        == solo(model, [5, 7, 9], 6)
+    with pytest.raises(ValueError, match="spec_k"):
+        make_engine(model, spec_k=-1)
+
+
+@pytest.mark.slow
+def test_engine_spec_eos_truncates_accepted_run(model):
+    """An accepted draft token that IS the eos finishes the request at
+    exactly the token the plain engine stops at — accepted tokens past
+    the eos are discarded, not emitted."""
+    base = solo(model, CYCLING_PROMPT, 12)
+    eos = base[len(base) // 2]
+    eng = make_engine(model, spec_k=3)
+    eng.submit(Request("r", list(CYCLING_PROMPT), 12, eos_id=eos))
+    done = eng.run_until_idle()[0]
+    assert done.tokens == base[:base.index(eos) + 1]
+    assert done.finish_reason == "eos"
+
+
+@pytest.mark.slow
+def test_engine_spec_composes_with_chunked_prefill_and_prefix(model):
+    """Speculation + chunked prefill + radix prefix sharing: same
+    outputs as the plain chunked engine, and prefix pages are reused
+    while being speculated around (never into)."""
+    shared = [9, 4, 2, 7, 9, 4, 2, 7]  # page-aligned shared prefix
+    reqs = [Request(f"r{i}", shared + [i + 1, i + 2], 8)
+            for i in range(3)]
+    outs = {}
+    for spec_k in (0, 2):
+        metrics.configure()
+        eng = make_engine(model, prefill_chunk=8, prefix_cache=True,
+                          spec_k=spec_k)
+        # First request lands alone so its full prefix pages are
+        # indexed before the followers arrive and map them.
+        eng.submit(Request(reqs[0].request_id, list(reqs[0].tokens),
+                           reqs[0].max_new_tokens))
+        done = list(eng.run_until_idle())
+        for r in reqs[1:]:
+            eng.submit(Request(r.request_id, list(r.tokens),
+                               r.max_new_tokens))
+        done.extend(eng.run_until_idle())
+        outs[spec_k] = {d.request_id: d.tokens for d in done}
+        assert metrics.counter(
+            "tk8s_serve_prefix_hit_tokens_total").value() > 0
+        eng.release_prefix_cache()
+        assert eng.allocator.in_use == 0
+    assert outs[2] == outs[0]
+
+
+@pytest.mark.slow
+def test_engine_spec_churn_preemption_parity(model):
+    """The engine churn pin with speculation ON: staggered arrivals,
+    ragged lengths, pool tight enough to force preemption — every
+    completion equals its spec-OFF run and the pool drains. Speculative
+    pages are opportunistic, so preemption decisions match the plain
+    engine's."""
+    prompts = [
+        ([5, 7, 9, 11, 2, 4, 6, 8], 16),
+        ([3, 1, 4, 1, 5, 9, 2, 6], 16),
+        ([2, 2, 2], 5),
+        ([9, 8, 7, 6, 5, 4, 3, 2, 1, 0, 1, 2, 3], 7),
+    ]
+    results, preempts = {}, {}
+    for spec_k in (0, 3):
+        metrics.configure()
+        eng = make_engine(model, num_blocks=10, max_batch=3,
+                          max_model_len=32, spec_k=spec_k)
+        arrivals = {0: [0], 1: [1, 2], 3: [3]}
+        out, step = {}, 0
+        while eng.has_work or step < 5:
+            for idx in arrivals.get(step, []):
+                p, n = prompts[idx]
+                eng.submit(Request(f"r{idx}", p, n))
+            for d in eng.step():
+                out[d.request_id] = d.tokens
+            step += 1
+            assert step < 500, "engine failed to drain"
+        preempts[spec_k] = metrics.counter(
+            "tk8s_serve_preemptions_total").value()
+        assert preempts[spec_k] >= 1, (
+            "scenario no longer preempts — the parity pin is vacuous")
+        assert eng.allocator.in_use == 0, "leaked KV pages"
+        results[spec_k] = out
+    assert results[3] == results[0]
+    # Speculative pages are opportunistic (allocated only AFTER every
+    # sequence's mandatory growth, trimmed under pressure), so
+    # speculation must not cause a single preemption the plain engine
+    # would not have made.
+    assert preempts[3] == preempts[0], (
+        f"speculation changed preemption count: {preempts}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_engine_spec_quantized_pools_bitwise(model, kv_dtype):
+    """Quantized pools under speculation: the anchored-scale rewind
+    keeps spec ON == OFF bitwise on int8 and fp8 pages."""
+    if kv_dtype == "fp8" and not fp8_supported():
+        pytest.skip("skipped:fp8-unavailable (no float8_e4m3fn in jax)")
+    reqs = [([5, 7, 5, 7, 5, 7, 5, 7], 12), ([3, 1, 4, 1, 5, 9], 8)]
+    for p, n in reqs:
+        want = solo(model, p, n, engine=dict(kv_dtype=kv_dtype))
+        got = solo(model, p, n,
+                   engine=dict(kv_dtype=kv_dtype, spec_k=3))
+        assert got == want
+
+
+def test_repetition_schedule_seeded_and_repetitive():
+    a = RepetitionSchedule(rate=10.0, n=8, vocab_size=64, seed=3)
+    b = RepetitionSchedule(rate=10.0, n=8, vocab_size=64, seed=3)
+    assert [(r.at, r.tokens) for r in a] == [(r.at, r.tokens) for r in b]
+    assert len(a) == 8
+    for r in a:
+        assert len(r.tokens) == 48
+        # Tiled motif: the prompt's own suffix recurs, so the drafter
+        # has something to match.
+        assert draft_ngram(r.tokens, 4) != []
+    with pytest.raises(ValueError, match="rate"):
+        RepetitionSchedule(rate=0, n=1, vocab_size=8)

@@ -277,17 +277,23 @@ def build_parser() -> argparse.ArgumentParser:
                        choices=list(KV_DTYPES), metavar="DTYPE",
                        help="KV-cache page storage: auto = the model "
                             "config's activation dtype, bf16 = force "
-                            "bfloat16 pages, int8 = quantized pages with "
-                            "per-page-per-head f32 scales — ~4x fewer "
-                            "pool bytes than f32 (~2x vs bf16), i.e. "
-                            "that many more concurrent sequences per "
-                            "chip (docs/guide/serving.md §Quantization)")
+                            "bfloat16 pages, int8/fp8 = quantized pages "
+                            "(int8 or float8_e4m3fn) with per-page-per-"
+                            "head f32 scales — ~4x fewer pool bytes "
+                            "than f32 (~2x vs bf16), i.e. that many "
+                            "more concurrent sequences per chip; fp8 "
+                            "fails loudly where this jax build lacks "
+                            "the dtype (docs/guide/serving.md "
+                            "§Quantization)")
     serve.add_argument("--weight-dtype", default="auto",
                        choices=list(WEIGHT_DTYPES), metavar="DTYPE",
-                       help="decode weight storage: int8 = per-channel "
-                            "symmetric quantization of the big matmuls "
-                            "(embed/norms/router stay full precision; "
-                            "the caller's f32 master tree is untouched)")
+                       help="decode weight storage: int8/fp8 = per-"
+                            "channel symmetric quantization of the big "
+                            "matmuls to int8 or float8_e4m3fn (embed/"
+                            "norms/router stay full precision; the "
+                            "caller's f32 master tree is untouched; fp8 "
+                            "fails loudly where this jax build lacks "
+                            "the dtype)")
     serve.add_argument("--sequential", action="store_true",
                        help="serve one request at a time (the continuous-"
                             "batching A/B baseline; scripts/ci/"
@@ -315,6 +321,17 @@ def build_parser() -> argparse.ArgumentParser:
                        help="disable shared-prefix KV reuse (outputs are "
                             "identical either way — the cache is a pure "
                             "prefill-compute save)")
+    serve.add_argument("--spec-k", type=int, default=0, metavar="N",
+                       help="speculative self-drafting decode: propose "
+                            "up to N tokens per sequence per step from "
+                            "an n-gram match over its own prompt + "
+                            "generated text and verify all N+1 "
+                            "positions in one widened pass — one "
+                            "weight/KV read for several tokens on "
+                            "repetitive text, with outputs bitwise "
+                            "identical to 0 (the default, speculation "
+                            "off; docs/guide/serving.md §Speculative "
+                            "decoding)")
     serve.add_argument("--seed", type=int, default=0, metavar="N",
                        help="parameter-init seed for the randomly "
                             "initialized model (default: 0)")
@@ -493,6 +510,11 @@ def main(argv: Optional[List[str]] = None,
         prefix_cache = (prefill_chunk is not None
                         if args.prefix_cache is None
                         else args.prefix_cache)
+        if args.spec_k < 0:
+            logger.error(
+                f"--spec-k must be >= 0, got {args.spec_k}",
+                kind="ValueError")
+            return 2
         engine = ServeEngine(
             init_params(model_config, _jax.random.PRNGKey(args.seed)),
             model_config,
@@ -501,7 +523,7 @@ def main(argv: Optional[List[str]] = None,
             sequential=args.sequential,
             kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
             prefill_chunk=prefill_chunk,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, spec_k=args.spec_k)
         server = ServeHTTPServer(engine, host=args.serve_host,
                                  port=args.port)
         host, port = server.address
@@ -510,7 +532,7 @@ def main(argv: Optional[List[str]] = None,
                     num_blocks=args.num_blocks, max_batch=args.max_batch,
                     kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
                     prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache)
+                    prefix_cache=prefix_cache, spec_k=args.spec_k)
         print(f"serving {args.model} on http://{host}:{port} "
               f"(POST /generate, GET /metrics, GET /healthz)", flush=True)
         try:

@@ -61,6 +61,9 @@ EXIT_RESUME = 75
 # registers them on jax-less machines while models/paged.py
 # (init_paged_cache) and train/precision.py (quantize_for_decode)
 # validate them at runtime — one tuple here keeps argparse and the
-# engine from ever drifting.
-KV_DTYPES = ("auto", "bf16", "int8")
-WEIGHT_DTYPES = ("auto", "int8")
+# engine from ever drifting. "fp8" (float8_e4m3fn) registers on every
+# machine but resolves at engine init: where the runtime jax lacks the
+# dtype it raises ops.quantization.Fp8UnavailableError — a loud typed
+# failure, never a silent fallback.
+KV_DTYPES = ("auto", "bf16", "int8", "fp8")
+WEIGHT_DTYPES = ("auto", "int8", "fp8")

@@ -59,11 +59,12 @@ class ModelConfig:
     # "flash-interpret" — interpret mode on every backend (tests only).
     attention: str = "auto"
     # Decode-time weight storage (models.llama.quantize_weights): "none"
-    # keeps param_dtype weights; "int8" means the big-matmul leaves are
-    # {"q": int8, "scale": f32} pairs (per-channel symmetric). Set ONLY
-    # by quantize_weights together with the params rewrite — the pair
-    # travels as one, mirroring train/precision.py's apply-policy shape,
-    # so a config/params half-applied state cannot exist.
+    # keeps param_dtype weights; "int8"/"fp8" mean the big-matmul
+    # leaves are {"q": int8|float8_e4m3fn, "scale": f32} pairs
+    # (per-channel symmetric). Set ONLY by quantize_weights together
+    # with the params rewrite — the pair travels as one, mirroring
+    # train/precision.py's apply-policy shape, so a config/params
+    # half-applied state cannot exist.
     weight_quant: str = "none"
 
     def __post_init__(self):
@@ -80,9 +81,9 @@ class ModelConfig:
             raise ValueError(
                 f"moe_dispatch must be 'auto', 'dense', or 'sort', got "
                 f"{self.moe_dispatch!r}")
-        if self.weight_quant not in ("none", "int8"):
+        if self.weight_quant not in ("none", "int8", "fp8"):
             raise ValueError(
-                f"weight_quant must be 'none' or 'int8', got "
+                f"weight_quant must be 'none', 'int8', or 'fp8', got "
                 f"{self.weight_quant!r}")
     scan_layers: bool = True  # lax.scan over the layer stack
     # Fused cross-entropy head (ops/fused_ce.py): compute the loss in vocab

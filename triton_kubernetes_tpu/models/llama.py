@@ -96,28 +96,42 @@ _QUANT_AXES_LAYERS: Dict[str, Tuple[int, ...]] = {
 
 
 def quantize_weights(params: Params, config: ModelConfig,
-                     ) -> Tuple[Params, ModelConfig]:
-    """Per-channel symmetric int8 for the big decode matmuls.
+                     dtype: str = "int8") -> Tuple[Params, ModelConfig]:
+    """Per-channel symmetric quantization for the big decode matmuls.
 
     Returns a NEW ``(params, config)`` pair: every weight named in
     :data:`_QUANT_AXES_LAYERS` plus ``lm_head`` becomes a
-    ``{"q": int8, "scale": f32}`` leaf, and the config records
-    ``weight_quant="int8"`` — the two rewrites travel together (the
-    apply-policy shape from train/precision.py), so a half-applied
+    ``{"q": int8|float8_e4m3fn, "scale": f32}`` leaf, and the config
+    records ``weight_quant=dtype`` — the two rewrites travel together
+    (the apply-policy shape from train/precision.py), so a half-applied
     state cannot exist. The caller's f32 master tree is untouched
     (pure function); ``embed`` (a gather, not a matmul), the MoE
     router (tiny, routing-sensitive), and the norms stay full
-    precision. Idempotent: quantizing twice is the identity.
+    precision. Idempotent: quantizing twice at the same dtype is the
+    identity; re-quantizing an already-quantized tree at a DIFFERENT
+    dtype raises (quantization losses must not compound silently).
+    ``dtype="fp8"`` raises ``Fp8UnavailableError`` where this jax build
+    lacks ``float8_e4m3fn`` — a loud typed failure, never a fallback.
     """
     from dataclasses import replace
 
-    from ..ops.quantization import quantize_int8
+    from ..ops.quantization import fp8_dtype, quantize_channelwise
 
-    if config.weight_quant == "int8":
+    if dtype not in ("int8", "fp8"):
+        raise ValueError(
+            f"quantize_weights dtype must be 'int8' or 'fp8', got "
+            f"{dtype!r}")
+    if config.weight_quant == dtype:
         return params, config
+    if config.weight_quant != "none":
+        raise ValueError(
+            f"params are already weight_quant={config.weight_quant!r}; "
+            f"re-quantizing to {dtype!r} would compound rounding losses "
+            f"— quantize from the full-precision tree instead")
+    qdtype = jnp.int8 if dtype == "int8" else fp8_dtype()
 
     def qleaf(w, axes):
-        q, scale = quantize_int8(w, axes)
+        q, scale = quantize_channelwise(w, axes, qdtype)
         return {"q": q, "scale": scale}
 
     layers = dict(params["layers"])
@@ -127,7 +141,7 @@ def quantize_weights(params: Params, config: ModelConfig,
     new = dict(params)
     new["layers"] = layers
     new["lm_head"] = qleaf(params["lm_head"], (0,))  # [d, v]: contract d
-    return new, replace(config, weight_quant="int8")
+    return new, replace(config, weight_quant=dtype)
 
 
 def remat_block(body: Callable, config: ModelConfig) -> Callable:

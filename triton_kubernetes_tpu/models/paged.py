@@ -53,13 +53,17 @@ from jax import lax
 from ..constants import KV_DTYPES
 from ..ops.attention import causal_attention
 from ..ops.paged_attention import (
+    TRASH_PAGE,
     gather_pages,
     ragged_paged_attention,
+    ragged_verify_attention,
     resolve_paged_impl,
     scatter_chunk,
+    scatter_span,
     scatter_token,
+    table_slots,
 )
-from ..ops.quantization import kv_quant_error, quantize_kv_pages
+from ..ops.quantization import fp8_dtype, kv_quant_error, quantize_kv_pages
 from ..ops.rotary import rotary_tables
 from .config import ModelConfig
 from . import llama
@@ -87,7 +91,10 @@ class PagedKVCache(NamedTuple):
 
     @property
     def quantized(self) -> bool:
-        return self.k.dtype == jnp.int8
+        """Whether pages carry quantized values + scales (int8 or fp8).
+        Keyed off the scale tensors, not the page dtype, so adding a
+        scaled dtype can never leave a path half-aware of it."""
+        return self.k_scale is not None
 
     @property
     def pool_bytes(self) -> int:
@@ -115,6 +122,10 @@ def init_paged_cache(config: ModelConfig, num_blocks: int,
              block_size, config.head_dim)
     if kv_dtype == "int8":
         dtype: jnp.dtype = jnp.dtype(jnp.int8)
+    elif kv_dtype == "fp8":
+        # Raises Fp8UnavailableError where this jax build lacks the
+        # dtype — the loud typed path, never a silent int8/bf16 swap.
+        dtype = fp8_dtype()
     elif kv_dtype == "bf16":
         dtype = jnp.dtype(jnp.bfloat16)
     else:
@@ -122,7 +133,7 @@ def init_paged_cache(config: ModelConfig, num_blocks: int,
     # Distinct buffers, never one aliased zeros array: the engine
     # donates every pool array to its jitted steps, and XLA rejects
     # donating the same buffer twice.
-    if kv_dtype == "int8":
+    if kv_dtype in ("int8", "fp8"):
         sshape = (config.num_layers, num_blocks, config.num_kv_heads)
         return PagedKVCache(
             k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
@@ -200,8 +211,8 @@ def paged_prefill(
     # what token-at-a-time decode writes produce for the same token
     # values — the quantizer's contribution to the recompute-on-readmit
     # (preemption) parity contract (ops/quantization.py docstring).
-    qk, sk = quantize_kv_pages(k)
-    qv, sv = quantize_kv_pages(v)
+    qk, sk = quantize_kv_pages(k, cache.k.dtype)
+    qv, sv = quantize_kv_pages(v, cache.v.dtype)
     new = PagedKVCache(
         k=cache.k.at[:, block_table].set(qk),
         v=cache.v.at[:, block_table].set(qv),
@@ -414,3 +425,172 @@ def paged_decode_step(
         new_cache = PagedKVCache(k=kp, v=vp)
     logits = llama.unembed(x, params, config)[:, 0, :]
     return logits, new_cache
+
+
+class VerifyUndo(NamedTuple):
+    """Pre-write bytes of every pool slot a verify step is about to
+    touch — what :func:`paged_rewind` scatters back for rejected draft
+    positions, so a speculated-then-rejected tail leaves the pool
+    byte-identical to an engine that never speculated (the
+    poisoned-page pin in tests/test_speculation.py). ``k_scale``/
+    ``v_scale`` are the touched pages' PRE-verify scales (an anchored
+    scale only moves when a slot-0 write lands, so restoring it undoes
+    exactly the slot-0 rejections)."""
+
+    k: jnp.ndarray  # [L, B, S, Hkv, Dh] page dtype
+    v: jnp.ndarray  # [L, B, S, Hkv, Dh]
+    k_scale: Optional[jnp.ndarray] = None  # [L, B, S, Hkv] f32
+    v_scale: Optional[jnp.ndarray] = None  # [L, B, S, Hkv] f32
+
+
+def _verify_slots(block_tables: jnp.ndarray, lengths: jnp.ndarray,
+                  s: int, block_size: int,
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(page [B, S], offset [B, S]) of the slots verify input ``j``
+    lands in: position ``lengths[b] + j`` through the SAME
+    ``table_slots`` mapping every write path uses — undo capture and
+    rewind must target exactly the slots ``scatter_span``'s writes
+    hit, so there is deliberately no second copy of the rule."""
+    pos = (lengths[:, None]
+           + jnp.arange(s, dtype=jnp.int32)[None, :])  # [B, S]
+    return table_slots(block_tables, pos, block_size)
+
+
+def paged_verify_step(
+    params,
+    tokens: jnp.ndarray,  # [B, S] int32 — last sampled + spec_k drafts
+    config: ModelConfig,
+    cache: PagedKVCache,
+    block_tables: jnp.ndarray,  # [B, T] int32
+    lengths: jnp.ndarray,  # [B] int32 — tokens already written per seq
+    attention_impl: Optional[str] = None,
+) -> Tuple[jnp.ndarray, PagedKVCache, VerifyUndo]:
+    """One speculative verify step: ``S = spec_k + 1`` tokens per
+    sequence through the stack in a single widened pass. Returns
+    (logits [B, S, V] f32, updated pool, :class:`VerifyUndo`).
+
+    Input ``j`` of sequence ``b`` is written at position
+    ``lengths[b] + j`` (input 0 is the sequence's latest *real* sampled
+    token — exactly what plain decode would write — inputs 1.. are the
+    self-drafted proposals) and its logits row is the model's
+    next-token distribution given the draft prefix before it. Row-for-
+    row, the math is plain :func:`paged_decode_step` — same
+    ``llama._qkv``/ragged-attention/``llama._mlp`` chain, same
+    token-at-a-time pool writes (``scatter_span``), the queries merely
+    batched along a second axis the ops are element-independent over —
+    which is what makes an ACCEPTED row's logits bitwise equal to the
+    decode step the non-speculative engine would have run (pinned in
+    tests/test_speculation.py). The whole weight pass is paid ONCE for
+    all S positions: the bandwidth exchange speculation exists for.
+
+    Inactive batch slots ride an all-trash table exactly as in decode;
+    sequences with fewer than ``spec_k`` drafted tokens carry pad
+    inputs whose writes the engine rewinds along with rejections
+    (:func:`paged_rewind`), so the pool never keeps a byte plain decode
+    would not have produced.
+    """
+    if attention_impl is None:
+        attention_impl = resolve_paged_impl(config.attention)
+    b, s = tokens.shape
+    ad = config.activation_dtype
+    positions = (lengths[:, None]
+                 + jnp.arange(s, dtype=jnp.int32)[None, :])  # [B, S]
+    cos, sin = rotary_tables(
+        config.head_dim, config.max_seq_len, config.rope_theta)
+    x = params["embed"].astype(ad)[tokens]  # [B, S, D]
+    quantized = cache.quantized
+    # Pre-write bytes of every slot this step will touch, captured for
+    # ALL layers in one gather before any write: each (layer, slot) is
+    # written at most once below, so "before the scan" == "before its
+    # write". Advanced-indexing note: the [B, S] index pair is
+    # separated by slice axes, so the indexed dims land in FRONT —
+    # [B, S, L, ...] — and are transposed to layer-major here once.
+    page, offset = _verify_slots(block_tables, lengths, s,
+                                 cache.block_size)
+    undo = VerifyUndo(
+        k=jnp.transpose(cache.k[:, page, :, offset], (2, 0, 1, 3, 4)),
+        v=jnp.transpose(cache.v[:, page, :, offset], (2, 0, 1, 3, 4)),
+        k_scale=(cache.k_scale[:, page] if quantized else None),
+        v_scale=(cache.v_scale[:, page] if quantized else None))
+
+    def body(carry, layer_and_pages):
+        x = carry
+        if quantized:
+            layer, kp, vp, ks, vs = layer_and_pages
+        else:
+            layer, kp, vp = layer_and_pages
+            ks = vs = None
+        q, k, v = llama._qkv(x, layer, config, cos, sin, positions)
+        written = scatter_span(kp, vp, k, v, block_tables, lengths,
+                               ks, vs)
+        if quantized:
+            kp, vp, ks, vs = written
+        else:
+            kp, vp = written
+        attn = ragged_verify_attention(
+            q, kp, vp, block_tables, lengths + 1, ks, vs,
+            impl=attention_impl)
+        x = llama.project_out(x, attn, layer, config)
+        y, _ = llama._mlp(x, layer, config)
+        if quantized:
+            return x + y, (kp, vp, ks, vs)
+        return x + y, (kp, vp)
+
+    if quantized:
+        x, (kp, vp, ks, vs) = lax.scan(
+            body, x, (params["layers"], cache.k, cache.v,
+                      cache.k_scale, cache.v_scale))
+        new_cache = PagedKVCache(k=kp, v=vp, k_scale=ks, v_scale=vs)
+    else:
+        x, (kp, vp) = lax.scan(
+            body, x, (params["layers"], cache.k, cache.v))
+        new_cache = PagedKVCache(k=kp, v=vp)
+    logits = llama.unembed(x, params, config)  # [B, S, V]
+    return logits, new_cache, undo
+
+
+def paged_rewind(
+    cache: PagedKVCache,
+    undo: VerifyUndo,
+    block_tables: jnp.ndarray,  # [B, T] int32
+    lengths: jnp.ndarray,  # [B] int32 — same operand the verify took
+    keep: jnp.ndarray,  # [B] int32 — verify inputs to KEEP (accepted+1)
+) -> PagedKVCache:
+    """Roll rejected speculative writes back: restore the pre-verify
+    bytes of every slot whose input index ``j >= keep[b]``, pages and
+    (for quantized pools) anchored scales alike.
+
+    Kept slots and inactive batch rows must not be touched, and a
+    conditional scatter needs somewhere to PUT its masked lanes — so
+    masked writes are steered to the trash page, the same don't-care
+    sink every inactive decode write already uses. Rewound slots only
+    ever live in pages the sequence exclusively owns: generated tokens
+    never land in prefix-cache pages (serve/engine.py admission
+    guarantees writes begin past the shared full-prompt pages), which
+    is why rolling them back cannot disturb a neighbor sequence —
+    refcounted sharing is untouched by design, not by luck.
+
+    A page's scale is restored only where the rejected slot was the
+    page's slot 0 (the only write that moves an anchored scale), so a
+    page that keeps an accepted anchor keeps its new scale.
+    """
+    s = undo.k.shape[2]
+    page, offset = _verify_slots(block_tables, lengths, s,
+                                 cache.block_size)
+    rej = (jnp.arange(s, dtype=jnp.int32)[None, :]
+           >= keep[:, None])  # [B, S]
+    page_w = jnp.where(rej, page, TRASH_PAGE)
+    # Indexed result is [B, S, L, Hkv, D] (the paged_verify_step
+    # advanced-indexing note) — permute the layer-major undo to match.
+    k = cache.k.at[:, page_w, :, offset].set(
+        jnp.transpose(undo.k, (1, 2, 0, 3, 4)))
+    v = cache.v.at[:, page_w, :, offset].set(
+        jnp.transpose(undo.v, (1, 2, 0, 3, 4)))
+    if not cache.quantized:
+        return PagedKVCache(k=k, v=v)
+    spage = jnp.where(rej & (offset == 0), page, TRASH_PAGE)
+    # Single (non-separated) advanced index: dims stay in place, so the
+    # layer-major undo scales already match the indexed [L, B, S, Hkv].
+    k_scale = cache.k_scale.at[:, spage].set(undo.k_scale)
+    v_scale = cache.v_scale.at[:, spage].set(undo.v_scale)
+    return PagedKVCache(k=k, v=v, k_scale=k_scale, v_scale=v_scale)

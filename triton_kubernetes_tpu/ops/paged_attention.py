@@ -175,6 +175,74 @@ def ragged_paged_attention(
     return causal_attention(q, k, v, q_positions, k_positions)
 
 
+def ragged_verify_attention(
+    q: jnp.ndarray,  # [B, S, Hq, D] — S consecutive queries per sequence
+    k_pages: jnp.ndarray,  # [N, Hkv, bs, D]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, T] int32
+    lengths: jnp.ndarray,  # [B] int32 — tokens written incl. row 0's
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    impl: str = "dense",
+) -> jnp.ndarray:
+    """One verify step of attention for a ragged batch at ``S``
+    positions per sequence: [B, S, Hq, D] — the multi-query widening of
+    :func:`ragged_paged_attention` that speculative decoding scores its
+    ``spec_k + 1`` proposed positions with, in ONE call.
+
+    Row ``j`` of sequence ``b`` sits at position ``lengths[b] - 1 + j``
+    and attends every written slot up to and including its own — so row
+    ``j`` sees the draft tokens of rows ``< j`` (their K/V must already
+    be scattered in, the :func:`scatter_span` contract) and is blind to
+    rows ``> j``: exactly the causal context plain decode would have
+    given it, which is why an accepted row's logits reproduce the
+    non-speculative step bitwise.
+
+    Implementation: the S queries flatten into S independent batch rows
+    sharing the sequence's block table at staggered lengths, then run
+    the UNCHANGED single-query kernel — so the dense reference, the
+    fused Pallas kernel's explicit-position masking, and the int8/fp8
+    dequantization all compose with verification without a second code
+    path to keep in parity.
+    """
+    b, s, hq, d = q.shape
+    t = block_tables.shape[1]
+    qf = q.reshape(b * s, 1, hq, d)
+    tables_f = jnp.repeat(block_tables, s, axis=0)  # [B*S, T]
+    lens_f = (lengths[:, None]
+              + jnp.arange(s, dtype=jnp.int32)[None, :]).reshape(-1)
+    out = ragged_paged_attention(qf, k_pages, v_pages, tables_f, lens_f,
+                                 k_scale, v_scale, impl=impl)
+    return out.reshape(b, s, hq, d)
+
+
+def table_slots(
+    block_tables: jnp.ndarray,  # [B, T] int32
+    positions: jnp.ndarray,  # [B] or [B, S] int32
+    block_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(physical page, slot offset) for per-sequence token positions —
+    THE logical-position-to-pool-slot mapping, shared by every write
+    path and by the verify step's undo capture/rewind (which must
+    target exactly the slots the writes hit — two copies of this rule
+    would be a silent-corruption hazard).
+
+    Positions past the table's coverage resolve to the trash page:
+    XLA's gather would otherwise CLAMP the logical block to the
+    table's last entry, a real page (speculative pad tokens near the
+    model-length window end are the case that hits this).
+    """
+    b, t = block_tables.shape
+    blk = positions // block_size
+    idx = jnp.arange(b, dtype=jnp.int32).reshape(
+        (b,) + (1,) * (positions.ndim - 1))
+    page = jnp.where(
+        blk < t,
+        block_tables[idx, jnp.minimum(blk, t - 1)],
+        TRASH_PAGE)
+    return page, positions % block_size
+
+
 def scatter_token(
     k_pages: jnp.ndarray,  # [N, Hkv, bs, D]
     v_pages: jnp.ndarray,
@@ -197,12 +265,12 @@ def scatter_token(
 
     Inactive batch slots must carry an all-trash block table (and any
     position): their writes land in the trash page, colliding only with
-    each other, never with an allocated page.
+    each other, never with an allocated page. Positions past the
+    table's coverage scatter to the trash page too (the
+    :func:`table_slots` rule).
     """
-    b = positions.shape[0]
     bs = k_pages.shape[2]
-    page = block_tables[jnp.arange(b), positions // bs]  # [B]
-    offset = positions % bs  # [B]
+    page, offset = table_slots(block_tables, positions, bs)  # [B], [B]
     if (k_scale is None) != (v_scale is None):
         raise ValueError("pass both k_scale and v_scale, or neither")
     if k_scale is None:
@@ -212,14 +280,53 @@ def scatter_token(
             v[:, 0].astype(v_pages.dtype))
         return k_pages, v_pages
     first = (offset == 0)[:, None]  # [B, 1] — this token anchors its page
-    new_ks = jnp.where(first, token_kv_scale(k[:, 0]), k_scale[page])
-    new_vs = jnp.where(first, token_kv_scale(v[:, 0]), v_scale[page])
+    qd = k_pages.dtype  # int8 or fp8: the anchored-scale rule is shared
+    new_ks = jnp.where(first, token_kv_scale(k[:, 0], qd), k_scale[page])
+    new_vs = jnp.where(first, token_kv_scale(v[:, 0], qd), v_scale[page])
     k_pages = k_pages.at[page, :, offset].set(
-        quantize_with_scale(k[:, 0], new_ks[:, :, None]))
+        quantize_with_scale(k[:, 0], new_ks[:, :, None], qd))
     v_pages = v_pages.at[page, :, offset].set(
-        quantize_with_scale(v[:, 0], new_vs[:, :, None]))
+        quantize_with_scale(v[:, 0], new_vs[:, :, None], qd))
     return (k_pages, v_pages,
             k_scale.at[page].set(new_ks), v_scale.at[page].set(new_vs))
+
+
+def scatter_span(
+    k_pages: jnp.ndarray,  # [N, Hkv, bs, D]
+    v_pages: jnp.ndarray,
+    k: jnp.ndarray,  # [B, S, Hkv, D] — S consecutive tokens per sequence
+    v: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, T] int32
+    start: jnp.ndarray,  # [B] int32 — slot of each sequence's token 0
+    k_scale: Optional[jnp.ndarray] = None,  # [N, Hkv] f32 (quantized)
+    v_scale: Optional[jnp.ndarray] = None,
+):
+    """Write ``S`` consecutive tokens per sequence: token ``j`` lands at
+    position ``start[b] + j`` — the multi-token write of the speculative
+    verify step.
+
+    Deliberately implemented as ``S`` :func:`scatter_token` calls in
+    position order (``S`` is static and small — ``spec_k + 1``), NOT as
+    one batched scatter: token-at-a-time writes are exactly what
+    non-speculative decode issues, so the quantized pool's anchored
+    scales — where a token landing in slot 0 *sets* its page's scale
+    and later slots quantize against it — come out bitwise identical to
+    the plain-decode byte stream. That identity is what the engine's
+    exact-output parity contract stands on (docs/guide/serving.md
+    §Speculative decoding).
+    """
+    s = k.shape[1]
+    out = (k_pages, v_pages) if k_scale is None \
+        else (k_pages, v_pages, k_scale, v_scale)
+    for j in range(s):
+        if len(out) == 2:
+            kp, vp = out
+            ks = vs = None
+        else:
+            kp, vp, ks, vs = out
+        out = scatter_token(kp, vp, k[:, j:j + 1], v[:, j:j + 1],
+                            block_tables, start + j, ks, vs)
+    return out
 
 
 def scatter_chunk(
@@ -264,8 +371,8 @@ def scatter_chunk(
     if k_scale is None:
         return (k_pages.at[window_table].set(kw.astype(k_pages.dtype)),
                 v_pages.at[window_table].set(vw.astype(v_pages.dtype)))
-    qk, sk = quantize_kv_pages(kw)
-    qv, sv = quantize_kv_pages(vw)
+    qk, sk = quantize_kv_pages(kw, k_pages.dtype)
+    qv, sv = quantize_kv_pages(vw, v_pages.dtype)
     return (k_pages.at[window_table].set(qk),
             v_pages.at[window_table].set(qv),
             k_scale.at[window_table].set(sk),

@@ -44,6 +44,10 @@ import jax.numpy as jnp
 # Symmetric int8 range: +-127, never -128 (keeps abs() exact and the
 # scale math symmetric).
 INT8_MAX = 127.0
+# float8_e4m3fn's largest finite value. fp8 OVERFLOWS TO NAN on cast
+# (it has no inf), so the quantizer clips to this BEFORE the cast —
+# the fp8 twin of int8's clip-before-round.
+FP8_MAX = 448.0
 # Anchored KV scales quantize later tokens against the first token's
 # amplitude; 2x headroom halves the clamp probability at the cost of
 # one effective bit (|q| <= 63 for the anchor token itself).
@@ -54,27 +58,80 @@ KV_SCALE_HEADROOM = 2.0
 MIN_SCALE = 1e-8
 
 
-def quantize_with_scale(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
-    """Symmetric int8 with a caller-supplied (broadcastable) scale:
-    ``clip(round(x / scale), -127, 127)``. The one quantizer every
-    write path shares — bitwise agreement between prefill and decode
-    writes reduces to agreeing on ``scale``."""
-    q = jnp.round(x.astype(jnp.float32) / scale)
-    return jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+class Fp8UnavailableError(RuntimeError):
+    """This jax build has no ``float8_e4m3fn`` — a loud typed failure
+    for ``--kv-dtype/--weight-dtype fp8`` (and the tests' skip reason),
+    never a silent fallback to a different dtype."""
+
+
+def fp8_supported() -> bool:
+    """Whether this jax exposes ``float8_e4m3fn`` (ml_dtypes-backed;
+    present on jax>=0.4.x CPU builds, absent on some minimal installs)."""
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def fp8_dtype() -> jnp.dtype:
+    """``float8_e4m3fn`` as a dtype, or :class:`Fp8UnavailableError`."""
+    if not fp8_supported():
+        raise Fp8UnavailableError(
+            "this jax build has no float8_e4m3fn dtype; --kv-dtype/"
+            "--weight-dtype fp8 need it (int8 and bf16 remain available)")
+    return jnp.dtype(jnp.float8_e4m3fn)
+
+
+def qmax_for(dtype: jnp.dtype) -> float:
+    """Largest representable quantized magnitude for a storage dtype —
+    the one number the anchored-scale formula and the clip share, so
+    every write path derives identical scales per dtype."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.dtype(jnp.int8):
+        return INT8_MAX
+    if fp8_supported() and dtype == jnp.dtype(jnp.float8_e4m3fn):
+        return FP8_MAX
+    raise ValueError(f"no quantized range for dtype {dtype}")
+
+
+def quantize_with_scale(x: jnp.ndarray, scale: jnp.ndarray,
+                        dtype: jnp.dtype = jnp.int8) -> jnp.ndarray:
+    """Symmetric quantization with a caller-supplied (broadcastable)
+    scale. int8: ``clip(round(x / scale), -127, 127)``; fp8: ``clip(x /
+    scale, -448, 448)`` cast (the cast itself rounds to the nearest
+    representable — fp8's mantissa plays the role int8's round() does).
+    The one quantizer every write path shares — bitwise agreement
+    between prefill and decode writes reduces to agreeing on ``scale``.
+    """
+    dtype = jnp.dtype(dtype)
+    qmax = qmax_for(dtype)
+    q = x.astype(jnp.float32) / scale
+    if dtype == jnp.dtype(jnp.int8):
+        q = jnp.round(q)
+    return jnp.clip(q, -qmax, qmax).astype(dtype)
+
+
+def quantize_channelwise(x: jnp.ndarray,
+                         axis: Union[int, Tuple[int, ...]],
+                         dtype: jnp.dtype = jnp.int8,
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-channel symmetric quantization: scale = amax over ``axis`` /
+    qmax(dtype).
+
+    Returns (quantized values in ``dtype``, f32 scale with ``axis`` kept
+    as size-1 dims — broadcastable straight back onto the values). The
+    weight-quant primitive: exact amax, no headroom — so dequantization
+    error is pure rounding, bounded by ``scale/2`` per element for int8
+    and by fp8's 3-bit relative mantissa step for fp8.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / qmax_for(dtype), MIN_SCALE)
+    return quantize_with_scale(x, scale, dtype), scale
 
 
 def quantize_int8(x: jnp.ndarray,
                   axis: Union[int, Tuple[int, ...]],
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Per-channel symmetric int8: scale = amax over ``axis`` / 127.
-
-    Returns (int8 values, f32 scale with ``axis`` kept as size-1 dims —
-    broadcastable straight back onto the values). The weight-quant
-    primitive: exact amax, no headroom.
-    """
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
-    scale = jnp.maximum(amax / INT8_MAX, MIN_SCALE)
-    return quantize_with_scale(x, scale), scale
+    """Per-channel symmetric int8: :func:`quantize_channelwise` at its
+    historical dtype (the PR 11 call sites)."""
+    return quantize_channelwise(x, axis, jnp.int8)
 
 
 def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray,
@@ -85,23 +142,26 @@ def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray,
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
-def token_kv_scale(kv: jnp.ndarray) -> jnp.ndarray:
+def token_kv_scale(kv: jnp.ndarray,
+                   dtype: jnp.dtype = jnp.int8) -> jnp.ndarray:
     """Anchor scale of one token's K or V: [..., Hkv, D] -> f32 [..., Hkv].
 
-    ``amax over D * HEADROOM / 127``, floored — the scale a page adopts
-    when this token lands in its slot 0, and the same formula
+    ``amax over D * HEADROOM / qmax(dtype)``, floored — the scale a page
+    adopts when this token lands in its slot 0, and the same formula
     :func:`quantize_kv_pages` applies to slot 0 of every page, so both
     write paths derive identical scales from identical token values.
     """
     amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
-    return jnp.maximum(amax * KV_SCALE_HEADROOM / INT8_MAX, MIN_SCALE)
+    return jnp.maximum(amax * KV_SCALE_HEADROOM / qmax_for(dtype),
+                       MIN_SCALE)
 
 
-def quantize_kv_pages(pages: jnp.ndarray,
+def quantize_kv_pages(pages: jnp.ndarray, dtype: jnp.dtype = jnp.int8,
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Whole-page anchored quantization: [..., Hkv, bs, D] exact K or V
     (the head-major page layout of ``ops.paged_attention``) ->
-    (int8 pages, f32 scales [..., Hkv]).
+    (quantized pages in ``dtype`` — int8 or fp8 — and f32 scales
+    [..., Hkv]).
 
     The scale comes from slot 0 only (every *allocated* page's slot 0
     holds a real token — allocators hand out ``ceil(length/bs)`` pages,
@@ -109,8 +169,8 @@ def quantize_kv_pages(pages: jnp.ndarray,
     the written length quantize pad garbage with the same scale, exactly
     as decode will overwrite them later.
     """
-    scale = token_kv_scale(pages[..., :, 0, :])  # [..., Hkv]
-    q = quantize_with_scale(pages, scale[..., :, None, None])
+    scale = token_kv_scale(pages[..., :, 0, :], dtype)  # [..., Hkv]
+    q = quantize_with_scale(pages, scale[..., :, None, None], dtype)
     return q, scale
 
 

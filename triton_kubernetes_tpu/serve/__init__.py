@@ -36,11 +36,13 @@ from importlib import import_module
 from ..constants import SERVE_PORT
 from .loadgen import (
     PoissonSchedule,
+    RepetitionSchedule,
     SessionSchedule,
     SharedPrefixSchedule,
     percentile,
 )
 from .router import HashRing, Router, RouterHTTPServer
+from .speculation import draft_ngram, longest_agreeing_prefix
 
 _LAZY = {
     "BlockAllocator": ".blocks",
@@ -72,11 +74,14 @@ __all__ = [
     "OutOfBlocksError",
     "PoissonSchedule",
     "PrefixCache",
+    "RepetitionSchedule",
     "Request",
     "Router",
     "RouterHTTPServer",
     "ServeEngine",
     "SessionSchedule",
     "SharedPrefixSchedule",
+    "draft_ngram",
+    "longest_agreeing_prefix",
     "percentile",
 ]

@@ -30,7 +30,22 @@ One :meth:`ServeEngine.step` is one scheduler tick, vLLM-style:
 4. **decode** — ONE batched ragged decode step for all fully-prefilled
    sequences (always ``max_batch`` wide; inactive and still-prefilling
    slots ride the trash page), then per-sequence sampling, completion
-   checks, page frees.
+   checks, page frees. With ``spec_k > 0`` the step widens into a
+   **speculative verify**: each sequence's n-gram self-drafter
+   (``serve/speculation.py`` — suffix match over its own prompt +
+   generated tokens, no second model) proposes up to ``spec_k`` tokens,
+   ``models.paged.paged_verify_step`` scores all ``spec_k + 1``
+   positions for every sequence in one widened ragged-attention pass
+   (ONE weight read for up to ``spec_k + 1`` tokens — the
+   bandwidth-bound decode's win), greedy acceptance keeps the longest
+   prefix the model's own (seed, position)-keyed samples agree with,
+   and rejected tokens' KV writes are ROLLED BACK byte-exactly
+   (``paged_rewind``) before anything else can observe them. Accepted
+   output is bitwise the non-speculative output (greedy and seeded);
+   ``spec_k=0`` is bitwise this engine without this paragraph.
+   Speculation never writes into prefix-cache pages: generated tokens
+   land past the shared full-prompt pages by construction, so
+   refcounted sharing is untouched.
 
 Prefix sharing is bitwise-invisible in the outputs (pinned in
 tests/test_serve.py): computed windows present the identical trace and
@@ -70,11 +85,14 @@ from ..models.paged import (
     paged_decode_step,
     paged_prefill,
     paged_prefill_chunk,
+    paged_rewind,
+    paged_verify_step,
 )
 from ..ops.paged_attention import TRASH_PAGE, blocks_for
 from ..train.precision import quantize_for_decode
 from ..utils import metrics
 from .blocks import BlockAllocator, OutOfBlocksError, PrefixCache
+from .speculation import draft_ngram, longest_agreeing_prefix
 
 
 class ManualClock:
@@ -152,6 +170,11 @@ class _Sequence:
     # but rides the trash page in decode batches.
     prefilled: int = 0
     target: int = 0
+    # This tick's self-drafted proposal (spec_k > 0): computed during
+    # page growth (so speculative pages are allocated before the verify
+    # runs), consumed and cleared by the verify. Never survives a
+    # preemption — a readmitted sequence re-drafts from its history.
+    draft: List[int] = field(default_factory=list)
 
     @property
     def length(self) -> int:
@@ -181,12 +204,15 @@ class ServeEngine:
         weight_dtype: str = "auto",
         prefill_chunk: Optional[int] = None,
         prefix_cache: bool = False,
+        spec_k: int = 0,
         clock: Callable[[], float] = time.monotonic,
     ):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if kv_dtype not in KV_DTYPES:
             raise ValueError(
                 f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
@@ -215,6 +241,7 @@ class ServeEngine:
                                  config.max_seq_len)
         self.sequential = sequential
         self.prefill_chunk = prefill_chunk
+        self.spec_k = spec_k
         self.clock = clock
         # One table width serves prefill and decode: enough pages for a
         # full-length sequence, prompt width padded up to whole pages —
@@ -279,6 +306,28 @@ class ServeEngine:
             lambda p, tok, pool, bt, lens: paged_decode_step(
                 p, tok, cfg, _cache_like(self.cache, *pool), bt, lens),
             donate_argnums=(2,))
+        if spec_k > 0:
+            # Speculative widened verify + rejected-tail rewind. Traced
+            # once each: the verify width spec_k + 1 is static, draft
+            # raggedness travels as data (pad inputs + the rewind's
+            # keep counts).
+            # tk8s: donate-safe(same pool-ownership contract as
+            # _prefill: device-allocated pool arrays, rebound from the
+            # result each verify)
+            self._verify = jax.jit(
+                lambda p, toks, pool, bt, lens: paged_verify_step(
+                    p, toks, cfg, _cache_like(self.cache, *pool),
+                    bt, lens),
+                donate_argnums=(2,))
+            # tk8s: donate-safe(same pool-ownership contract as
+            # _prefill: the rewound pool arrays come from the verify
+            # jit's result and are rebound to self.cache from this
+            # jit's result — dead on return)
+            self._rewind = jax.jit(
+                lambda pool, undo, bt, lens, keep: paged_rewind(
+                    _cache_like(self.cache, *pool), undo, bt, lens,
+                    keep),
+                donate_argnums=(0,))
 
     # ------------------------------------------------------------ intake
     def validate_request(self, request: Request) -> None:
@@ -334,7 +383,10 @@ class ServeEngine:
         self._ensure_growth_pages()
         if any(s is not None and s.prefilled >= s.target
                for s in self.slots):
-            self._decode_once(finished)
+            if self.spec_k > 0:
+                self._spec_decode_once(finished)
+            else:
+                self._decode_once(finished)
         self._steps += 1
         self._update_gauges()
         return finished
@@ -540,6 +592,18 @@ class ServeEngine:
                     self._preempt(victim)
                     if victim == i:
                         break  # preempted ourselves; re-admit later
+        if self.spec_k > 0:
+            # Speculative allocation runs as a SECOND pass, only after
+            # every sequence's mandatory next-token page landed above:
+            # interleaving it with base growth would let an early
+            # sequence's draft pages starve a later sequence's
+            # mandatory page and force an eviction/preemption the
+            # spec_k=0 engine would never make.
+            for i in sorted(range(self.max_batch),
+                            key=lambda i: (self.slots[i].admit_seq
+                                           if self.slots[i] else -1)):
+                if self.slots[i] is not None:
+                    self._draft_and_grow(self.slots[i])
 
     def _preempt(self, slot: int) -> None:
         seq = self.slots[slot]
@@ -549,9 +613,39 @@ class ServeEngine:
         seq.admit_seq = -1
         seq.preemptions += 1
         seq.prefilled = seq.target = 0
+        seq.draft = []
         self.slots[slot] = None
         self.waiting.appendleft(seq)
         metrics.counter("tk8s_serve_preemptions_total").inc()
+
+    def _draft_and_grow(self, seq: _Sequence) -> None:
+        """Self-draft this tick's proposal and allocate the pages its
+        speculative writes need. Speculative pages are OPPORTUNISTIC:
+        under pool pressure the draft trims itself instead of evicting
+        prefix-cache pages or preempting a neighbor — speculation may
+        only ever spend memory nobody else wants this tick, so every
+        preemption/eviction decision is identical to the spec_k=0
+        engine's."""
+        seq.draft = []
+        if seq.prefilled < seq.target or not seq.generated:
+            return  # still prefilling: nothing to speculate from
+        r = seq.request
+        # Cap so accepted-draft + bonus can never exceed max_new_tokens
+        # (which also keeps every written position inside the
+        # validated prompt+max_new window).
+        cap = min(self.spec_k, r.max_new_tokens - len(seq.generated) - 1)
+        if cap <= 0:
+            return
+        draft = draft_ngram(list(r.tokens) + list(seq.generated), cap)
+        while draft:
+            need = (blocks_for(seq.length + len(draft) + 1,
+                               self.block_size) - len(seq.pages))
+            if need <= self.allocator.available:
+                if need > 0:
+                    seq.pages.extend(self.allocator.alloc(need))
+                break
+            draft.pop()
+        seq.draft = draft
 
     # ------------------------------------------------------------ decode
     def _decode_once(self, finished: List[FinishedRequest]) -> None:
@@ -582,13 +676,139 @@ class ServeEngine:
         metrics.counter("tk8s_serve_tokens_total").inc(
             decoded, kind="decode")
 
+    def _spec_decode_once(self, finished: List[FinishedRequest]) -> None:
+        """The widened decode tick: verify every sequence's self-draft
+        at ``spec_k + 1`` positions in one pass, keep the longest
+        model-agreeing prefix, roll rejected KV writes back, emit
+        accepted tokens + the model's own next token.
+
+        Exactness over cleverness: every sampled position uses the same
+        (seed, position)-keyed draw `_sample_at` always used, so the
+        emitted stream is bitwise the non-speculative engine's — a
+        rejected draft costs one wasted verify row, never a changed
+        token.
+        """
+        active = [i for i, s in enumerate(self.slots)
+                  if s is not None and s.prefilled >= s.target]
+        if not any(self.slots[i].draft for i in active):
+            # Nothing drafted (non-repetitive text, caps, pool
+            # pressure): the plain step emits the identical token for
+            # one weight pass less.
+            self._decode_once(finished)
+            return
+        s_width = self.spec_k + 1
+        tokens = [[0] * s_width for _ in range(self.max_batch)]
+        lengths = [0] * self.max_batch
+        tables = [[TRASH_PAGE] * self.blocks_per_seq
+                  for _ in range(self.max_batch)]
+        for i in active:
+            seq = self.slots[i]
+            tokens[i][0] = seq.generated[-1]
+            for j, d in enumerate(seq.draft):
+                tokens[i][j + 1] = d
+            lengths[i] = seq.length
+            tables[i][:len(seq.pages)] = seq.pages
+        bt = jnp.asarray(tables, jnp.int32)
+        lens = jnp.asarray(lengths, jnp.int32)
+        logits, cache, undo = self._verify(
+            self.params, jnp.asarray(tokens, jnp.int32), self._pool(),
+            bt, lens)
+        self.cache = cache
+        # Greedy rows take one batched argmax (bitwise the per-row
+        # argmax `sample_token` computes at temperature 0); sampled
+        # rows draw per position with their own keys below.
+        greedy = None
+        if any(self.slots[i].request.temperature == 0.0 for i in active):
+            greedy = jnp.argmax(logits, axis=-1).tolist()
+        proposed = accepted = emitted = 0
+        keep = [s_width] * self.max_batch
+        plans: Dict[int, List[int]] = {}
+        for i in active:
+            seq = self.slots[i]
+            nd = len(seq.draft)
+            g0 = len(seq.generated)
+            samples: List[int] = []
+            for j in range(nd + 1):
+                if seq.request.temperature == 0.0:
+                    tok = int(greedy[i][j])
+                else:
+                    tok = self._sample_at(seq, logits[i, j][None], g0 + j)
+                samples.append(tok)
+                if j >= nd or tok != seq.draft[j]:
+                    break  # bonus row sampled, or first disagreement
+            a = longest_agreeing_prefix(seq.draft, samples)
+            # Accepted drafts ARE samples[:a]; samples[a] is the
+            # model's own next token either way — ≥1 emitted per
+            # verify, so speculation never stalls a sequence. The plan
+            # then truncates at eos / max_new BEFORE keep and the
+            # accept accounting: a draft token past the sequence's end
+            # is never emitted, so its K/V must be rewound and it must
+            # not inflate the accept-rate families.
+            emit = samples[:a + 1]
+            cut = len(emit)
+            for j, tok in enumerate(emit):
+                if (seq.request.eos_id is not None
+                        and tok == seq.request.eos_id) \
+                        or g0 + j + 1 >= seq.request.max_new_tokens:
+                    cut = j + 1
+                    break
+            plans[i] = emit[:cut]
+            keep[i] = cut
+            proposed += nd
+            accepted += min(a, cut)
+        if any(keep[i] < s_width for i in active):
+            # Roll back every rejected (and pad) write BEFORE any page
+            # can be freed or re-handed: after this the pool is
+            # byte-identical to a never-speculated engine's.
+            self.cache = self._rewind(
+                self._pool(), undo, bt, lens,
+                jnp.asarray(keep, jnp.int32))
+        for i in active:
+            seq = self.slots[i]
+            seq.draft = []
+            # plans[i] is already truncated at eos/max_new above.
+            seq.generated.extend(plans[i])
+            emitted += len(plans[i])
+            if not self._maybe_finish(i, finished):
+                # Return rejected-draft surplus pages NOW: a spec_k=0
+                # engine that emitted these same tokens would end the
+                # tick holding exactly blocks_for(length) pages, and
+                # the allocator-state parity (admission/eviction/
+                # preemption timing) holds only if we do too. The
+                # rewind above already restored the surplus pages'
+                # bytes, and tail pages are exclusively owned, so
+                # freeing them cannot strand a neighbor's reference.
+                surplus = (len(seq.pages)
+                           - blocks_for(seq.length, self.block_size))
+                if surplus > 0:
+                    self.allocator.free(seq.pages[-surplus:])
+                    del seq.pages[-surplus:]
+        metrics.counter("tk8s_serve_tokens_total").inc(
+            emitted, kind="decode")
+        metrics.counter(
+            "tk8s_serve_spec_proposed_tokens_total").inc(proposed)
+        metrics.counter(
+            "tk8s_serve_spec_accepted_tokens_total").inc(accepted)
+        if proposed:
+            metrics.histogram("tk8s_serve_spec_accept_rate").observe(
+                accepted / proposed)
+        metrics.gauge("tk8s_serve_spec_tokens_per_step").set(
+            emitted / len(active))
+
     def _sample(self, seq: _Sequence, logits: jnp.ndarray) -> int:
-        """Sample position len(generated) of this request — keyed by the
-        request's own seed and position so the draw is independent of
-        batch composition and survives preemption/re-prefill."""
+        """Sample position len(generated) of this request — see
+        :meth:`_sample_at`."""
+        return self._sample_at(seq, logits, len(seq.generated))
+
+    def _sample_at(self, seq: _Sequence, logits: jnp.ndarray,
+                   position: int) -> int:
+        """Sample one position of this request — keyed by the request's
+        own seed and the position so the draw is independent of batch
+        composition, survives preemption/re-prefill, and is the SAME
+        draw whether the position is reached by plain decode or inside
+        a speculative verify (the acceptance-exactness contract)."""
         r = seq.request
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(r.seed), len(seq.generated))
+        key = jax.random.fold_in(jax.random.PRNGKey(r.seed), position)
         return int(sample_token(
             logits, key, r.temperature, r.top_k, r.top_p)[0])
 
@@ -651,6 +871,7 @@ class ServeEngine:
             "weight_dtype": self.weight_dtype,
             "kv_pool_bytes": self.cache.pool_bytes + self.cache.scale_bytes,
             "prefill_chunk": self.prefill_chunk,
+            "spec_k": self.spec_k,
             "prefix_cache": self.prefix is not None,
             "prefix_cache_pages": (self.prefix.pages
                                    if self.prefix is not None else 0),

@@ -19,7 +19,13 @@ Three trace shapes, one per serving claim:
   ``session_id`` and growing prompts (turn N's prompt extends turn
   N-1's), which is what makes router affinity *measurable*: a
   session-affine fleet serves every turn from the replica whose prefix
-  cache already holds the session.
+  cache already holds the session;
+* :class:`RepetitionSchedule` — prompts that are a short seeded motif
+  tiled many times, the self-similar text the n-gram self-drafter
+  (``serve/speculation.py``) is built for: long generations over such
+  prompts settle into repeating continuations, so speculative decode's
+  accept rate — and its tokens-per-weight-pass win — becomes
+  measurable (scripts/ci/spec_decode_evidence.py's throughput arm).
 
 Dependency-free (``random.Random``, like cloudsim's fault plans): no
 numpy on the provisioning-CLI side of the package.
@@ -109,6 +115,47 @@ class SharedPrefixSchedule:
             self.requests.append(TimedRequest(
                 at=t, request_id=f"req-{i}",
                 tokens=list(self.prefixes[k]) + suffix,
+                max_new_tokens=max_new_tokens))
+
+    def __iter__(self):
+        return iter(self.requests)
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+
+class RepetitionSchedule:
+    """Seeded Poisson arrivals of repetition-heavy prompts: each request
+    draws a short motif of ``motif_len_range`` tokens and tiles it to
+    ``prompt_len`` (cut mid-motif where it does not divide evenly).
+
+    The speculative-decode trace: code, templated prose, and chat
+    boilerplate are self-similar, and greedy continuations of
+    self-similar context settle into cycles the prompt-lookup drafter
+    proposes at high accept rates. ``max_new_tokens`` defaults long
+    relative to the other traces because the win compounds over the
+    decode tail, which is exactly what the A/B measures.
+    """
+
+    def __init__(self, *, rate: float, n: int, vocab_size: int,
+                 prompt_len: int = 48,
+                 motif_len_range: Sequence[int] = (3, 6),
+                 max_new_tokens: int = 32, seed: int = 0):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0 req/s, got {rate}")
+        if prompt_len < 1:
+            raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+        rng = random.Random(seed)
+        lo, hi = motif_len_range
+        t = 0.0
+        self.requests: List[TimedRequest] = []
+        for i in range(n):
+            t += rng.expovariate(rate)
+            motif = [rng.randrange(vocab_size)
+                     for _ in range(rng.randint(lo, hi))]
+            tokens = (motif * (prompt_len // len(motif) + 1))[:prompt_len]
+            self.requests.append(TimedRequest(
+                at=t, request_id=f"req-{i}", tokens=tokens,
                 max_new_tokens=max_new_tokens))
 
     def __iter__(self):

@@ -1,0 +1,117 @@
+"""Draft-free speculative decoding: the n-gram self-drafter and the
+acceptance rule.
+
+Decode is memory-bandwidth-bound: every step re-reads the whole KV pool
+and the (possibly int8/fp8) weights to emit ONE token per sequence.
+Speculation buys that bandwidth back by verifying several *proposed*
+tokens per weight pass — ``models.paged.paged_verify_step`` scores
+``spec_k + 1`` positions in one widened call, and the engine keeps the
+longest prefix the model itself agrees with.
+
+This module is the host-side half, kept as PURE FUNCTIONS (no engine
+state, no jax) so the properties the whole scheme leans on are directly
+testable (tests/test_speculation.py):
+
+* :func:`draft_ngram` — prompt-lookup self-drafting: the proposal is
+  the continuation of the most recent earlier occurrence of the
+  sequence's own current suffix (its prompt + generated tokens). No
+  second model, no extra weights, no new numerics — every proposed
+  token is literally a token from the sequence's own history, which is
+  also why a draft can never propose an out-of-vocab id. Repetitive
+  text (code, templated prose, greedy decode loops) drafts at high
+  accept rates; on text with no self-similarity it proposes nothing
+  and the engine degrades to plain decode for that step.
+* :func:`longest_agreeing_prefix` — greedy acceptance: keep draft
+  tokens while the model's own (seed, position)-keyed sample at each
+  position equals the draft, stop at the first disagreement. Because
+  acceptance re-samples every position with the SAME keyed sampler the
+  non-speculative engine uses, accepted output is *bitwise* the
+  non-speculative output — for greedy AND for seeded sampling — not an
+  approximation of it.
+
+Determinism contract (the churn-test axis): both functions are pure
+and depend only on their arguments, so a given request history always
+drafts identically, whatever the batch around it is doing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+# Longest suffix the drafter tries to match before shorter ones. Longer
+# matches are rarer but much more specific (fewer false continuations);
+# 3 is the prompt-lookup literature's usual sweet spot and what the
+# spec_decode_evidence A/B measured best on the repetition-heavy trace.
+MAX_NGRAM = 3
+# Shortest suffix worth matching: 1-token matches fire constantly on
+# common tokens and mispredict, so the floor is their cutoff.
+MIN_NGRAM = 1
+# How far back the suffix search looks. The drafter runs on the
+# scheduler thread once per decoding sequence per tick, and its WORST
+# case is exactly the traffic where it finds nothing (non-self-similar
+# text scans everything, every tick) — so the scan is bounded: with
+# 32k-token prompts (what chunked prefill exists for) an unbounded
+# match would put ~max_batch * 32k Python comparisons on every tick's
+# host path while producing zero drafts. Recency also correlates with
+# relevance: the continuation of a *recent* repeat predicts better
+# than one 30k tokens ago.
+MAX_SCAN = 2048
+
+
+def draft_ngram(history: Sequence[int], k: int, *,
+                max_ngram: int = MAX_NGRAM,
+                min_ngram: int = MIN_NGRAM,
+                max_scan: int = MAX_SCAN) -> List[int]:
+    """Propose up to ``k`` next tokens by suffix match over the last
+    ``max_scan`` tokens of ``history`` (the sequence's own prompt +
+    generated tokens).
+
+    Longest-match-first: for ``n`` from ``max_ngram`` down to
+    ``min_ngram``, find the MOST RECENT earlier occurrence of the
+    final ``n`` tokens and propose the tokens that followed it.
+    Returns ``[]`` when nothing matches (or ``k <= 0``) — the engine
+    then runs that step as plain decode.
+
+    Pure and deterministic: same history, same proposal, independent
+    of batch composition (the solo-run parity contract). Proposals are
+    copies of history slices, so they cannot contain an id the
+    validated request did not already carry.
+    """
+    if k <= 0:
+        return []
+    h = list(history)[-max_scan:]
+    n_hist = len(h)
+    for n in range(min(max_ngram, n_hist - 1), min_ngram - 1, -1):
+        suffix = h[n_hist - n:]
+        # Most recent occurrence whose continuation exists (ends
+        # strictly before the history's end). Compare elementwise
+        # first-token-out so the common miss costs one comparison, not
+        # an n-length slice allocation per candidate position.
+        first = suffix[0]
+        for p in range(n_hist - n - 1, -1, -1):
+            if h[p] == first and h[p:p + n] == suffix:
+                return h[p + n:p + n + k]
+    return []
+
+
+def longest_agreeing_prefix(draft: Sequence[int],
+                            sampled: Sequence[int]) -> int:
+    """Number of leading draft tokens the model agreed with: the count
+    of positions ``j`` (from 0) where ``sampled[j] == draft[j]`` before
+    the first mismatch.
+
+    ``sampled[j]`` is the model's own token for that position, drawn
+    from the verify logits with the request's (seed, position) key —
+    so "agrees" means "the non-speculative engine would have emitted
+    exactly this", which is what makes acceptance exact rather than
+    approximate. The engine emits the accepted prefix plus
+    ``sampled[a]`` (the first disagreeing — or bonus — model token):
+    every verify therefore nets at least one token, so speculation can
+    slow a step down but never stall one.
+    """
+    a = 0
+    for d, s in zip(draft, sampled):
+        if d != s:
+            break
+        a += 1
+    return a

@@ -131,9 +131,11 @@ def quantize_for_decode(params: Any, config: ModelConfig,
 
     The quantization twin of :func:`apply_policy`, with the same
     cannot-be-half-applied shape: "auto" is the identity on BOTH params
-    and config, "int8" rewrites both together via
-    ``models.llama.quantize_weights`` (per-channel symmetric int8 for
-    the big matmuls; the caller's f32 master tree is untouched).
+    and config, "int8"/"fp8" rewrite both together via
+    ``models.llama.quantize_weights`` (per-channel symmetric int8 or
+    float8_e4m3fn for the big matmuls; the caller's f32 master tree is
+    untouched). "fp8" raises ``Fp8UnavailableError`` where this jax
+    build lacks the dtype — loud and typed, never a silent fallback.
     """
     if weight_dtype not in WEIGHT_DTYPES:
         raise KeyError(
@@ -143,7 +145,7 @@ def quantize_for_decode(params: Any, config: ModelConfig,
         return params, config
     from ..models.llama import quantize_weights
 
-    return quantize_weights(params, config)
+    return quantize_weights(params, config, weight_dtype)
 
 
 def grads_all_finite(grads: Any) -> jnp.ndarray:

@@ -216,6 +216,22 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "gauge", "KV pages currently indexed by the radix prefix cache "
         "(each holds one cache-owned reference; evicted LRU-leaf-first "
         "under pool pressure)", (), None),
+    "tk8s_serve_spec_proposed_tokens_total": (
+        "counter", "Draft tokens proposed by the n-gram self-drafter "
+        "and scored by the widened verify step (spec_k > 0)", (), None),
+    "tk8s_serve_spec_accepted_tokens_total": (
+        "counter", "Proposed draft tokens the model's own keyed samples "
+        "agreed with (accepted/proposed = the effective accept rate; "
+        "rejected tokens' KV writes are rolled back)", (), None),
+    "tk8s_serve_spec_accept_rate": (
+        "histogram", "Per-verify-step draft acceptance rate "
+        "(accepted/proposed over the step's batch); high on "
+        "self-similar text, ~0 where speculation is wasted",
+        (), (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)),
+    "tk8s_serve_spec_tokens_per_step": (
+        "gauge", "Tokens emitted per decoding sequence by the most "
+        "recent verify step (1.0 = plain-decode pace, up to spec_k + 1 "
+        "when every draft accepts)", (), None),
     # --------------------------------------------- serve/router.py
     "tk8s_route_requests_total": (
         "counter", "Requests the router placed, by replica and routing "
