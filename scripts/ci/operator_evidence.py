@@ -1,0 +1,496 @@
+#!/usr/bin/env python
+"""Produce the operator evidence artifact
+(docs/ci-evidence/operator-<tag>.json): the ISSUE 14 acceptance gates,
+measured against live serving traffic.
+
+**Phase A — the diurnal autoscaling A/B/C.** One seeded
+:class:`DiurnalSchedule` (a raised-cosine day curve with Poisson
+bursts, compressed to ``DAY_WALL`` wall seconds of simulated day) is
+replayed open-loop against a fleet of REAL ServeEngine replicas three
+times:
+
+* **static-small** — trough-provisioned (1 pool), fixed. Must MISS the
+  TTFT p99 SLO: sustained peak overload queues requests without bound,
+  which is the whole case for autoscaling.
+* **static-peak** — peak-provisioned (``MAX_POOLS``), fixed. Meets the
+  SLO but pays ``MAX_POOLS`` simulated chip-hours all day.
+* **autoscaled** — the real reconcile operator closing the loop: a
+  cloudsim-backed TPU cluster document (template pool + clones), the
+  real wavefront apply, and the autoscaler scraping the fleet's
+  aggregated /metrics text through the Prometheus parser each tick.
+  Replica count tracks the *applied* pool modules — a scale decision
+  only adds capacity once the pool module actually converged. Gates:
+  meets the SLO static-small misses, spends >= 25% fewer simulated
+  chip-hours than static-peak, and every decision is journaled.
+
+Replicas are real engines on real wall-clock TTFT; pool counts map to
+active replicas (one single-host slice pool = one replica — the
+serving.md topology). Engines are built and jit-warmed before the
+clock starts, so the measured window sees scheduling, not compilation.
+Chip-hours integrate desired pools over the simulated day
+(``pools x sim-hours x CHIPS_PER_POOL``).
+
+Each replica thread enforces a deterministic **per-step device-time
+floor** (``STEP_FLOOR``), the serving analog of PR 8's
+``--device-ms-per-row``: on a 2-vCPU CI box, concurrently-stepping
+CPU engines share FMA ports, so raw compute makes capacity go DOWN
+with replica count — a grow would worsen TTFT, the autoscaler would
+grow again, and the A/B would measure a death spiral instead of
+scale-out (measured here before the floor existed). With the floor,
+each step sleeps to a fixed device budget (sleeps release the GIL),
+so N replicas give N x service rate exactly like the hardware each
+thread stands in for, while TTFT still rides real engine scheduling.
+Dispatch keeps the backlog in a FLEET-level queue and feeds each
+replica only to a shallow watermark — new capacity starts draining
+the backlog the tick it lands (what the PR 12 router's least-loaded
+spill does), instead of the backlog staying pinned to the replica
+that queued it.
+
+**Phase B — preempt-mid-reconcile chaos arm.** The pinned corpus
+scenario (tests/chaos_corpus/operator-preempt-mid-reconcile.json)
+replayed through the chaos runner: a slice preempted between a
+reconcile tick's observe and act phases must converge within
+``at_tick + 3`` ticks, repaired exactly once, zero orphaned resources.
+
+Latency figures vary run to run; the trace, the scale-decision causes,
+and the chaos verdict are deterministic.
+
+Usage: python scripts/ci/operator_evidence.py [tag]   (default: local)
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from triton_kubernetes_tpu.backends import MemoryBackend  # noqa: E402
+from triton_kubernetes_tpu.executor import LocalExecutor  # noqa: E402
+from triton_kubernetes_tpu.executor.dagspec import (  # noqa: E402
+    document_from_spec,
+)
+from triton_kubernetes_tpu.models import get_config, init_params  # noqa: E402
+from triton_kubernetes_tpu.operator import (  # noqa: E402
+    Autoscaler,
+    AutoscalerConfig,
+    Reconciler,
+    tpu_pool_modules,
+)
+from triton_kubernetes_tpu.serve import (  # noqa: E402
+    DiurnalSchedule,
+    Request,
+    ServeEngine,
+    percentile,
+)
+from triton_kubernetes_tpu.utils import metrics  # noqa: E402
+from triton_kubernetes_tpu.utils.logging import Logger  # noqa: E402
+
+# ---- trace shape: one compressed "day" per arm ------------------------
+DAY_WALL = 45.0        # wall seconds of one simulated 24 h day
+BASE_RATE = 2.0        # req/s at the overnight trough
+PEAK_RATE = 16.0       # req/s at the afternoon peak
+PEAK_AT = 0.55
+NUM_BURSTS = 2
+BURST_MULT = 1.5
+MAX_NEW = 8
+PROMPT_LEN = (4, 24)
+SEED = 1234
+
+# ---- fleet shape ------------------------------------------------------
+MAX_POOLS = 3          # static-peak provisioning = autoscaler ceiling
+CHIPS_PER_POOL = 16    # v5e-16 single-host slice per serving replica
+MAX_BATCH = 4
+STEP_FLOOR = 0.04      # deterministic device seconds per engine step:
+                       # ~11 req/s service rate per replica at MAX_NEW=8
+                       # (1 replica drowns at the 16 req/s peak, 3 absorb
+                       # the 24 req/s burst) — see module docstring
+SLOT_WATERMARK = 2 * MAX_BATCH   # per-replica feed depth; the rest
+                                 # waits in the fleet queue
+TICK_WALL = 1.0        # operator reconcile interval (wall s)
+
+# ---- gates ------------------------------------------------------------
+TTFT_SLO_P99 = 2.0     # the SLO the operator defends (wall seconds)
+GATE_CHIP_SAVINGS = 0.25   # autoscaled <= (1 - this) x static-peak
+CHAOS_TICK_BOUND = 4       # at_tick + 3
+
+
+class ReplicaSlot:
+    """One serving replica: a real engine owned by one thread, fed
+    through an inbox (the engine's single-caller contract), stepping
+    against the deterministic STEP_FLOOR device budget."""
+
+    def __init__(self, idx, params, cfg):
+        self.idx = idx
+        self.engine = ServeEngine(
+            params, cfg, block_size=16, num_blocks=160,
+            max_batch=MAX_BATCH, max_model_len=64)
+        self.inbox = deque()
+        self.lock = threading.Lock()
+        self.load = 0          # fed-to-engine - finished
+        self.results = {}      # rid -> arrival-to-first-token seconds
+        self.running = True
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=f"replica-{idx}")
+
+    def warm(self):
+        self.engine.submit(Request(f"warm-{self.idx}", [1, 2, 3], 2))
+        self.engine.run_until_idle()
+
+    def submit(self, tr, arrival_mono):
+        with self.lock:
+            self.inbox.append((tr, arrival_mono))
+            self.load += 1
+
+    def _run(self):
+        meta = {}
+        while self.running:
+            with self.lock:
+                batch, self.inbox = list(self.inbox), deque()
+            for tr, arrival in batch:
+                meta[tr.request_id] = (arrival, time.monotonic())
+                self.engine.submit(Request(
+                    tr.request_id, list(tr.tokens), tr.max_new_tokens))
+            if self.engine.has_work:
+                t0 = time.monotonic()
+                for done in self.engine.step():
+                    arrival, submitted = meta.pop(done.request_id)
+                    # Arrival-to-first-token: fleet-queue wait + engine
+                    # queue wait + prefill (the TTFT a CLIENT sees).
+                    ttft = (submitted - arrival) + done.ttft
+                    with self.lock:
+                        self.results[done.request_id] = ttft
+                        self.load -= 1
+                # The device-time floor (module docstring): sleeping
+                # releases the GIL, so replicas scale instead of
+                # fighting over this box's FP ports.
+                time.sleep(max(0.0, STEP_FLOOR - (time.monotonic() - t0)))
+            else:
+                time.sleep(0.001)
+
+    def start(self):
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self.running = False
+        self.thread.join(timeout=10)
+
+
+class Fleet:
+    """Fleet-level queue + dispatch + aggregated /metrics for N
+    replicas, ``active`` of which take new traffic (the pool-count
+    actuator)."""
+
+    def __init__(self, params, cfg, n):
+        self.slots = [ReplicaSlot(i, params, cfg) for i in range(n)]
+        self.active = 1
+        self.queue = deque()   # (tr, arrival_mono) waiting for capacity
+        # The fleet aggregator's own registry IS the scrape source: a
+        # fleet-wide TTFT histogram (observed from real finished
+        # requests) and a queued-behind-capacity gauge — what a
+        # metrics proxy over per-replica /metrics would expose.
+        self.registry = metrics.MetricsRegistry()
+        self._ttft = self.registry.histogram("tk8s_serve_ttft_seconds")
+        self._queue_g = self.registry.gauge("tk8s_serve_queue_depth")
+        self._seen = set()
+        self._harvest_lock = threading.Lock()
+
+    def start(self):
+        for s in self.slots:
+            s.warm()
+            s.start()
+        return self
+
+    def stop(self):
+        for s in self.slots:
+            s.stop()
+
+    def dispatch(self, tr):
+        self.queue.append((tr, time.monotonic()))
+        self.pump()
+
+    def pump(self):
+        """Feed queued requests to active replicas up to the shallow
+        per-slot watermark — the backlog stays fleet-owned, so a
+        replica activated mid-burst starts draining it immediately."""
+        while self.queue:
+            candidates = [s for s in self.slots[:self.active]
+                          if s.load < SLOT_WATERMARK]
+            if not candidates:
+                return
+            slot = min(candidates, key=lambda s: s.load)
+            tr, arrival = self.queue.popleft()
+            slot.submit(tr, arrival)
+
+    def drain(self):
+        while self.queue or any(s.load > 0 for s in self.slots):
+            self.pump()
+            self.harvest()
+            time.sleep(0.01)
+        self.harvest()
+
+    def harvest(self):
+        # Runs from both the dispatch/drain thread and the operator
+        # tick thread (via scrape): serialize the _seen check-then-
+        # observe, or a finished request double-counts into the TTFT
+        # histogram the autoscaler windows.
+        with self._harvest_lock:
+            for s in self.slots:
+                with s.lock:
+                    fresh = {rid: v for rid, v in s.results.items()
+                             if rid not in self._seen}
+                self._seen.update(fresh)
+                for rid, ttft in fresh.items():
+                    if not rid.startswith("warm-"):
+                        self._ttft.observe(ttft)
+
+    def scrape(self) -> str:
+        self.harvest()
+        waiting = len(self.queue) + sum(
+            max(0, s.load - MAX_BATCH) for s in self.slots[:self.active])
+        self._queue_g.set(waiting)
+        return self.registry.render_prometheus()
+
+    def results(self):
+        out = {}
+        for s in self.slots:
+            out.update(s.results)
+        for i in range(len(self.slots)):
+            out.pop(f"warm-{i}", None)
+        return out
+
+
+def make_operator_world(name):
+    topo = {"manager": {"provider": "bare-metal", "name": "m1"},
+            "clusters": [{"provider": "gcp-tpu", "name": "ml",
+                          "pools": [{"name": "pool0",
+                                     "accelerator": "v5e-16"}]}]}
+    doc = document_from_spec(topo, name)
+    backend = MemoryBackend()
+    backend.persist(doc)
+    import io
+
+    ex = LocalExecutor(log=lambda m: None,
+                       logger=Logger(stream=io.StringIO()))
+    return backend, ex
+
+
+def run_arm(label, fleet, schedule, reconciler=None, journal_path=None):
+    """Replay the trace open-loop; the operator (when present) ticks
+    every TICK_WALL on its OWN thread, the way `tk8s operate` is its
+    own process: a grow's multi-second cloudsim apply must not stall
+    dispatch (inline ticking froze the arrival loop for the whole
+    apply, charging the operator's actuation latency to every request
+    that arrived during it — a harness artifact, not a serving cost).
+    Returns (summary, pool_segments)."""
+    for s in fleet.slots:
+        s.results.clear()
+    fleet._seen.clear()
+    pending = sorted(schedule, key=lambda r: r.at)
+    segments = []   # (wall_t, pools) step function
+    t0 = time.perf_counter()
+    segments.append((0.0, fleet.active))
+    pool_box = {"pools": fleet.active}
+    stop = threading.Event()
+    op_thread = None
+    if reconciler is not None:
+        def _operate():
+            while not stop.is_set():
+                reconciler.tick()
+                pools = len(tpu_pool_modules(
+                    reconciler._load_doc()).get("ml", []))
+                pool_box["pools"] = max(1, min(pools, len(fleet.slots)))
+                stop.wait(TICK_WALL)
+
+        op_thread = threading.Thread(target=_operate, daemon=True)
+        op_thread.start()
+    i = 0
+    while i < len(pending):
+        now = time.perf_counter() - t0
+        # The dispatch loop is the sole writer of fleet.active; the
+        # operator thread only publishes its desired count.
+        if pool_box["pools"] != fleet.active:
+            fleet.active = pool_box["pools"]
+            segments.append((now, fleet.active))
+        fleet.pump()
+        if pending[i].at <= now:
+            fleet.dispatch(pending[i])
+            i += 1
+        else:
+            time.sleep(min(0.002, pending[i].at - now))
+    fleet.drain()
+    if op_thread is not None:
+        stop.set()
+        op_thread.join()
+    wall = time.perf_counter() - t0
+    segments.append((wall, fleet.active))
+    results = fleet.results()
+    ttfts = list(results.values())
+    summary = {
+        "arm": label,
+        "requests": len(results),
+        "wall_seconds": round(wall, 2),
+        "ttft_p50_s": round(percentile(ttfts, 50), 4),
+        "ttft_p99_s": round(percentile(ttfts, 99), 4),
+        "chip_hours": round(chip_hours(segments, wall), 2),
+        "pool_timeline": [(round(t, 2), p) for t, p in segments],
+    }
+    return summary
+
+
+def chip_hours(segments, wall):
+    """∫ pools dt in simulated day time x chips per pool: DAY_WALL wall
+    seconds = 24 simulated hours."""
+    total = 0.0
+    for (t, p), (t2, _) in zip(segments, segments[1:]):
+        total += p * (t2 - t)
+    # Everything past the schedule end still bills the final width.
+    sim_hours_per_wall_s = 24.0 / DAY_WALL
+    return total * sim_hours_per_wall_s * CHIPS_PER_POOL
+
+
+def phase_diurnal():
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    schedule = DiurnalSchedule(
+        base_rate=BASE_RATE, peak_rate=PEAK_RATE, day_seconds=DAY_WALL,
+        peak_at=PEAK_AT, vocab_size=cfg.vocab_size,
+        prompt_len_range=PROMPT_LEN, max_new_tokens=MAX_NEW,
+        num_bursts=NUM_BURSTS, burst_mult=BURST_MULT, seed=SEED)
+    print(f"[diurnal] {len(schedule)} requests over {DAY_WALL}s "
+          f"(trough {BASE_RATE} -> peak {PEAK_RATE} req/s, "
+          f"{NUM_BURSTS} bursts)", flush=True)
+    fleet = Fleet(params, cfg, MAX_POOLS).start()
+    arms = {}
+    try:
+        # static-peak first (every replica already warm), then small,
+        # then autoscaled — order is irrelevant to the gates.
+        fleet.active = MAX_POOLS
+        arms["static_peak"] = run_arm("static-peak", fleet, schedule)
+        print(f"[static-peak] {arms['static_peak']}", flush=True)
+
+        fleet.active = 1
+        arms["static_small"] = run_arm("static-small", fleet, schedule)
+        print(f"[static-small] {arms['static_small']}", flush=True)
+
+        backend, ex = make_operator_world("operator-evidence")
+        # Defend at a QUARTER of the gated SLO with one-tick
+        # hysteresis: the p99 gate is over the whole day, so the loop
+        # must grow before a backlog forms, not once the SLO is
+        # already lost — an operator that reacts at the SLO boundary
+        # has spent its error budget reacting.
+        autoscaler = Autoscaler(AutoscalerConfig(
+            ttft_slo_p99_s=TTFT_SLO_P99 * 0.25,
+            queue_high=MAX_BATCH, queue_low=1.0,
+            min_pools=1, max_pools=MAX_POOLS,
+            scale_up_after=1, scale_down_after=8,
+            cooldown_s=2.5 * TICK_WALL))
+        reconciler = Reconciler(
+            backend, ex, "operator-evidence",
+            autoscaler=autoscaler, autoscale_cluster="ml",
+            metrics_sources=[fleet.scrape],
+            clock=time.monotonic, sleep=time.sleep,
+            log=lambda m: print(f"  [operator] {m}", flush=True))
+        reconciler.tick()   # converge the template pool pre-trace
+        fleet.active = 1
+        arms["autoscaled"] = run_arm("autoscaled", fleet, schedule,
+                                     reconciler=reconciler)
+        decisions = [t.decision for t in reconciler.journal if t.decision]
+        arms["autoscaled"]["reconcile_ticks"] = len(reconciler.journal)
+        arms["autoscaled"]["scale_decisions"] = {
+            d: sum(1 for x in decisions if x["direction"] == d)
+            for d in ("grow", "drain", "hold")}
+        arms["autoscaled"]["journal_tail"] = [
+            t.to_dict() for t in reconciler.journal[-8:]]
+        print(f"[autoscaled] {dict((k, v) for k, v in arms['autoscaled'].items() if k != 'journal_tail')}",
+              flush=True)
+    finally:
+        fleet.stop()
+    return arms
+
+
+def phase_chaos():
+    """Replay the pinned preempt-mid-reconcile corpus entry through the
+    chaos runner (jax-free)."""
+    from triton_kubernetes_tpu.chaos.corpus import load_entries
+    from triton_kubernetes_tpu.chaos.runner import run_scenario
+
+    corpus_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              os.pardir, os.pardir, "tests", "chaos_corpus")
+    entry = next(e for _, e in load_entries(corpus_dir)
+                 if e["name"] == "operator-preempt-mid-reconcile")
+    res = run_scenario(entry["spec"], ns="operator-evidence-chaos")
+    return {
+        "scenario": entry["name"],
+        "checked": res.checked,
+        "passed": res.passed,
+        "violations": res.violations,
+        "operator_ticks": res.stats.get("operator_ticks"),
+        "tick_bound": entry["spec"]["operator_preempt"]["at_tick"] + 3,
+    }
+
+
+def main():
+    tag = sys.argv[1] if len(sys.argv) > 1 else "local"
+    metrics.configure()
+    arms = phase_diurnal()
+    chaos = phase_chaos()
+
+    small_p99 = arms["static_small"]["ttft_p99_s"]
+    auto_p99 = arms["autoscaled"]["ttft_p99_s"]
+    peak_ch = arms["static_peak"]["chip_hours"]
+    auto_ch = arms["autoscaled"]["chip_hours"]
+    savings = 1.0 - auto_ch / peak_ch if peak_ch else 0.0
+    gates = {
+        "slo_p99_s": TTFT_SLO_P99,
+        "static_small_misses_slo": small_p99 > TTFT_SLO_P99,
+        "autoscaled_meets_slo": auto_p99 <= TTFT_SLO_P99,
+        "chip_hour_savings": round(savings, 4),
+        "chip_hour_savings_gate": GATE_CHIP_SAVINGS,
+        "chip_hours_ok": savings >= GATE_CHIP_SAVINGS,
+        "decisions_journaled":
+            arms["autoscaled"].get("reconcile_ticks", 0) > 0
+            and arms["autoscaled"]["scale_decisions"]["grow"] > 0,
+        "chaos_converged": chaos["passed"]
+            and "operator-converge" in chaos["checked"]
+            and (chaos["operator_ticks"] or 99) <= chaos["tick_bound"],
+    }
+    ok = (gates["static_small_misses_slo"] and gates["autoscaled_meets_slo"]
+          and gates["chip_hours_ok"] and gates["decisions_journaled"]
+          and gates["chaos_converged"])
+    doc = {
+        "tag": tag,
+        "kind": "operator-evidence",
+        "trace": {"day_wall_seconds": DAY_WALL, "base_rate": BASE_RATE,
+                  "peak_rate": PEAK_RATE, "bursts": NUM_BURSTS,
+                  "seed": SEED, "chips_per_pool": CHIPS_PER_POOL,
+                  "max_pools": MAX_POOLS},
+        "arms": arms,
+        "chaos": chaos,
+        "gates": gates,
+        "pass": ok,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       os.pardir, os.pardir, "docs", "ci-evidence",
+                       f"operator-{tag}.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"[operator-evidence] wrote {out}")
+    print(json.dumps(gates, indent=2, sort_keys=True))
+    if not ok:
+        print("[operator-evidence] GATE FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,29 @@ def test_generated_rules_are_module_anchored():
             assert rule.get("module"), rule
 
 
+def test_generated_operator_preempt_names_a_real_slice():
+    """ISSUE 14: when the preempt-mid-reconcile arm is drawn, it names a
+    slice the topology actually declares (and only TPU topologies draw
+    it) — a dangling slice id would make the arm a silent no-op."""
+    from triton_kubernetes_tpu.executor.dagspec import tpu_slices
+
+    drawn = 0
+    for seed in range(60):
+        spec = generate_spec(seed, "tpu")
+        op = spec.get("operator_preempt")
+        if op is None:
+            continue
+        drawn += 1
+        assert op["slice_id"] in {
+            row["slice_id"] for row in tpu_slices(spec["topology"])}
+        assert op["at_tick"] in (1, 2)
+    assert drawn > 0  # the tpu profile draws the arm at weight 0.4
+    # quick profile never draws it (weight 0 — the CI sweep workhorse
+    # stays cheap).
+    assert all(generate_spec(s, "quick").get("operator_preempt") is None
+               for s in range(30))
+
+
 def test_unknown_profile_is_rejected():
     with pytest.raises(ValueError, match="unknown chaos profile"):
         generate_spec(0, "exhaustive")

@@ -41,10 +41,10 @@ def hits(findings, code):
 
 # ---------------------------------------------------------------- registry
 
-def test_at_least_nine_active_rules():
+def test_at_least_ten_active_rules():
     codes = {r.code for r in RULES}
-    assert len(codes) >= 9
-    assert codes == {f"TK8S10{i}" for i in range(1, 10)}
+    assert len(codes) >= 10
+    assert codes == {f"TK8S10{i}" for i in range(1, 10)} | {"TK8S110"}
 
 
 # ----------------------------------------------------------- TK8S101
@@ -360,6 +360,46 @@ def test_tk8s109_absent_corpus_dir_is_clean(tmp_path):
     })
     findings, _ = lint_project(root)
     assert hits(findings, "TK8S109") == []
+
+
+# ----------------------------------------------------------- TK8S110
+
+def test_tk8s110_wall_clock_anywhere_in_operator(tmp_path):
+    # TK8S107 only covers pinned commit-path files; TK8S110 covers the
+    # WHOLE operator package — any new file there is born covered.
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/operator/freshly_added.py": """\
+            import time
+            import random
+
+            def tick(journal):
+                journal.append(time.time())
+                return random.random()
+
+            def ok(clock):
+                rng = random.Random(7)
+                return clock(), rng.random(), time.perf_counter()
+        """,
+    })
+    findings, _ = lint_project(root)
+    # time.time() and the global random.random() fire; the injected
+    # clock, the seeded Random instance, and perf_counter do not.
+    assert hits(findings, "TK8S110") == [
+        ("triton_kubernetes_tpu/operator/freshly_added.py", 5),
+        ("triton_kubernetes_tpu/operator/freshly_added.py", 6)]
+
+
+def test_tk8s110_outside_operator_is_not_its_scope(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/workflows/x.py": """\
+            import time
+
+            def stamp():
+                return time.time()
+        """,
+    })
+    findings, _ = lint_project(root)
+    assert hits(findings, "TK8S110") == []
 
 
 # ------------------------------------------------- suppression round trip

@@ -449,3 +449,129 @@ def test_repair_outcomes_are_counted(tmp_path):
         kind="slice", outcome="failed") == 1
     assert metrics.counter("tk8s_repairs_total").value(
         kind="slice", outcome="ok") == 0
+
+
+# ---------------------------------------------- Prometheus text parser
+# (ISSUE 14: the operator's scrape side — parse what render writes.)
+
+def _full_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("tk8s_serve_requests_total").inc(3, outcome="eos")
+    reg.counter("tk8s_serve_requests_total").inc(outcome="length")
+    reg.gauge("tk8s_serve_queue_depth").set(7)
+    h = reg.histogram("tk8s_serve_ttft_seconds")
+    for v in (0.004, 0.03, 0.03, 0.4, 2.0):
+        h.observe(v)
+    hl = reg.histogram("tk8s_module_apply_duration_seconds")
+    hl.observe(0.2, module='weird "name"\\with\nescapes')
+    return reg
+
+
+def test_parse_prometheus_round_trips_every_metric_kind():
+    reg = _full_registry()
+    parsed = metrics.parse_prometheus(reg.render_prometheus())
+    snap = reg.snapshot()
+    assert set(parsed) == set(snap)
+    for name, fam in snap.items():
+        assert parsed[name]["type"] == fam["type"]
+        assert parsed[name]["help"] == fam["help"]
+        # Series content — incl. histogram cumulative buckets, sums,
+        # counts, and escaped label values — survives byte-exactly.
+        assert parsed[name]["series"] == fam["series"], name
+
+
+def test_parse_prometheus_zero_series_catalog_families_round_trip():
+    reg = MetricsRegistry()
+    reg.register_catalog()
+    parsed = metrics.parse_prometheus(reg.render_prometheus())
+    assert set(parsed) == set(CATALOG)
+    assert all(fam["series"] == [] for fam in parsed.values())
+
+
+@pytest.mark.parametrize("line", [
+    "tk8s_x{bad} 1",                       # label without value
+    'tk8s_x{a="1"',                        # unterminated label set
+    "tk8s_x one",                          # non-numeric value
+    "tk8s_x",                              # no value at all
+    '{a="1"} 2',                           # no family name
+    'tk8s_x{a="1" b="2"} 3',               # missing comma
+])
+def test_parse_prometheus_rejects_malformed_lines(line):
+    text = "tk8s_ok 1\n" + line + "\n"
+    with pytest.raises(metrics.PrometheusParseError) as exc:
+        metrics.parse_prometheus(text)
+    assert exc.value.lineno == 2
+    assert line in str(exc.value)
+
+
+def test_parse_prometheus_rejects_unknown_type():
+    with pytest.raises(metrics.PrometheusParseError):
+        metrics.parse_prometheus("# TYPE tk8s_x gizmo\ntk8s_x 1\n")
+
+
+def test_parse_prometheus_accepts_timestamps_and_inf_nan():
+    parsed = metrics.parse_prometheus(
+        "tk8s_a 1 1700000000\ntk8s_b +Inf\ntk8s_c -Inf\n")
+    assert parsed["tk8s_a"]["series"][0]["value"] == 1.0
+    assert parsed["tk8s_b"]["series"][0]["value"] == float("inf")
+    assert parsed["tk8s_c"]["series"][0]["value"] == float("-inf")
+
+
+def test_histogram_quantile_interpolation_pins():
+    # 100 obs <= 1s, 90 more <= 2s, 10 past the last finite bucket.
+    b = {"1": 100.0, "2": 190.0, "+Inf": 200.0}
+    # p50: rank 100 lands exactly on the first bucket's boundary.
+    assert metrics.histogram_quantile(b, 0.5) == 1.0
+    # p94.5: rank 189 -> 1 + (189-100)/90 of the way through [1, 2].
+    assert metrics.histogram_quantile(b, 0.945) == pytest.approx(
+        1.0 + 89.0 / 90.0)
+    # p99.9 lands in +Inf: the highest finite bound is the answer.
+    assert metrics.histogram_quantile(b, 0.999) == 2.0
+    # Degenerate cases.
+    assert metrics.histogram_quantile({}, 0.99) == 0.0
+    assert metrics.histogram_quantile({"1": 0.0, "+Inf": 0.0}, 0.5) == 0.0
+    with pytest.raises(ValueError):
+        metrics.histogram_quantile(b, 1.5)
+
+
+def test_histogram_quantile_matches_observed_distribution():
+    reg = MetricsRegistry()
+    h = reg.histogram("tk8s_serve_ttft_seconds")
+    for _ in range(99):
+        h.observe(0.02)
+    h.observe(500.0)  # one outlier past every finite bucket
+    parsed = metrics.parse_prometheus(reg.render_prometheus())
+    buckets = parsed["tk8s_serve_ttft_seconds"]["series"][0]["buckets"]
+    # p50 interpolates inside the 0.025 bucket; p99 still fast.
+    assert metrics.histogram_quantile(buckets, 0.5) <= 0.025
+    assert metrics.histogram_quantile(buckets, 0.99) <= 0.025
+    # p999 hits the +Inf bucket -> highest finite bound (120s).
+    assert metrics.histogram_quantile(buckets, 0.999) == 120.0
+
+
+def test_merge_histogram_series_sums_replicas():
+    regs = [MetricsRegistry() for _ in range(3)]
+    for i, reg in enumerate(regs):
+        h = reg.histogram("tk8s_serve_ttft_seconds")
+        for _ in range(10):
+            h.observe(0.01 * (i + 1))
+    series = []
+    for reg in regs:
+        parsed = metrics.parse_prometheus(reg.render_prometheus())
+        series.extend(parsed["tk8s_serve_ttft_seconds"]["series"])
+    merged = metrics.merge_histogram_series(series)
+    assert merged["count"] == 30
+    assert merged["sum"] == pytest.approx(0.1 + 0.2 + 0.3)
+    assert merged["buckets"]["+Inf"] == 30
+    assert metrics.histogram_quantile(merged["buckets"], 0.99) <= 0.05
+
+
+def test_histogram_quantile_accepts_inf_spelling_variants():
+    """The overflow bucket may arrive keyed 'Inf'/'inf'/'+inf' from
+    foreign exposition; the total must come from it — never treated as
+    a finite bucket (which would return inf) or dropped."""
+    for key in ("+Inf", "Inf", "inf", "+inf", "+INF", "INF"):
+        b = {"1": 5.0, key: 10.0}
+        # Rank 9.9 of 10 lands past the finite buckets -> highest
+        # finite bound, NOT an interpolation inside [0, 1].
+        assert metrics.histogram_quantile(b, 0.99) == 1.0

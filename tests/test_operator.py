@@ -1,0 +1,765 @@
+"""The reconcile operator (ISSUE 14): loop, rules, autoscaler, scrape.
+
+Everything here drives the REAL packages — cloudsim-backed executor,
+memory backend, the actual repair workflow — on injected clocks and
+in-process metrics sources, so a full day of reconciling costs
+milliseconds. The serving-side closed loop (real ServeEngine replicas
+under the diurnal trace) lives in scripts/ci/operator_evidence.py.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from triton_kubernetes_tpu.backends import MemoryBackend
+from triton_kubernetes_tpu.executor import LocalExecutor
+from triton_kubernetes_tpu.executor.cloudsim import CloudSimulator
+from triton_kubernetes_tpu.executor.dagspec import document_from_spec
+from triton_kubernetes_tpu.executor.engine import (
+    load_executor_state,
+    save_executor_state,
+)
+from triton_kubernetes_tpu.operator import (
+    Autoscaler,
+    AutoscalerConfig,
+    MetricsWatcher,
+    OperatorHTTPServer,
+    Reconciler,
+    ScaleDecision,
+    apply_decision,
+    tpu_pool_modules,
+)
+from triton_kubernetes_tpu.operator.observe import ServingSample, observe
+from triton_kubernetes_tpu.serve.loadgen import DiurnalSchedule
+from triton_kubernetes_tpu.utils import metrics
+from triton_kubernetes_tpu.utils.logging import Logger
+
+TOPO = {"manager": {"provider": "bare-metal", "name": "m1"},
+        "clusters": [{"provider": "gcp-tpu", "name": "ml",
+                      "pools": [{"name": "pool0",
+                                 "accelerator": "v5e-16"}]}]}
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    metrics.configure()
+    yield
+    metrics.configure()
+
+
+def quiet_executor() -> LocalExecutor:
+    return LocalExecutor(log=lambda m: None,
+                         logger=Logger(stream=io.StringIO()))
+
+
+def make_world(name: str, topo=None):
+    doc = document_from_spec(topo or TOPO, name)
+    backend = MemoryBackend()
+    backend.persist(doc)
+    return backend, quiet_executor(), doc
+
+
+class TickClock:
+    """Deterministic reconcile clock: +dt per read."""
+
+    def __init__(self, dt: float = 10.0):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self) -> float:
+        self.t += self.dt
+        return self.t
+
+
+def make_reconciler(backend, ex, name, **kw):
+    kw.setdefault("clock", TickClock())
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("log", lambda m: None)
+    return Reconciler(backend, ex, name, **kw)
+
+
+def preempt(doc, slice_id: str) -> None:
+    est = load_executor_state(doc)
+    sim = CloudSimulator(est.cloud)
+    sim.preempt_slice(slice_id)
+    est.cloud = sim.to_dict()
+    save_executor_state(doc, est)
+
+
+# ------------------------------------------------------------ reconcile
+
+
+def test_reconciler_converges_fresh_doc_then_noops():
+    backend, ex, _ = make_world("op-fresh")
+    rec = make_reconciler(backend, ex, "op-fresh")
+    t1 = rec.tick()
+    assert t1.outcome == "acted"
+    assert [a["rule"] for a in t1.actions] == ["converge-drift"]
+    assert "node_gcp-tpu_ml_pool0" in t1.delta["to_apply"]
+    t2 = rec.tick()
+    assert t2.outcome == "noop" and rec.converged
+    # The tick journal carries the decision audit trail.
+    assert [t.tick for t in rec.journal] == [1, 2]
+    assert metrics.counter("tk8s_operator_reconciles_total").value(
+        outcome="acted") == 1
+    assert metrics.counter("tk8s_operator_reconciles_total").value(
+        outcome="noop") == 1
+    assert metrics.histogram(
+        "tk8s_operator_reconcile_duration_seconds").count() == 2
+
+
+def test_reconciler_repairs_preempted_slice_exactly_once():
+    backend, ex, _ = make_world("op-repair")
+    rec = make_reconciler(backend, ex, "op-repair")
+    rec.run(max_ticks=2)
+    preempt(rec._load_doc(), "ml-pool0")
+    t = rec.tick()
+    assert t.outcome == "acted"
+    assert t.delta["to_repair"] == [{"slice_id": "ml-pool0",
+                                     "cluster": "ml", "pool": "pool0"}]
+    assert t.actions == [{"rule": "replace-preempted-slice",
+                          "targets": ["ml-pool0"], "ok": True}]
+    assert rec.tick().outcome == "noop"
+    view = ex.cloud_view(rec._load_doc())
+    assert view.preempted_slices() == {}
+    # Lifetime history survives the repair — the risk-weighting signal.
+    est = load_executor_state(rec._load_doc())
+    assert est.cloud["preempt_history"] == {"ml-pool0": 1}
+    assert metrics.counter("tk8s_operator_drift_total").value(
+        kind="preempted") == 1
+
+
+def test_reconciler_drains_orphans_dependents_first():
+    backend, ex, _ = make_world("op-orphan")
+    rec = make_reconciler(backend, ex, "op-orphan")
+    rec.run(max_ticks=2)
+    # Out-of-band edit: the pool vanishes from desired state.
+    doc = backend.state("op-orphan")
+    assert doc.delete("module.node_gcp-tpu_ml_pool0")
+    backend.persist(doc)
+    t = rec.tick()
+    assert t.outcome == "acted"
+    assert [a["rule"] for a in t.actions] == ["drain-orphans"]
+    assert t.actions[0]["targets"] == ["node_gcp-tpu_ml_pool0"]
+    est = load_executor_state(rec._load_doc())
+    assert "node_gcp-tpu_ml_pool0" not in est.modules
+    assert rec.tick().outcome == "noop"
+
+
+def test_preempted_slice_of_drained_pool_is_not_resurrected():
+    backend, ex, _ = make_world("op-dead-drain")
+    rec = make_reconciler(backend, ex, "op-dead-drain")
+    rec.run(max_ticks=2)
+    preempt(rec._load_doc(), "ml-pool0")
+    doc = backend.state("op-dead-drain")
+    doc.delete("module.node_gcp-tpu_ml_pool0")
+    backend.persist(doc)
+    t = rec.tick()
+    # Not drift to repair — an orphan to drain.
+    assert t.delta["to_repair"] == []
+    assert [a["rule"] for a in t.actions] == ["drain-orphans"]
+    assert rec.tick().outcome == "noop"
+
+
+def test_preempt_between_observe_and_act_converges_next_tick():
+    """The chaos-arm contract, unit-sized: the world changes after the
+    diff; THIS tick acts stale, the NEXT tick repairs, exactly once."""
+    backend, ex, _ = make_world("op-midtick")
+    fired = []
+
+    def hook(observed):
+        # Fire once, after the first tick has provisioned the pool.
+        if not fired and rec.journal:
+            preempt(rec._load_doc(), "ml-pool0")
+            fired.append(True)
+
+    rec = make_reconciler(backend, ex, "op-midtick",
+                          between_observe_and_act=hook)
+    rec.tick()        # applies the fresh doc
+    t2 = rec.tick()   # hook preempts AFTER this tick's diff: stale noop
+    assert fired and t2.delta["to_repair"] == [] and t2.outcome == "noop"
+    t3 = rec.tick()
+    assert [a["rule"] for a in t3.actions] == ["replace-preempted-slice"]
+    t4 = rec.tick()
+    assert t4.outcome == "noop" and rec.converged
+    repairs = [a for t in rec.journal for a in t.actions
+               if a["rule"] == "replace-preempted-slice"]
+    assert len(repairs) == 1 and repairs[0]["targets"] == ["ml-pool0"]
+
+
+def test_journal_path_appends_jsonl(tmp_path):
+    backend, ex, _ = make_world("op-journal")
+    path = tmp_path / "ticks.jsonl"
+    rec = make_reconciler(backend, ex, "op-journal",
+                          journal_path=str(path))
+    rec.run(max_ticks=2)
+    lines = [json.loads(line) for line in
+             path.read_text().strip().splitlines()]
+    assert [r["tick"] for r in lines] == [1, 2]
+    assert lines[0]["outcome"] == "acted"
+    assert lines[1]["outcome"] == "noop"
+    assert "observed" in lines[0] and "delta" in lines[0]
+
+
+def test_unknown_manager_is_typed_operator_error():
+    from triton_kubernetes_tpu.operator import OperatorError
+
+    backend, ex, _ = make_world("op-known")
+    rec = make_reconciler(backend, ex, "no-such-doc")
+    with pytest.raises(OperatorError, match="no-such-doc"):
+        rec.tick()
+
+
+# ----------------------------------------------------------- autoscaler
+
+
+def fleet_source():
+    """A controllable in-process 'serving fleet': its registry is the
+    scrape source, exactly what the evidence harness does."""
+    reg = metrics.MetricsRegistry()
+    return reg, (lambda: reg.render_prometheus())
+
+
+def autoscaled_world(name, cfg=None, clock=None):
+    backend, ex, _ = make_world(name)
+    reg, src = fleet_source()
+    asc = Autoscaler(cfg or AutoscalerConfig(
+        ttft_slo_p99_s=0.5, queue_high=4.0, queue_low=1.0,
+        min_pools=1, max_pools=3, scale_up_after=2, scale_down_after=3,
+        cooldown_s=15.0))
+    rec = make_reconciler(backend, ex, name, autoscaler=asc,
+                          autoscale_cluster="ml", metrics_sources=[src],
+                          clock=clock or TickClock())
+    rec.tick()  # initial converge
+    return backend, ex, rec, reg, asc
+
+
+def test_autoscaler_grows_after_hysteresis_and_respects_max():
+    _, ex, rec, reg, _ = autoscaled_world("as-grow")
+    q = reg.gauge("tk8s_serve_queue_depth")
+    directions = []
+    for _ in range(8):
+        q.set(10.0)
+        t = rec.tick()
+        directions.append(t.decision["direction"])
+    # breach tick 1 holds (hysteresis), tick 2 grows; cooldown then
+    # gates the next grow; the ceiling caps it at 3 pools.
+    assert directions.count("grow") == 2
+    assert directions[0] == "hold"
+    doc = rec._load_doc()
+    assert tpu_pool_modules(doc)["ml"] == [
+        "node_gcp-tpu_ml_pool0", "node_gcp-tpu_ml_pool1",
+        "node_gcp-tpu_ml_pool2"]
+    # Grown pools are applied clones of the template (same accelerator).
+    est = load_executor_state(doc)
+    assert "node_gcp-tpu_ml_pool2" in est.modules
+    cfg = doc.get("module.node_gcp-tpu_ml_pool2")
+    assert cfg["tpu_accelerator"] == "v5e-16"
+    assert cfg["pool_name"] == "pool2"
+    reasons = [t.decision["reason"] for t in rec.journal if t.decision]
+    assert "at-max" in reasons
+    assert metrics.counter("tk8s_operator_scale_decisions_total").value(
+        direction="grow", reason="queue-high") == 2
+    assert metrics.gauge("tk8s_operator_pools").value(cluster="ml") == 3
+
+
+def test_autoscaler_ttft_breach_uses_windowed_p99():
+    _, _, rec, reg, _ = autoscaled_world("as-ttft")
+    h = reg.histogram("tk8s_serve_ttft_seconds")
+    # A slow era already in the cumulative histogram BEFORE the
+    # operator's first scrape window closes...
+    for _ in range(50):
+        h.observe(3.0)
+    rec.tick()  # first sample swallows history into the baseline
+    # ...then a fast era: windowed p99 must be fast, no breach.
+    for _ in range(50):
+        h.observe(0.05)
+    t = rec.tick()
+    assert t.observed["ttft_p99_s"] <= 0.5
+    assert t.decision["direction"] == "hold"
+    # And a newly slow window breaches even though the lifetime
+    # distribution is now majority-fast.
+    for _ in range(10):
+        h.observe(3.0)
+    t = rec.tick()
+    assert t.observed["ttft_p99_s"] > 0.5
+
+
+def test_autoscaler_drains_on_calm_and_risk_floor_blocks_after_preempts():
+    backend, ex, rec, reg, asc = autoscaled_world("as-drain")
+    q = reg.gauge("tk8s_serve_queue_depth")
+    # Grow to 2 pools.
+    for _ in range(3):
+        q.set(10.0)
+        rec.tick()
+    assert len(tpu_pool_modules(rec._load_doc())["ml"]) == 2
+    # Calm traffic: drains back to 1 after scale_down_after ticks.
+    q.set(0.0)
+    drained = []
+    for _ in range(6):
+        t = rec.tick()
+        drained.append(t.decision["direction"])
+    assert "drain" in drained
+    assert tpu_pool_modules(rec._load_doc())["ml"] == [
+        "node_gcp-tpu_ml_pool0"]
+    # Now a preemption storm: repair happens, risk score rises, and a
+    # regrown pool refuses to drain (risk-floor) despite calm.
+    q.set(10.0)
+    for _ in range(3):
+        rec.tick()
+    assert len(tpu_pool_modules(rec._load_doc())["ml"]) == 2
+    preempt(rec._load_doc(), "ml-pool0")
+    t = rec.tick()   # repair-first tick: risk absorbs the reclaim
+    assert t.decision["reason"] == "repair-first"
+    q.set(0.0)
+    reasons = []
+    for _ in range(3):
+        t = rec.tick()
+        reasons.append(t.decision["reason"])
+    # While the decayed risk score is hot, calm alone cannot drain.
+    assert "risk-floor" in reasons and "drain" not in [
+        t.decision["direction"] for t in rec.journal[-3:]]
+    assert len(tpu_pool_modules(rec._load_doc())["ml"]) == 2
+    # Once the risk decays cold, the drain goes through.
+    for _ in range(6):
+        rec.tick()
+    assert tpu_pool_modules(rec._load_doc())["ml"] == [
+        "node_gcp-tpu_ml_pool0"]
+
+
+def test_autoscaler_holds_without_signal_and_on_preempted():
+    backend, ex, _ = make_world("as-blind")
+    dead = lambda: (_ for _ in ()).throw(ConnectionError("down"))  # noqa: E731
+    asc = Autoscaler(AutoscalerConfig())
+    rec = make_reconciler(backend, ex, "as-blind", autoscaler=asc,
+                          autoscale_cluster="ml", metrics_sources=[dead])
+    rec.tick()
+    t = rec.tick()
+    assert t.decision == {"direction": "hold", "reason": "no-signal",
+                          "pools": 1, "cluster": "ml",
+                          "detail": "0/1 sources answered", "risk": 0.0}
+    # repair-first: with signal present but a slice dead, hold.
+    reg, src = fleet_source()
+    reg.gauge("tk8s_serve_queue_depth").set(50.0)
+    rec2 = make_reconciler(backend, ex, "as-blind",
+                           autoscaler=Autoscaler(AutoscalerConfig()),
+                           autoscale_cluster="ml", metrics_sources=[src])
+    rec2.tick()
+    preempt(rec2._load_doc(), "ml-pool0")
+    t = rec2.tick()
+    assert t.decision["reason"] == "repair-first"
+
+
+def test_apply_decision_grow_clones_template_and_drain_is_lifo():
+    doc = document_from_spec(TOPO, "ad")
+    pools = tpu_pool_modules(doc)["ml"]
+    key = apply_decision(doc, ScaleDecision("grow", "x", 2, "ml"), pools)
+    assert key == "node_gcp-tpu_ml_pool1"
+    assert doc.get(f"module.{key}")["pool_name"] == "pool1"
+    pools = tpu_pool_modules(doc)["ml"]
+    victim = apply_decision(doc, ScaleDecision("drain", "x", 1, "ml"),
+                            pools)
+    assert victim == "node_gcp-tpu_ml_pool1"
+    # Template pool is never drained even if asked.
+    pools = tpu_pool_modules(doc)["ml"]
+    assert apply_decision(doc, ScaleDecision("drain", "x", 0, "ml"),
+                          pools) is None
+    assert tpu_pool_modules(doc)["ml"] == ["node_gcp-tpu_ml_pool0"]
+
+
+def test_autoscaler_config_validation():
+    with pytest.raises(ValueError, match="min_pools"):
+        Autoscaler(AutoscalerConfig(min_pools=0))
+    with pytest.raises(ValueError, match="max_pools"):
+        Autoscaler(AutoscalerConfig(min_pools=3, max_pools=2))
+    with pytest.raises(ValueError, match="risk_decay"):
+        Autoscaler(AutoscalerConfig(risk_decay=1.0))
+
+
+# -------------------------------------------------------------- observe
+
+
+def test_watcher_counts_unreachable_sources_as_blind_not_quiet():
+    reg, src = fleet_source()
+    reg.gauge("tk8s_serve_queue_depth").set(2.0)
+    dead = lambda: (_ for _ in ()).throw(ConnectionError("down"))  # noqa: E731
+    w = MetricsWatcher([src, dead])
+    s = w.sample()
+    assert (s.sources_total, s.sources_ok) == (2, 1)
+    assert not s.blind and s.has_signal
+    assert s.queue_depth == 2.0
+    blind = MetricsWatcher([dead]).sample()
+    assert blind.blind and not blind.has_signal
+
+
+def test_sample_defaults_mean_no_fleet_configured():
+    s = ServingSample()
+    assert not s.has_signal and not s.blind
+
+
+def test_tpu_pool_modules_scans_only_nodepool_sources():
+    doc = document_from_spec(TOPO, "pools")
+    assert tpu_pool_modules(doc) == {"ml": ["node_gcp-tpu_ml_pool0"]}
+    # The manager and the tpu cluster module are not pools.
+    doc2 = document_from_spec(
+        {"manager": {"provider": "bare-metal", "name": "m1"},
+         "clusters": [{"provider": "aws", "name": "c0",
+                       "nodes": ["w0"]}]}, "pools2")
+    assert tpu_pool_modules(doc2) == {}
+
+
+def test_observe_reports_plan_and_preempted(tmp_path):
+    backend, ex, doc = make_world("obs")
+    obs = observe(backend.state("obs"), ex, None)
+    assert "node_gcp-tpu_ml_pool0" in obs.to_apply
+    assert obs.to_prune == [] and obs.preempted == {}
+
+
+# ----------------------------------------------------------- HTTP + CLI
+
+
+def test_operator_http_metrics_healthz_stats():
+    backend, ex, _ = make_world("op-http")
+    rec = make_reconciler(backend, ex, "op-http")
+    rec.run(max_ticks=2)
+    with OperatorHTTPServer(rec, port=0) as srv:
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as r:
+            fams = metrics.parse_prometheus(r.read().decode())
+        assert fams["tk8s_operator_reconciles_total"]["series"]
+        with urllib.request.urlopen(srv.url + "/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        assert stats["ticks"] == 2 and stats["converged"] is True
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=10) as r:
+            assert r.status == 200
+        srv.set_liveness(lambda: False)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert exc.value.code == 503
+
+
+def test_cli_operate_until_converged():
+    from triton_kubernetes_tpu.cli.main import main as cli_main
+
+    backend, ex, _ = make_world("cli-op")
+    rc = cli_main(["--non-interactive", "--set", "cluster_manager=cli-op",
+                   "operate", "--until-converged", "--interval", "0"],
+                  backend=backend, executor=ex)
+    assert rc == 0
+    est = load_executor_state(backend.state("cli-op"))
+    assert "node_gcp-tpu_ml_pool0" in est.modules
+
+
+def test_cli_operate_rejects_bad_autoscaler_config():
+    from triton_kubernetes_tpu.cli.main import main as cli_main
+
+    backend, ex, _ = make_world("cli-bad")
+    rc = cli_main(["--non-interactive", "--set", "cluster_manager=cli-bad",
+                   "operate", "--autoscale-cluster", "ml",
+                   "--min-pools", "0", "--max-ticks", "1"],
+                  backend=backend, executor=ex)
+    assert rc == 2
+
+
+# -------------------------------------------------------------- diurnal
+
+
+def test_diurnal_schedule_is_seed_deterministic_and_sorted():
+    a = DiurnalSchedule(base_rate=2, peak_rate=10, day_seconds=30,
+                        vocab_size=64, seed=5)
+    b = DiurnalSchedule(base_rate=2, peak_rate=10, day_seconds=30,
+                        vocab_size=64, seed=5)
+    assert [(r.at, tuple(r.tokens)) for r in a] == \
+        [(r.at, tuple(r.tokens)) for r in b]
+    ats = [r.at for r in a]
+    assert ats == sorted(ats) and len(a) > 0
+    c = DiurnalSchedule(base_rate=2, peak_rate=10, day_seconds=30,
+                        vocab_size=64, seed=6)
+    assert [r.at for r in c] != ats
+
+
+def test_diurnal_rate_curve_peaks_where_told():
+    s = DiurnalSchedule(base_rate=2, peak_rate=10, day_seconds=100,
+                        peak_at=0.5, num_bursts=0, vocab_size=64, seed=0)
+    assert s.rate_at(50.0) == pytest.approx(10.0)
+    assert s.rate_at(0.0) == pytest.approx(2.0)
+    assert 2.0 < s.rate_at(25.0) < 10.0
+
+
+def test_diurnal_bursts_multiply_the_curve():
+    s = DiurnalSchedule(base_rate=4, peak_rate=4, day_seconds=100,
+                        num_bursts=1, burst_mult=3.0, burst_seconds=10,
+                        vocab_size=64, seed=3)
+    (start, end), = s.bursts
+    assert s.rate_at((start + end) / 2) == pytest.approx(12.0)
+    assert s.rate_at(end + 1e-6) == pytest.approx(4.0)
+
+
+def test_diurnal_rejects_bad_knobs():
+    with pytest.raises(ValueError):
+        DiurnalSchedule(base_rate=0, peak_rate=1, vocab_size=8)
+    with pytest.raises(ValueError):
+        DiurnalSchedule(base_rate=2, peak_rate=1, vocab_size=8)
+    with pytest.raises(ValueError):
+        DiurnalSchedule(base_rate=1, peak_rate=2, burst_mult=0.5,
+                        vocab_size=8)
+
+
+# ------------------------------------------- review-regression pins
+
+
+def test_watcher_rebaselines_on_counter_reset_and_partial_scrape():
+    """A replica restart (counters reset) must re-baseline, not re-count
+    its lifetime histogram as fresh traffic; a source that skips a tick
+    contributes a two-tick delta next time, not a poisoned baseline."""
+    regs = [metrics.MetricsRegistry(), metrics.MetricsRegistry()]
+    flaky = {"down": False}
+
+    def src0():
+        return regs[0].render_prometheus()
+
+    def src1():
+        if flaky["down"]:
+            raise ConnectionError("scrape timeout")
+        return regs[1].render_prometheus()
+
+    for reg in regs:
+        for _ in range(20):
+            reg.histogram("tk8s_serve_ttft_seconds").observe(3.0)
+    w = MetricsWatcher([src0, src1])
+    first = w.sample()
+    # The first-ever sample only establishes the baseline: the
+    # cumulative histogram is each replica's LIFETIME, not this tick's
+    # traffic — windowing it would let a freshly-started operator grow
+    # on a morning incident that is already over.
+    assert first.window_requests == 0 and first.ttft_p99_s == 0.0
+    assert first.has_signal  # baselining is not blindness
+    # Partial scrape: source 1 times out; source 0 sees 5 fast requests.
+    flaky["down"] = True
+    for _ in range(5):
+        regs[0].histogram("tk8s_serve_ttft_seconds").observe(0.01)
+    s = w.sample()
+    assert (s.sources_ok, s.window_requests) == (1, 5)
+    assert s.ttft_p99_s <= 0.5  # the slow lifetime history is NOT in it
+    # Source 1 comes back: its delta covers the two-tick gap only.
+    flaky["down"] = False
+    regs[1].histogram("tk8s_serve_ttft_seconds").observe(0.02)
+    s = w.sample()
+    assert s.window_requests == 1
+    # Source 0 restarts (counters reset to less than the baseline):
+    # re-baseline, never negative/lifetime-recount.
+    regs[0] = metrics.MetricsRegistry()
+    regs[0].histogram("tk8s_serve_ttft_seconds").observe(0.03)
+    s = w.sample()
+    assert s.window_requests == 0 and s.ttft_p99_s == 0.0
+    # Next tick windows cleanly from the new baseline.
+    regs[0].histogram("tk8s_serve_ttft_seconds").observe(0.04)
+    assert w.sample().window_requests == 1
+
+
+def test_drain_never_takes_a_human_named_pool():
+    """The drain victim is the highest-N pool<N> clone by NUMERIC order;
+    a hand-provisioned pool whose name sorts after the clones (and the
+    template itself) is never reclaimed."""
+    topo = {"manager": {"provider": "bare-metal", "name": "m1"},
+            "clusters": [{"provider": "gcp-tpu", "name": "ml",
+                          "pools": [{"name": "serving",
+                                     "accelerator": "v5e-16"}]}]}
+    doc = document_from_spec(topo, "ad-human")
+    pools = tpu_pool_modules(doc)["ml"]
+    grown = apply_decision(doc, ScaleDecision("grow", "x", 2, "ml"), pools)
+    assert grown == "node_gcp-tpu_ml_pool1"
+    pools = tpu_pool_modules(doc)["ml"]
+    # "serving" sorts after "pool1" lexicographically — the clone must
+    # still be the victim.
+    victim = apply_decision(doc, ScaleDecision("drain", "x", 1, "ml"),
+                            pools)
+    assert victim == "node_gcp-tpu_ml_pool1"
+    assert tpu_pool_modules(doc)["ml"] == ["node_gcp-tpu_ml_serving"]
+    # Numeric order: pool10 outranks pool2.
+    doc2 = document_from_spec(TOPO, "ad-num")
+    for name in ("pool2", "pool10"):
+        cfg = dict(doc2.get("module.node_gcp-tpu_ml_pool0"))
+        cfg["pool_name"] = name
+        doc2.set(f"module.node_gcp-tpu_ml_{name}", cfg)
+    victim = apply_decision(doc2, ScaleDecision("drain", "x", 2, "ml"),
+                            tpu_pool_modules(doc2)["ml"])
+    assert victim == "node_gcp-tpu_ml_pool10"
+
+
+def test_failed_scale_actuation_does_not_consume_cooldown():
+    """A grow whose apply failed must not arm the cooldown: the next
+    tick re-decides the grow immediately instead of holding for a
+    capacity change that never landed."""
+    backend, ex, _ = make_world("as-fail")
+    reg, src = fleet_source()
+    asc = Autoscaler(AutoscalerConfig(
+        ttft_slo_p99_s=0.5, queue_high=4.0, queue_low=1.0,
+        min_pools=1, max_pools=3, scale_up_after=1, scale_down_after=3,
+        cooldown_s=1000.0))
+    rec = make_reconciler(backend, ex, "as-fail", autoscaler=asc,
+                          autoscale_cluster="ml", metrics_sources=[src])
+    rec.tick()
+    reg.gauge("tk8s_serve_queue_depth").set(50.0)
+    # Make the converge apply fail once (the new pool cannot resolve).
+    real_apply = ex.apply
+    boom = {"armed": True}
+
+    def flaky_apply(doc, targets=None, parallelism=None):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("control plane 500")
+        return real_apply(doc, targets=targets, parallelism=parallelism)
+
+    ex.apply = flaky_apply
+    t = rec.tick()
+    assert t.decision["direction"] == "grow" and t.outcome == "failed"
+    # The pools gauge reports what actually holds (1), not the decided
+    # count of a grow that never landed.
+    assert metrics.gauge("tk8s_operator_pools").value(cluster="ml") == 1
+    # Next tick: NOT cooldown — the grow is re-decided and lands.
+    t = rec.tick()
+    assert t.decision["direction"] == "grow"
+    assert t.outcome == "acted"
+    assert len(tpu_pool_modules(rec._load_doc())["ml"]) == 2
+    assert metrics.gauge("tk8s_operator_pools").value(cluster="ml") == 2
+    # A LANDED action does arm the (huge) cooldown.
+    reg.gauge("tk8s_serve_queue_depth").set(50.0)
+    t = rec.tick()
+    assert t.decision == {**t.decision, "reason": "cooldown"}
+
+
+def test_calm_with_no_drainable_clone_holds_not_drains():
+    """A fleet of hand-named pools must hold with 'nothing-drainable'
+    on calm ticks — not decide (and journal, and count) a drain that
+    apply_decision can never land."""
+    topo = {"manager": {"provider": "bare-metal", "name": "m1"},
+            "clusters": [{"provider": "gcp-tpu", "name": "ml",
+                          "pools": [{"name": "alpha",
+                                     "accelerator": "v5e-16"},
+                                    {"name": "beta",
+                                     "accelerator": "v5e-16"}]}]}
+    backend, ex, _ = make_world("as-nodrain", topo)
+    reg, src = fleet_source()
+    reg.gauge("tk8s_serve_queue_depth").set(0.0)
+    asc = Autoscaler(AutoscalerConfig(min_pools=1, max_pools=3,
+                                      scale_down_after=1))
+    rec = make_reconciler(backend, ex, "as-nodrain", autoscaler=asc,
+                          autoscale_cluster="ml", metrics_sources=[src])
+    rec.tick()
+    for _ in range(3):
+        t = rec.tick()
+        assert t.decision["direction"] == "hold"
+        assert t.decision["reason"] == "nothing-drainable"
+    assert metrics.counter("tk8s_operator_scale_decisions_total").value(
+        direction="drain", reason="calm") == 0
+    assert len(tpu_pool_modules(rec._load_doc())["ml"]) == 2
+
+
+def test_drain_persisted_by_converge_still_arms_cooldown():
+    """A drain whose document deletion persisted via converge-drift's
+    persist must count as LANDED even when the drain-orphans prune then
+    fails: the next calm tick holds in cooldown instead of shedding a
+    second pool off one calm trend, and the pools gauge reports the
+    persisted desired count. The orphaned resources prune as ordinary
+    drift once the apply heals."""
+    topo = {"manager": {"provider": "bare-metal", "name": "m1"},
+            "clusters": [{"provider": "gcp-tpu", "name": "ml",
+                          "pools": [{"name": f"pool{i}",
+                                     "accelerator": "v5e-16"}
+                                    for i in range(3)]}]}
+    backend, ex, _ = make_world("as-drain-persist", topo)
+    reg, src = fleet_source()
+    asc = Autoscaler(AutoscalerConfig(
+        ttft_slo_p99_s=0.5, queue_high=4.0, queue_low=1.0,
+        min_pools=1, max_pools=3, scale_up_after=99, scale_down_after=1,
+        cooldown_s=1000.0))
+    rec = make_reconciler(backend, ex, "as-drain-persist", autoscaler=asc,
+                          autoscale_cluster="ml", metrics_sources=[src])
+    q = reg.gauge("tk8s_serve_queue_depth")
+    q.set(10.0)   # breach (held by huge scale_up_after) during converge
+    rec.tick()
+    # Out-of-band drift on pool0, so the drain tick also has converge
+    # work (whose persist carries the deletion).
+    doc = backend.state("as-drain-persist")
+    cfg = dict(doc.get("module.node_gcp-tpu_ml_pool0"))
+    cfg["auto_repair"] = False
+    doc.set("module.node_gcp-tpu_ml_pool0", cfg)
+    backend.persist(doc)
+    real_apply = ex.apply
+
+    def prune_fails(doc, targets=None, parallelism=None):
+        if targets and any("pool2" in t for t in targets):
+            raise RuntimeError("control plane 500")
+        return real_apply(doc, targets=targets, parallelism=parallelism)
+
+    ex.apply = prune_fails
+    q.set(0.0)
+    t = rec.tick()
+    assert t.decision["direction"] == "drain"
+    assert {a["rule"]: a["ok"] for a in t.actions} == {
+        "converge-drift": True, "drain-orphans": False}
+    assert t.outcome == "failed"
+    # The deletion persisted with converge-drift's persist; the gauge
+    # reports the persisted desired count, not the pre-decision one.
+    assert len(tpu_pool_modules(backend.state("as-drain-persist"))["ml"]) \
+        == 2
+    assert metrics.gauge("tk8s_operator_pools").value(cluster="ml") == 2
+    # Next calm tick: cooldown — NOT a second drain.
+    ex.apply = real_apply
+    t = rec.tick()
+    assert t.decision == {**t.decision, "direction": "hold",
+                          "reason": "cooldown"}
+    # The orphaned pool2 resources were pruned as ordinary drift.
+    assert [a["rule"] for a in t.actions] == ["drain-orphans"]
+    assert t.outcome == "acted"
+    est = load_executor_state(rec._load_doc())
+    assert "node_gcp-tpu_ml_pool2" not in est.modules
+    drains = [tk for tk in rec.journal
+              if tk.decision and tk.decision["direction"] == "drain"]
+    assert len(drains) == 1
+
+
+def test_hand_keyed_pool_module_never_crashes_or_drains():
+    """A pool module stored under a key that does not follow the
+    add_node scheme (an out-of-band document edit) must not crash the
+    decide path — and is never the drain victim."""
+    from triton_kubernetes_tpu.operator.autoscaler import drain_candidates
+
+    doc = document_from_spec(TOPO, "ad-handkey")
+    cfg = dict(doc.get("module.node_gcp-tpu_ml_pool0"))
+    cfg["pool_name"] = "aux"
+    doc.set("module.mypool", cfg)
+    pools = tpu_pool_modules(doc)["ml"]
+    assert "mypool" in pools
+    # No ValueError; the hand-keyed pool is treated like a human pool.
+    assert drain_candidates(pools, "ml") == [(0, "node_gcp-tpu_ml_pool0")]
+    victim = apply_decision(doc, ScaleDecision("drain", "x", 1, "ml"),
+                            pools)
+    assert victim == "node_gcp-tpu_ml_pool0"
+    assert tpu_pool_modules(doc)["ml"] == ["mypool"]
+
+
+def test_preempted_hand_keyed_pool_fails_loudly_not_silently():
+    """A preempted slice whose desired pool lives under an out-of-band
+    module key is matched by (cluster, pool) CONFIG identity, so the
+    repair is attempted and its failure lands in the journal — instead
+    of key reconstruction silently never matching and the loop holding
+    'repair-first' forever with noop ticks."""
+    backend, ex, _ = make_world("op-handkey")
+    doc = backend.state("op-handkey")
+    cfg = dict(doc.get("module.node_gcp-tpu_ml_pool0"))
+    cfg["pool_name"] = "aux"
+    doc.set("module.mypool", cfg)
+    backend.persist(doc)
+    rec = make_reconciler(backend, ex, "op-handkey")
+    rec.run(max_ticks=2)
+    preempt(rec._load_doc(), "ml-aux")
+    t = rec.tick()
+    assert t.delta["to_repair"] == [{"slice_id": "ml-aux",
+                                     "cluster": "ml", "pool": "aux"}]
+    assert t.outcome == "failed"
+    assert "ml-aux" in t.error

@@ -299,6 +299,8 @@ def test_gather_pages_dequantizes():
                                atol=0.15, rtol=0.15)
 
 
+@pytest.mark.slow  # ISSUE 14 budget pass: quant_evidence.py's exact
+# short-sequence greedy pin covers this contract every CI run
 def test_quantized_paged_greedy_decode_tracks_unquantized():
     """Model-level quantization contract: int8 pages reproduce the
     unquantized greedy decode exactly for short continuations (the
@@ -502,6 +504,9 @@ def test_paged_prefill_chunk_window_invariance():
                           np.asarray(c16.v[:, 1:nfull + 1]))
 
 
+@pytest.mark.slow  # ISSUE 14 budget pass: the f32 chunk bitwise +
+# window-invariance pins stay tier-1; the int8 page-identity arm runs
+# in `-m slow` (quant_evidence.py exercises int8 pools every CI run)
 def test_paged_prefill_chunk_quantized_pages_consistent():
     """int8 pools through the chunked path: the anchored-scale rule
     keeps a window's quantized pages bitwise identical however the

@@ -154,6 +154,9 @@ def test_shared_prefix_schedule_shares_prefixes():
 
 
 # ----------------------------------------------------------- HTTP fleet
+@pytest.mark.slow  # ISSUE 14 budget pass: prefix_router_evidence.py
+# phase B gates affinity >= 0.95 with reference-equal outputs over 3
+# live replicas every CI run
 def test_router_affinity_and_identical_outputs(model):
     """Two replicas behind the router: every session's turns land on ONE
     replica (affinity 1.0 with no spill pressure) and outputs equal the
@@ -189,6 +192,9 @@ def test_router_affinity_and_identical_outputs(model):
             s.stop()
 
 
+@pytest.mark.slow  # ISSUE 14 budget pass: prefix_router_evidence.py
+# phase C kills a replica mid-decode and gates the identical-output
+# re-land every CI run
 def test_router_replica_death_relands_requests(model):
     """Kill a replica's engine loop mid-decode: its in-flight request
     must 503 out of the dead replica (PR 6's loop-death semantics),

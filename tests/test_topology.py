@@ -331,3 +331,58 @@ def test_route_port_matches_constants_pin():
     from triton_kubernetes_tpu.topology import render_router_service
     assert render_router_service("x")["spec"]["ports"][0]["port"] \
         == ROUTE_PORT
+
+
+def test_operator_deployment_and_service_render():
+    """ISSUE 14: the reconcile operator renders as a single-replica
+    Recreate Deployment (the loop is a single writer against the state
+    document — two operators would race the backend lock), CPU-only,
+    with a LIVENESS probe on /healthz (a dead loop is fixed by a
+    restart; there is no traffic to park with readiness)."""
+    from triton_kubernetes_tpu.constants import OPERATOR_PORT
+    from triton_kubernetes_tpu.topology import (
+        render_operator_deployment, render_operator_service)
+    from triton_kubernetes_tpu.topology.serving import ROLE_LABEL
+    from triton_kubernetes_tpu.topology.validate import validate_manifest
+
+    dep = render_operator_deployment(
+        "llm-operator", image="tk8s:latest", manager="prod",
+        scrape_urls=["http://r0:8000/metrics"])
+    svc = render_operator_service("llm-operator")
+    validate_manifest(dep)
+    validate_manifest(svc)
+
+    assert dep["spec"]["replicas"] == 1
+    assert dep["spec"]["strategy"] == {"type": "Recreate"}
+    pod = dep["spec"]["template"]["spec"]
+    assert "nodeSelector" not in pod  # control-plane plumbing, no TPU pin
+    c = pod["containers"][0]
+    assert "google.com/tpu" not in str(c.get("resources", {}))
+    assert c["command"][0] == "triton-kubernetes-tpu"
+    assert "--scrape" in c["command"]
+    assert f"cluster_manager=prod" in c["command"]
+    # The rendered argv must actually parse: --non-interactive/--set are
+    # ROOT-parser flags, so they precede the 'operate' subcommand (a
+    # trailing --set crash-loops the pod with argparse exit 2).
+    from triton_kubernetes_tpu.cli.main import build_parser
+    args = build_parser().parse_args(c["command"][1:])
+    assert args.command == "operate" and args.non_interactive
+    assert args.overrides == ["cluster_manager=prod"]
+    assert args.scrape_urls == ["http://r0:8000/metrics"]
+    assert c["ports"][0]["containerPort"] == OPERATOR_PORT
+    assert "livenessProbe" in c and "readinessProbe" not in c
+    assert c["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert dep["spec"]["template"]["metadata"]["labels"][ROLE_LABEL] \
+        == "operator"
+    assert svc["spec"]["ports"][0]["port"] == OPERATOR_PORT
+    assert svc["spec"]["selector"][ROLE_LABEL] == "operator"
+
+
+def test_operator_port_matches_constants_pin():
+    """OPERATOR_PORT crosses the jax boundary like SERVE/ROUTE_PORT:
+    rendered jax-free here, bound at runtime by operator/server.py."""
+    from triton_kubernetes_tpu.constants import (
+        OPERATOR_PORT, ROUTE_PORT, SERVE_PORT)
+    assert len({SERVE_PORT, ROUTE_PORT, OPERATOR_PORT}) == 3
+    from triton_kubernetes_tpu.operator.server import OPERATOR_PORT as runtime
+    assert runtime == OPERATOR_PORT

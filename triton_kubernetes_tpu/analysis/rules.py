@@ -571,6 +571,52 @@ class ResumeDeterminism(Rule):
 
 
 # ---------------------------------------------------------------------------
+# TK8S110 — reconcile-loop determinism
+# ---------------------------------------------------------------------------
+
+@register
+class OperatorDeterminism(Rule):
+    """No wall-clock or global-RNG calls anywhere in ``operator/`` —
+    the reconcile loop must take time through its injectable
+    ``clock``/``sleep`` seams and randomness through seeded
+    ``random.Random``.
+
+    History: TK8S107 pins the same discipline for the journal/
+    checkpoint *commit paths* file by file; the operator extends the
+    stakes to a whole package — its tick journal, hysteresis counters,
+    cooldown stamps, and the chaos harness's preempt-mid-reconcile
+    replay are all deterministic functions of the injected clock, so a
+    naked ``time.time()`` anywhere in the loop breaks corpus replay the
+    same way it broke resume parity.
+    """
+
+    code = "TK8S110"
+    name = "operator-determinism"
+    summary = ("no naked time.time()/random.* anywhere in operator/ — "
+               "use the injectable clock/seeded-RNG seams")
+
+    SCOPES = (f"{PKG}/operator/",)
+    BANNED = ResumeDeterminism.BANNED
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.path.startswith(self.SCOPES):
+            return
+        imports = import_map(ctx.tree)
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = resolve_call(n, imports)
+            if callee in self.BANNED:
+                yield self.finding(
+                    ctx, n.lineno, n.col_offset,
+                    f"{callee}() in the reconcile loop — inject the "
+                    f"clock/sleep ctor seams or a seeded "
+                    f"random.Random instead; nondeterminism here "
+                    f"breaks tick-journal replay and the chaos "
+                    f"harness's preempt-mid-reconcile pins")
+
+
+# ---------------------------------------------------------------------------
 # TK8S108 — CLI/docs drift
 # ---------------------------------------------------------------------------
 

@@ -34,7 +34,8 @@ _NAME_RE = re.compile(r"^[a-z0-9][a-z0-9-]*$")
 _REQUIRED_KEYS = ("version", "kind", "name", "expect", "spec")
 _ALLOWED_KEYS = _REQUIRED_KEYS + ("invariant", "notes", "shrunk_from")
 _SPEC_KEYS = ("version", "seed", "profile", "parallelism", "op_latency",
-              "topology", "faults", "kill_fraction", "mutation")
+              "topology", "faults", "kill_fraction", "mutation",
+              "operator_preempt")
 
 
 class CorpusError(ValueError):

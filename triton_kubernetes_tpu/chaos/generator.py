@@ -47,26 +47,27 @@ PROFILES: Dict[str, Dict[str, Any]] = {
     "quick": {"clusters": (1, 2), "nodes": (0, 2), "tpu_weight": 0.0,
               "hosted_weight": 0.2, "parallelism": (1, 2),
               "fault_rules": (0, 2), "latency_weight": 0.15,
-              "kill_weight": 0.2},
+              "kill_weight": 0.2, "operator_weight": 0.0},
     # The full matrix: every provider family, widths 1/2/8, all fault
     # kinds, occasional latency models and kills.
     "default": {"clusters": (1, 3), "nodes": (0, 3), "tpu_weight": 0.25,
                 "hosted_weight": 0.25, "parallelism": (1, 2, 8),
                 "fault_rules": (0, 3), "latency_weight": 0.25,
-                "kill_weight": 0.3},
+                "kill_weight": 0.3, "operator_weight": 0.25},
     # TPU-pool DAGs with preemption/graceful-warning faults — the
     # apply -> preempt -> repair -> resume loop.
     "tpu": {"clusters": (1, 2), "nodes": (0, 1), "tpu_weight": 1.0,
             "hosted_weight": 0.0, "parallelism": (1, 2, 8),
             "fault_rules": (1, 3), "latency_weight": 0.25,
-            "kill_weight": 0.25},
+            "kill_weight": 0.25, "operator_weight": 0.4},
     # The long soak: TPU loops under a heavy simulated latency model so
     # every round advances the mutation clock by minutes of simulated
     # time (the sleeper is a recorder — no wall-clock cost).
     "soak": {"clusters": (1, 2), "nodes": (0, 1), "tpu_weight": 1.0,
              "hosted_weight": 0.0, "parallelism": (1, 2, 8),
              "fault_rules": (1, 2), "latency_weight": 1.0,
-             "latency_scale": 60.0, "kill_weight": 0.2},
+             "latency_scale": 60.0, "kill_weight": 0.2,
+             "operator_weight": 0.3},
 }
 
 # Ops each module family is known to issue — rules target these so a
@@ -207,6 +208,24 @@ def _draw_latency(rng: random.Random, prof: Dict[str, Any]
             "*": round(rng.uniform(0.05, 0.5) * scale, 6)}
 
 
+def _draw_operator(rng: random.Random, prof: Dict[str, Any],
+                   topo: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The preempt-mid-reconcile arm: a slice dies between a reconcile
+    tick's observe and its act. Drawn only for topologies that declare
+    a TPU slice; ``at_tick`` 1 hits the very first tick (the loop is
+    still converging the fresh apply), 2 hits steady state. Drawn LAST
+    so earlier profiles' streams are unchanged by this spec field."""
+    if rng.random() >= prof.get("operator_weight", 0.0):
+        return None
+    from ..executor.dagspec import tpu_slices
+
+    slices = tpu_slices(topo)
+    if not slices:
+        return None
+    row = rng.choice(slices)
+    return {"slice_id": row["slice_id"], "at_tick": rng.randint(1, 2)}
+
+
 def scenario_seed(base: int, i: int) -> int:
     """Per-scenario seed of sweep step ``i``. One shared formula: the
     sweep runner and the CI evidence coverage report must derive the
@@ -235,4 +254,5 @@ def generate_spec(seed: int, profile: str = "default") -> Dict[str, Any]:
                           if rng.random() < prof["kill_weight"] else None),
         "mutation": None,
     }
+    spec["operator_preempt"] = _draw_operator(rng, prof, topo)
     return spec

@@ -47,6 +47,7 @@ from ..executor.engine import (
     RetryPolicy,
     load_executor_state,
     modules_fingerprint,
+    save_executor_state,
     state_fingerprint,
 )
 from ..utils import metrics
@@ -54,7 +55,7 @@ from ..utils.logging import Logger
 from ..utils.trace import TraceCollector
 
 INVARIANTS = ("parity", "kill-resume", "trace-journal", "metrics-journal",
-              "repair", "destroy-clean")
+              "repair", "destroy-clean", "operator-converge")
 
 #: Deliberate invariant breakages (mutation testing of the harness
 #: itself): each key names a way run_scenario corrupts its own checking
@@ -228,7 +229,7 @@ def run_scenario(spec: Dict[str, Any], ns: str = "chaos") -> ScenarioResult:
                                 f"(choices: {MUTATIONS})")
     base = f"{ns}-s{spec.get('seed', 0)}"
     names = {"ref": f"{base}-ref", "par": f"{base}-par",
-             "kill": f"{base}-kill"}
+             "kill": f"{base}-kill", "op": f"{base}-op"}
     slept: List[float] = []
     recorder = slept.append
     try:
@@ -348,6 +349,12 @@ def _run_arms(spec: Dict[str, Any], res: ScenarioResult,
     if slices:
         _check_repair(spec, res, ref_doc, ref_ex, names["ref"])
 
+    # --- operator-converge: a slice preempted between a reconcile
+    # tick's observe and act phases is converged by the NEXT tick,
+    # exactly once, with zero orphaned pools (its own fresh arm).
+    if spec.get("operator_preempt") and slices:
+        _check_operator(spec, res, names["op"], recorder)
+
     # --- destroy-clean: targeted destroy of everything (par arm) leaves
     # zero orphans; whole-graph destroy (ref arm) deletes the state.
     par_est = load_executor_state(par_doc)
@@ -363,6 +370,90 @@ def _run_arms(spec: Dict[str, Any], res: ScenarioResult,
     _destroy_to_success(ref_ex, ref_doc)
     _check(res, "destroy-clean", _MEMORY_STATES.get(names["ref"]) is None,
            "whole-graph destroy did not delete the executor state")
+
+
+def _check_operator(spec: Dict[str, Any], res: ScenarioResult,
+                    op_name: str, recorder) -> None:
+    """The preempt-mid-reconcile arm (ISSUE 14): run the real
+    reconcile operator over a freshly-applied copy of the topology and
+    kill one slice through the ``between_observe_and_act`` seam — the
+    tick has already diffed a healthy world when the reclaim lands.
+
+    Converges iff, within ``at_tick + 3`` ticks: the loop reaches the
+    noop steady state, the journal shows the slice repaired EXACTLY
+    once (observing the same dead slice on two ticks must not run two
+    replacements), and the cloud carries no orphaned pools (every
+    desired pool exists, nothing is left preempted).
+    """
+    from ..operator import Reconciler
+
+    op = spec["operator_preempt"]
+    sid = str(op.get("slice_id", ""))
+    at_tick = int(op.get("at_tick", 1))
+    known = {row["slice_id"] for row in tpu_slices(spec["topology"])}
+    if sid not in known:
+        return  # shrunk-away pool: the arm has nothing to exercise
+    # Faults excluded on purpose: this arm isolates the mid-tick
+    # preemption; fault-plan interactions are the other arms' job.
+    doc = document_from_spec(spec["topology"], op_name,
+                             driver=_driver_dict(spec, with_faults=False))
+    ex = _executor(recorder, parallelism=spec["parallelism"])
+    _apply_to_success(ex, doc)
+    backend = MemoryBackend()
+    backend.persist(doc)
+
+    ticks = {"n": 0}
+    fired = {"tick": 0}
+
+    def clock() -> float:
+        ticks["n"] += 1
+        return float(ticks["n"])
+
+    def preempt_mid_tick(observed) -> None:
+        if fired["tick"] or len(reconciler.journal) + 1 < at_tick:
+            return
+        est = load_executor_state(doc)
+        sim = CloudSimulator(est.cloud)
+        sim.preempt_slice(sid)
+        est.cloud = sim.to_dict()
+        save_executor_state(doc, est)
+        fired["tick"] = len(reconciler.journal) + 1
+
+    reconciler = Reconciler(
+        backend, ex, op_name, clock=clock, sleep=recorder,
+        interval_s=0.0, log=lambda m: None,
+        between_observe_and_act=preempt_mid_tick)
+    bound = at_tick + 3
+    for _ in range(bound):
+        reconciler.tick()
+        # Converged only counts AFTER a post-preemption tick has had
+        # the chance to observe the dead slice — the firing tick's own
+        # noop is the stale world, not convergence.
+        if fired["tick"] and len(reconciler.journal) > fired["tick"] \
+                and reconciler.converged:
+            break
+    repairs = [
+        t for rec in reconciler.journal for t in rec.actions
+        if t.get("rule") == "replace-preempted-slice" and t.get("ok")]
+    repaired_slices = [s for t in repairs for s in t.get("targets", [])]
+    view = ex.cloud_view(doc)
+    still_preempted = sorted(view.preempted_slices())
+    # Orphan check: every desired pool module still exists in applied
+    # state and the cloud, and nothing undesired is left behind.
+    est = load_executor_state(doc)
+    desired = set(doc.to_dict().get("module", {}))
+    applied = set(est.modules)
+    ok = (bool(fired["tick"]) and reconciler.converged
+          and repaired_slices == [sid]
+          and not still_preempted
+          and desired == applied)
+    _check(res, "operator-converge", ok,
+           f"preempt {sid} mid-tick@{at_tick}: converged="
+           f"{reconciler.converged} after {len(reconciler.journal)} "
+           f"ticks (bound {bound}), repairs={repaired_slices}, "
+           f"still_preempted={still_preempted}, "
+           f"desired^applied={sorted(desired ^ applied)}")
+    res.stats["operator_ticks"] = len(reconciler.journal)
 
 
 def _check_repair(spec: Dict[str, Any], res: ScenarioResult, ref_doc,

@@ -77,6 +77,10 @@ def _candidates(spec: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
         s = copy.deepcopy(spec)
         s["kill_fraction"] = None
         yield s
+    if spec.get("operator_preempt") is not None:
+        s = copy.deepcopy(spec)
+        s["operator_preempt"] = None
+        yield s
     # 6. rebisect anchors toward the origin
     for i, rule in enumerate(spec.get("faults", [])):
         for anchor in ("at_op", "at_module_op"):

@@ -22,7 +22,7 @@ from typing import List, Optional
 
 from .. import __version__
 from ..backends import Backend, LocalBackend, ObjectStoreBackend
-from ..constants import KV_DTYPES, ROUTE_PORT, WEIGHT_DTYPES
+from ..constants import KV_DTYPES, OPERATOR_PORT, ROUTE_PORT, WEIGHT_DTYPES
 from ..backends.objectstore import DirObjectStore
 from ..backends.base import StateLockedError, StateNotFoundError
 from ..backends.gcs import GcsConfigError
@@ -372,6 +372,80 @@ def build_parser() -> argparse.ArgumentParser:
                        help="per-attempt timeout for proxied /generate "
                             "calls (default: 120)")
 
+    operate = sub.add_parser(
+        "operate",
+        help="run the reconcile operator: a continuous observe->diff->"
+             "act loop converging desired state against the cloud, with "
+             "an optional metrics-driven TPU autoscaler "
+             "(docs/guide/operator.md)")
+    operate.add_argument("--interval", type=float, default=10.0,
+                         metavar="SECONDS",
+                         help="seconds between reconcile ticks "
+                              "(default: 10)")
+    operate.add_argument("--max-ticks", type=int, default=None,
+                         metavar="N",
+                         help="stop after N ticks (default: run forever; "
+                              "CI and smoke runs bound themselves here)")
+    operate.add_argument("--until-converged", action="store_true",
+                         help="stop at the first tick that observes no "
+                              "drift and acts on nothing (one-shot "
+                              "convergence, the `apply`-like mode)")
+    operate.add_argument("--scrape", action="append", default=[],
+                         metavar="URL", dest="scrape_urls",
+                         help="serving-fleet /metrics endpoint to scrape "
+                              "each tick (repeatable); the autoscaler is "
+                              "blind — and holds — without at least one")
+    operate.add_argument("--autoscale-cluster", default=None,
+                         metavar="NAME",
+                         help="TPU cluster whose slice node pools the "
+                              "autoscaler may grow/drain (default: "
+                              "reconcile-only, no scaling)")
+    operate.add_argument("--ttft-slo", type=float, default=0.5,
+                         metavar="SECONDS",
+                         help="TTFT p99 SLO the autoscaler defends, "
+                              "quantiled over each tick's scrape window "
+                              "(default: 0.5)")
+    operate.add_argument("--queue-high", type=float, default=8.0,
+                         metavar="N",
+                         help="fleet queue depth treated as a breach "
+                              "(default: 8)")
+    operate.add_argument("--queue-low", type=float, default=1.0,
+                         metavar="N",
+                         help="fleet queue depth treated as calm — "
+                              "drain-eligible (default: 1)")
+    operate.add_argument("--min-pools", type=int, default=1, metavar="N",
+                         help="autoscaler floor on TPU pools (default: 1)")
+    operate.add_argument("--max-pools", type=int, default=4, metavar="N",
+                         help="autoscaler ceiling on TPU pools "
+                              "(default: 4)")
+    operate.add_argument("--scale-up-after", type=int, default=2,
+                         metavar="TICKS",
+                         help="consecutive breached ticks before a grow "
+                              "(hysteresis; default: 2)")
+    operate.add_argument("--scale-down-after", type=int, default=5,
+                         metavar="TICKS",
+                         help="consecutive calm ticks before a drain "
+                              "(hysteresis; default: 5)")
+    operate.add_argument("--cooldown", type=float, default=60.0,
+                         metavar="SECONDS",
+                         help="hold after any grow/drain so the fleet's "
+                              "response is judged, not the action "
+                              "(default: 60)")
+    operate.add_argument("--operator-host", default="127.0.0.1",
+                         metavar="ADDR",
+                         help="bind address for the operator's own "
+                              "/metrics+/healthz endpoint (default: "
+                              "127.0.0.1; manifests use 0.0.0.0)")
+    operate.add_argument("--operator-port", type=int, default=None,
+                         metavar="N",
+                         help=f"port for the operator endpoint "
+                              f"(default: no endpoint; manifests use "
+                              f"{OPERATOR_PORT}; 0 = ephemeral)")
+    operate.add_argument("--journal-out", default=None, metavar="FILE",
+                         help="append every reconcile tick's journal "
+                              "record as a JSON line (the decision "
+                              "audit trail CI evidence reads)")
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -638,6 +712,92 @@ def main(argv: Optional[List[str]] = None,
             resolver, logger)
         ctx = WorkflowContext(backend=be, executor=ex, resolver=resolver,
                               catalog=make_catalog(config))
+
+        if args.command == "operate":
+            from ..operator import (
+                Autoscaler,
+                AutoscalerConfig,
+                OperatorError,
+                OperatorHTTPServer,
+                Reconciler,
+            )
+            from ..utils import metrics as _metrics
+            from ..workflows.common import select_manager
+
+            _metrics.get_registry().register_catalog()
+            manager = select_manager(ctx)
+            autoscaler = None
+            if args.autoscale_cluster:
+                try:
+                    autoscaler = Autoscaler(AutoscalerConfig(
+                        ttft_slo_p99_s=args.ttft_slo,
+                        queue_high=args.queue_high,
+                        queue_low=args.queue_low,
+                        min_pools=args.min_pools,
+                        max_pools=args.max_pools,
+                        scale_up_after=args.scale_up_after,
+                        scale_down_after=args.scale_down_after,
+                        cooldown_s=args.cooldown))
+                except ValueError as e:
+                    logger.error(str(e), kind="ValueError")
+                    return 2
+            reconciler = Reconciler(
+                be, ex, manager,
+                autoscaler=autoscaler,
+                autoscale_cluster=args.autoscale_cluster,
+                metrics_sources=list(args.scrape_urls),
+                interval_s=args.interval,
+                journal_path=args.journal_out,
+                log=logger.info)
+            server = None
+            if args.operator_port is not None:
+                server = OperatorHTTPServer(
+                    reconciler, host=args.operator_host,
+                    port=args.operator_port).start()
+                # Heartbeat liveness: a tick completed recently (on the
+                # loop's own monotonic clock). A wedged observe/apply
+                # stops the heartbeat and /healthz flips 503, which is
+                # what the rendered Deployment's liveness probe
+                # restarts — without this a stuck loop would answer
+                # 200 forever while the fleet drifts. The staleness
+                # budget covers the worst HEALTHY tick: every scrape
+                # timing out sequentially (the blind-fleet case the
+                # autoscaler is designed to hold through) must not read
+                # as a dead loop. A first tick that never completes
+                # counts stale too (measured from startup).
+                import time as _time
+
+                stale_after = (max(60.0, 5 * args.interval)
+                               + len(args.scrape_urls)
+                               * reconciler.watcher.timeout_s)
+                started_at = _time.monotonic()
+                server.set_liveness(
+                    lambda: _time.monotonic()
+                    - (reconciler.last_tick_at
+                       if reconciler.last_tick_at is not None
+                       else started_at) < stale_after)
+                host, port = server.address
+                logger.info("operator endpoint",
+                            url=f"http://{host}:{port}")
+            logger.info("operating", manager=manager,
+                        autoscale_cluster=args.autoscale_cluster or "",
+                        interval_s=args.interval,
+                        scrapes=len(args.scrape_urls))
+            try:
+                ticks = reconciler.run(
+                    max_ticks=args.max_ticks,
+                    until_converged=args.until_converged)
+                print(f"operate: stopped after {ticks} ticks "
+                      f"(converged={reconciler.converged})")
+            except KeyboardInterrupt:
+                print("\nstopped", file=sys.stderr)
+            except OperatorError as e:
+                logger.error(str(e), kind="OperatorError")
+                return 1
+            finally:
+                if server is not None:
+                    server.close()
+            return 0
 
         if args.command == "create":
             result = {"manager": new_manager, "cluster": new_cluster,

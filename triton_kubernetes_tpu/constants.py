@@ -34,6 +34,12 @@ SERVE_PORT = 8000
 # share a pod network namespace during local runs.
 ROUTE_PORT = 8001
 
+# The operator endpoint port (`tk8s operate --operator-port`): rendered
+# into the operator Deployment/Service (topology/serving.py), bound by
+# operator/server.py. Distinct from the serving/router ports for the
+# same local-run reason.
+OPERATOR_PORT = 8002
+
 # Process exit codes — bounded and machine-readable so launchers, the
 # JobSet podFailurePolicy, and CI classify terminations without parsing
 # logs:

@@ -326,6 +326,12 @@ class CloudSimulator:
         # module-scoped fault sequences survive round-trips like the
         # global clock does.
         self.module_ops: Dict[str, int] = s.get("module_ops", {})
+        # Lifetime preemption count per slice id. The live "preempted"
+        # pool flag is consumed by repair (the replacement pool comes
+        # back clean), so without this record past reclaims are
+        # invisible — and the operator's preemption-risk weighting needs
+        # exactly that history. Serialized with the state.
+        self.preempt_history: Dict[str, int] = s.get("preempt_history", {})
         # One re-entrant lock makes every mutating operation atomic, so
         # the wavefront apply scheduler can drive modules concurrently:
         # clock tick + fault check + state mutation are indivisible.
@@ -422,6 +428,8 @@ class CloudSimulator:
             }
             if self.module_ops:
                 out["module_ops"] = self.module_ops
+            if self.preempt_history:
+                out["preempt_history"] = self.preempt_history
             if self.op_latency:
                 out["op_latency"] = self.op_latency
             if self.fault_plan is not None:
@@ -747,6 +755,9 @@ class CloudSimulator:
                     node["preempted"] = True
                     node["labels"] = {}
                     hit.append(node["name"])
+            if hit:
+                self.preempt_history[slice_id] = \
+                    self.preempt_history.get(slice_id, 0) + 1
         if not hit:
             raise CloudSimError(f"no node pool carries slice {slice_id!r}")
         metrics.counter("tk8s_cloudsim_preemptions_total").inc()

@@ -1,0 +1,47 @@
+"""The operator: a continuous reconcile loop + metrics-driven TPU
+autoscaler (ROADMAP item 1, docs/guide/operator.md).
+
+jax-free by construction — the operator runs on the provisioning side
+of the package split (it drives the executor and scrapes the serving
+fleet over HTTP; it never imports the workload stack). Time and
+randomness come only through injectable seams (lint rule TK8S110), so
+tests and the chaos harness drive simulated days of reconciling in
+milliseconds of wall time.
+"""
+
+from .autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    ScaleDecision,
+    apply_decision,
+)
+from .loop import OperatorError, Reconciler, ReconcileTick
+from .observe import (
+    MetricsWatcher,
+    ObservedState,
+    ServingSample,
+    observe,
+    tpu_pool_modules,
+)
+from .reconcile import RULES, ReconcileDelta, act, compute_delta
+from .server import OperatorHTTPServer
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "MetricsWatcher",
+    "ObservedState",
+    "OperatorError",
+    "OperatorHTTPServer",
+    "Reconciler",
+    "ReconcileDelta",
+    "ReconcileTick",
+    "RULES",
+    "ScaleDecision",
+    "ServingSample",
+    "act",
+    "apply_decision",
+    "compute_delta",
+    "observe",
+    "tpu_pool_modules",
+]

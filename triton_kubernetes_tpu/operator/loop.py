@@ -1,0 +1,284 @@
+"""The reconciler: a long-running observe -> diff -> act loop.
+
+One :meth:`Reconciler.tick` is the whole control loop, once:
+
+1. **observe** — re-read the desired document from the backend, the
+   applied/cloud state from the executor, and the serving fleet's
+   windowed metrics (:mod:`.observe`);
+2. **autoscale** — the policy (:mod:`.autoscaler`) may edit desired
+   state (add/remove a TPU pool module), turning a metrics signal into
+   ordinary drift;
+3. **diff** — compute the typed delta (:func:`~.reconcile.compute_delta`);
+4. **act** — run the reconcile rules over exactly that delta
+   (:func:`~.reconcile.act`), persisting the document after success.
+
+Every tick is journaled the way apply journals modules — a structured
+record of what was observed, decided, and done, kept in memory (bounded)
+and optionally appended as JSONL — and exported as ``tk8s_operator_*``
+metric families. Time comes only through the injected ``clock``/
+``sleep`` seams (lint rule TK8S110): tests and the chaos harness drive
+thousands of simulated ticks in milliseconds; ``tk8s operate`` injects
+the wall clock.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..state import StateDocument
+from ..utils import metrics
+from .autoscaler import Autoscaler, ScaleDecision, apply_decision, \
+    record_decision
+from .observe import MetricsWatcher, MetricsSource, ObservedState, observe
+from .reconcile import act, compute_delta
+
+#: Tick outcomes (journal/metrics vocabulary).
+OUTCOMES = ("noop", "acted", "failed")
+
+#: Sliding window (ticks with serving signal) over which the SLO
+#: attainment gauges are computed.
+SLO_WINDOW = 32
+
+
+class OperatorError(RuntimeError):
+    """The loop itself is misconfigured (no such manager/document) — as
+    opposed to a tick whose rules failed, which is journaled and
+    retried forever."""
+
+
+@dataclass
+class ReconcileTick:
+    """One journaled reconcile decision."""
+
+    tick: int
+    at: float                      # injected-clock timestamp
+    outcome: str = "noop"
+    duration_s: float = 0.0
+    observed: Dict[str, Any] = field(default_factory=dict)
+    decision: Optional[Dict[str, Any]] = None
+    delta: Dict[str, Any] = field(default_factory=dict)
+    actions: List[Dict[str, Any]] = field(default_factory=list)
+    error: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "tick": self.tick, "at": round(self.at, 6),
+            "outcome": self.outcome,
+            "duration_s": round(self.duration_s, 6),
+            "observed": self.observed, "delta": self.delta,
+            "actions": self.actions,
+        }
+        if self.decision is not None:
+            out["decision"] = self.decision
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class Reconciler:
+    """The operator: converges one manager's document forever.
+
+    ``autoscale_cluster`` names the TPU cluster whose pools the policy
+    may scale (None = reconcile-only; the rules still run). The
+    ``between_observe_and_act`` hook is the chaos seam — the harness
+    preempts a slice there to pin that a world that changes mid-tick is
+    converged by the *next* tick, exactly once, with no orphans.
+    """
+
+    def __init__(self, backend, executor, manager: str, *,
+                 autoscaler: Optional[Autoscaler] = None,
+                 autoscale_cluster: Optional[str] = None,
+                 metrics_sources: Optional[List[MetricsSource]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 interval_s: float = 10.0,
+                 journal_path: Optional[str] = None,
+                 journal_limit: int = 1000,
+                 log: Optional[Callable[[str], None]] = None,
+                 between_observe_and_act: Optional[
+                     Callable[[ObservedState], None]] = None):
+        from ..utils import get_logger
+
+        self.backend = backend
+        self.executor = executor
+        self.manager = manager
+        self.autoscaler = autoscaler
+        self.autoscale_cluster = autoscale_cluster
+        self.watcher = MetricsWatcher(metrics_sources or [])
+        self.clock = clock
+        self._sleep = sleep
+        self.interval_s = float(interval_s)
+        self.journal_path = journal_path
+        self.journal_limit = int(journal_limit)
+        self.journal: List[ReconcileTick] = []
+        self.log = log or (lambda m: get_logger().info(m))
+        self._between = between_observe_and_act
+        self._ticks = 0
+        # Injected-clock stamp of the last COMPLETED tick — the
+        # liveness heartbeat `tk8s operate` wires into /healthz (a
+        # wedged tick stops the heartbeat; a dead loop must probe 503,
+        # not keep answering 200 while the fleet drifts).
+        self.last_tick_at: Optional[float] = None
+        self._slo_hits: Dict[str, List[bool]] = {"ttft_p99": [],
+                                                 "queue_depth": []}
+
+    # ----------------------------------------------------------- document
+    def _load_doc(self) -> StateDocument:
+        states = self.backend.states()
+        if self.manager not in states:
+            raise OperatorError(
+                f"no state document {self.manager!r} in the backend "
+                f"(choices: {sorted(states)})")
+        doc = self.backend.state(self.manager)
+        doc.set_backend_config(
+            self.backend.executor_backend_config(self.manager))
+        return doc
+
+    # ---------------------------------------------------------------- SLO
+    def _track_slo(self, observed: ObservedState) -> None:
+        if self.autoscaler is None or not observed.serving.has_signal:
+            return
+        cfg = self.autoscaler.config
+        serving = observed.serving
+        hits = self._slo_hits
+        if serving.window_requests > 0:
+            hits["ttft_p99"].append(serving.ttft_p99_s <= cfg.ttft_slo_p99_s)
+        hits["queue_depth"].append(serving.queue_depth <= cfg.queue_high)
+        for slo, window in hits.items():
+            del window[:-SLO_WINDOW]
+            if window:
+                metrics.gauge("tk8s_operator_slo_attainment").set(
+                    sum(window) / len(window), slo=slo)
+
+    # --------------------------------------------------------------- tick
+    def tick(self) -> ReconcileTick:
+        """One observe -> autoscale -> diff -> act cycle. Never raises
+        for rule failures (journaled, retried next tick); raises
+        :class:`OperatorError` only for setup problems."""
+        self._ticks += 1
+        t0 = self.clock()
+        record = ReconcileTick(tick=self._ticks, at=t0)
+        doc = self._load_doc()
+        observed = observe(doc, self.executor, self.watcher)
+        record.observed = {
+            "applied_modules": len(observed.applied_modules),
+            "preempted": sorted(observed.preempted),
+            "queue_depth": observed.serving.queue_depth,
+            "ttft_p99_s": round(observed.serving.ttft_p99_s, 6),
+            "window_requests": observed.serving.window_requests,
+            "sources_ok": observed.serving.sources_ok,
+            "last_apply_status": observed.last_apply_status,
+        }
+        self._track_slo(observed)
+
+        decision: Optional[ScaleDecision] = None
+        pools_before = 0
+        if self.autoscaler is not None and self.autoscale_cluster:
+            pools = observed.tpu_pools.get(self.autoscale_cluster, [])
+            pools_before = len(pools)
+            if pools:
+                decision = self.autoscaler.decide(
+                    observed, pools, self.autoscale_cluster, t0)
+                record_decision(decision)
+                record.decision = decision.to_dict()
+                changed = apply_decision(doc, decision, pools)
+                if changed is not None:
+                    self.log(f"autoscaler: {decision.direction} "
+                             f"{changed} ({decision.reason})")
+                    # The document changed: re-plan (no re-scrape — a
+                    # second scrape would double-count the windowed
+                    # serving deltas) so the delta sees the new/removed
+                    # pool as ordinary drift.
+                    observed = observe(doc, self.executor, None)
+
+        delta = compute_delta(observed)
+        record.delta = delta.to_dict()
+
+        if self._between is not None:
+            # Chaos seam: the world changes between diff and act.
+            self._between(observed)
+
+        if delta.empty:
+            record.outcome = "noop"
+        else:
+            outcomes = act(self.backend, self.executor, self.manager, doc,
+                           delta)
+            record.actions = [o.to_dict() for o in outcomes]
+            failed = [o for o in outcomes if not o.ok]
+            record.outcome = "failed" if failed else "acted"
+            if failed:
+                record.error = failed[0].error
+                self.log(f"reconcile tick {self._ticks}: rule "
+                         f"{failed[0].rule} failed: {failed[0].error}")
+        if decision is not None:
+            landed = True
+            if decision.direction in ("grow", "drain"):
+                # Cooldown/hysteresis arm only on a LANDED scale
+                # action — landed meaning the edited desired document
+                # persisted. Any successful converge/drain rule
+                # persists the whole doc, so a drain whose
+                # converge-drift persisted the deletion but whose
+                # prune then failed still counts (the leftover
+                # resources are ordinary to_prune drift next tick —
+                # re-deciding would shed a second pool off one calm
+                # trend). A tick where no rule persisted leaves the
+                # counters standing so the next tick re-decides
+                # immediately.
+                landed = any(
+                    a.get("ok") and a.get("rule") in
+                    ("converge-drift", "drain-orphans")
+                    for a in record.actions)
+                self.autoscaler.record_actuation(landed, t0)
+            # Pool-count gauge from what actually holds: the decided
+            # count only once the apply landed, else the pre-decision
+            # count (the persisted document never changed).
+            metrics.gauge("tk8s_operator_pools").set(
+                decision.pools if landed else pools_before,
+                cluster=self.autoscale_cluster)
+        record.duration_s = self.clock() - t0
+        self.last_tick_at = self.clock()
+        metrics.counter("tk8s_operator_reconciles_total").inc(
+            outcome=record.outcome)
+        metrics.histogram(
+            "tk8s_operator_reconcile_duration_seconds").observe(
+            record.duration_s)
+        self._journal(record)
+        return record
+
+    # ------------------------------------------------------------ journal
+    def _journal(self, record: ReconcileTick) -> None:
+        self.journal.append(record)
+        del self.journal[:-self.journal_limit]
+        if self.journal_path:
+            with open(self.journal_path, "a") as f:
+                json.dump(record.to_dict(), f, sort_keys=True)
+                f.write("\n")
+
+    # ---------------------------------------------------------------- run
+    @property
+    def converged(self) -> bool:
+        """True when the most recent tick observed no drift and acted
+        on nothing (the steady state a healthy fleet sits in)."""
+        return bool(self.journal) and self.journal[-1].outcome == "noop"
+
+    def run(self, max_ticks: Optional[int] = None,
+            until_converged: bool = False,
+            should_stop: Optional[Callable[[], bool]] = None) -> int:
+        """Tick until a bound is hit: ``max_ticks`` ticks, convergence
+        (``until_converged``), or ``should_stop()`` (the CLI's SIGINT
+        flag). Sleeps ``interval_s`` between ticks through the injected
+        sleeper. Returns the number of ticks taken."""
+        taken = 0
+        while True:
+            if should_stop is not None and should_stop():
+                return taken
+            self.tick()
+            taken += 1
+            if max_ticks is not None and taken >= max_ticks:
+                return taken
+            if until_converged and self.converged:
+                return taken
+            self._sleep(self.interval_s)

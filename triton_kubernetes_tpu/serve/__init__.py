@@ -35,6 +35,7 @@ from importlib import import_module
 # never drags jax in.
 from ..constants import SERVE_PORT
 from .loadgen import (
+    DiurnalSchedule,
     PoissonSchedule,
     RepetitionSchedule,
     SessionSchedule,
@@ -68,6 +69,7 @@ __all__ = [
     "SERVE_PORT",
     "ServeHTTPServer",
     "BlockAllocator",
+    "DiurnalSchedule",
     "FinishedRequest",
     "HashRing",
     "ManualClock",

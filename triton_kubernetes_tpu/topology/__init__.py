@@ -27,6 +27,8 @@ from .labels import (
 )
 from .jobset import render_headless_service, render_jobset
 from .serving import (
+    render_operator_deployment,
+    render_operator_service,
     render_router_deployment,
     render_router_service,
     render_serving_deployment,
@@ -45,6 +47,8 @@ __all__ = [
     "parse_accelerator",
     "render_headless_service",
     "render_jobset",
+    "render_operator_deployment",
+    "render_operator_service",
     "render_router_deployment",
     "render_router_service",
     "render_serving_deployment",

@@ -26,7 +26,7 @@ from .slices import SliceSpec
 # Single-sourced with serve.server.SERVE_PORT / serve.router's bind
 # port from the dependency-free constants module (see module docstring;
 # lint rule TK8S104).
-from ..constants import ROUTE_PORT, SERVE_PORT
+from ..constants import OPERATOR_PORT, ROUTE_PORT, SERVE_PORT
 
 APP_LABEL = "serve.tk8s.io/name"
 MODEL_LABEL = "serve.tk8s.io/model"
@@ -216,5 +216,96 @@ def render_router_service(
             "selector": {APP_LABEL: name, ROLE_LABEL: "router"},
             "ports": [{"name": "http", "port": ROUTE_PORT,
                        "targetPort": ROUTE_PORT}],
+        },
+    }
+
+
+def default_operate_command(manager: str,
+                            scrape_urls: Optional[List[str]] = None,
+                            port: int = OPERATOR_PORT) -> List[str]:
+    """The operator container command: the CLI's ``operate`` verb with
+    its /metrics endpoint bound to all interfaces, scraping the fleet's
+    per-replica endpoints. ``--non-interactive`` and ``--set`` are
+    ROOT-parser flags and must precede the subcommand — and a pod has
+    no TTY to answer prompts on."""
+    cmd = ["triton-kubernetes-tpu", "--non-interactive",
+           "--set", f"cluster_manager={manager}", "operate",
+           "--operator-host", "0.0.0.0", "--operator-port", str(port)]
+    for url in scrape_urls or []:
+        cmd += ["--scrape", url]
+    return cmd
+
+
+def render_operator_deployment(
+    name: str,
+    image: str,
+    manager: str,
+    scrape_urls: Optional[List[str]] = None,
+    namespace: str = "default",
+    env: Optional[Dict[str, str]] = None,
+    command: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """The reconcile operator Deployment.
+
+    Exactly one replica, by design: the reconcile loop is a single
+    writer against the state document (two operators would race the
+    backend's state lock every tick and fight over scale decisions) —
+    ``replicas: 1`` plus Recreate strategy is the poor-k8s leader
+    election that matches the backend's locking model. CPU-only, no
+    node selector: like the router, the operator is control-plane
+    plumbing and schedules anywhere.
+    """
+    labels = {APP_LABEL: name, ROLE_LABEL: "operator"}
+    container = {
+        "name": "operator",
+        "image": image,
+        "command": command or default_operate_command(manager, scrape_urls),
+        "env": [{"name": k, "value": v} for k, v in sorted(
+            (env or {}).items())],
+        "ports": [{"containerPort": OPERATOR_PORT, "name": "http"}],
+        # Liveness (not readiness): /healthz goes 503 when the
+        # reconcile loop thread died, and restarting the pod is exactly
+        # the fix — the loop is the workload, there is no traffic to
+        # park away.
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": OPERATOR_PORT},
+            "initialDelaySeconds": 10,
+            "periodSeconds": 10,
+        },
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": dict(labels)},
+        "spec": {
+            "replicas": 1,
+            "strategy": {"type": "Recreate"},
+            "selector": {"matchLabels": {APP_LABEL: name,
+                                         ROLE_LABEL: "operator"}},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {"containers": [container]},
+            },
+        },
+    }
+
+
+def render_operator_service(
+    name: str,
+    namespace: str = "default",
+) -> Dict[str, Any]:
+    """A ClusterIP over the operator pod — the Prometheus scrape target
+    for the ``tk8s_operator_*`` families."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": {APP_LABEL: name, ROLE_LABEL: "operator"}},
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {APP_LABEL: name, ROLE_LABEL: "operator"},
+            "ports": [{"name": "http", "port": OPERATOR_PORT,
+                       "targetPort": OPERATOR_PORT}],
         },
     }
