@@ -1,0 +1,460 @@
+#!/usr/bin/env python
+"""Produce the fleet-tracing evidence artifact
+(docs/ci-evidence/trace-<tag>.json + fleet-trace-<tag>.json): the
+ISSUE 15 acceptance gates, measured.
+
+**A. Traced fleet run.** The multi-turn session trace through
+`RouterHTTPServer` over two live `ServeHTTPServer` replicas, every
+process writing trace JSONL (`utils/trace.TraceWriter`), with a real
+`Reconciler` ticking against the fleet's metrics and tracing its own
+reconcile spans. Gates:
+
+- **span completeness** — every routed request's trace id appears as a
+  `route.place` span in the router's file AND as a complete
+  `serve.submitted -> serve.admitted -> serve.first_token ->
+  serve.finish` lifecycle in a replica's file (100%, both replicas
+  serving);
+- **phase attribution** — every response's
+  `queue_s + prefill_s + decode_s + recompute_s` equals its `e2e_s`
+  within EPSILON, and for unpreempted requests `queue_s + prefill_s`
+  equals the reported TTFT within EPSILON;
+- **exemplar resolution** — the TTFT histogram's p99 exemplar
+  (`Histogram.exemplar_for_quantile`) names a trace id that resolves
+  through a replica's flight recorder to a full lifecycle whose phases
+  sum to its e2e (the "why is p99 burning" chain, mechanical);
+- **merged timeline** — `merge_trace_files` over all four JSONL files
+  (router + 2 replicas + operator) validates
+  (`validate_chrome_trace == []`) and lands as the
+  `fleet-trace-<tag>.json` artifact — the one-view Perfetto answer.
+
+**B. Overhead A/B.** Closed decode bursts engine-direct, tracing-on
+(flight recorder + JSONL writer) vs tracing-off vs a second identical
+tracing-off null arm, interleaved and paired per rep: the median paired
+per-token overhead must be <= 3% beyond the null arm's (see
+:func:`phase_overhead` for why each piece exists).
+
+Latency figures vary run to run; token counts, outputs, trace ids, and
+span completeness are deterministic.
+
+Usage: python scripts/ci/trace_evidence.py [tag]  (default: local)
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from triton_kubernetes_tpu.backends import MemoryBackend  # noqa: E402
+from triton_kubernetes_tpu.executor import LocalExecutor  # noqa: E402
+from triton_kubernetes_tpu.executor.dagspec import (  # noqa: E402
+    document_from_spec,
+)
+from triton_kubernetes_tpu.models import get_config, init_params  # noqa: E402
+from triton_kubernetes_tpu.operator import Reconciler  # noqa: E402
+from triton_kubernetes_tpu.serve import (  # noqa: E402
+    PoissonSchedule,
+    Request,
+    RouterHTTPServer,
+    ServeEngine,
+    ServeHTTPServer,
+    SessionSchedule,
+)
+from triton_kubernetes_tpu.utils import metrics  # noqa: E402
+from triton_kubernetes_tpu.utils.logging import Logger  # noqa: E402
+from triton_kubernetes_tpu.utils.trace import (  # noqa: E402
+    FlightRecorder,
+    TraceWriter,
+    merge_trace_files,
+    read_trace_jsonl,
+    validate_chrome_trace,
+)
+
+EPSILON = 1e-6
+GATE_OVERHEAD = 0.03        # on-vs-off per-token cost <= 3% beyond null
+NUM_SESSIONS = 10
+TURNS = 2
+MAX_NEW = 6
+AB_REPS = 30                # paired bursts per overhead arm
+AB_BURST_N = 12             # closed-loop requests per burst
+AB_MAX_NEW = 12             # decode tokens per request: ~0.3s bursts,
+#                             long enough to average sub-second noise
+#                             inside the burst, short enough that a rep
+#                             (all three arms) fits inside one epoch of
+#                             the slower drift the pairing cancels
+
+LIFECYCLE = ("serve.submitted", "serve.admitted", "serve.first_token",
+             "serve.finish")
+
+TOPO = {"manager": {"provider": "bare-metal", "name": "m1"},
+        "clusters": [{"provider": "gcp-tpu", "name": "ml",
+                      "pools": [{"name": "pool0",
+                                 "accelerator": "v5e-16"}]}]}
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def make_engine(params, cfg, **over):
+    kw = dict(block_size=4, num_blocks=96, max_batch=4, max_model_len=64)
+    kw.update(over)
+    return ServeEngine(params, cfg, **kw)
+
+
+def phase_fleet(params, cfg, out_dir, tag):
+    """Phase A: the traced router + 2-replica + operator run."""
+    metrics.configure()
+    paths = {
+        "router": os.path.join(out_dir, f"trace-router-{tag}.jsonl"),
+        "replica-0": os.path.join(out_dir, f"trace-replica0-{tag}.jsonl"),
+        "replica-1": os.path.join(out_dir, f"trace-replica1-{tag}.jsonl"),
+        "operator": os.path.join(out_dir, f"trace-operator-{tag}.jsonl"),
+    }
+    srvs = []
+    writers = []
+    for i in range(2):
+        writer = TraceWriter(paths[f"replica-{i}"], f"replica-{i}")
+        writers.append(writer)
+        srvs.append(ServeHTTPServer(
+            make_engine(params, cfg,
+                        flight=FlightRecorder(writer=writer))).start())
+    router_writer = TraceWriter(paths["router"], "router")
+    operator_writer = TraceWriter(paths["operator"], "operator")
+    writers += [router_writer, operator_writer]
+
+    sched = SessionSchedule(rate=30.0, num_sessions=NUM_SESSIONS,
+                            turns=TURNS, vocab_size=cfg.vocab_size,
+                            prefix_len=12, turn_len_range=(2, 5),
+                            think_time=0.05, max_new_tokens=MAX_NEW,
+                            seed=15)
+    responses = {}
+    try:
+        with RouterHTTPServer(
+                [s.url for s in srvs], health_interval_s=0.5,
+                spill_threshold=8, trace_seed=7,
+                trace=router_writer) as router:
+            # The operator reconciles (and traces) WHILE load flows:
+            # its ticks land between the serving spans on the merged
+            # timeline. The doc converges on tick 1, then noops.
+            doc = document_from_spec(TOPO, "trace-fleet")
+            backend = MemoryBackend()
+            backend.persist(doc)
+            import io as _io
+
+            reconciler = Reconciler(
+                backend, LocalExecutor(
+                    log=lambda m: None,
+                    logger=Logger(stream=_io.StringIO())),
+                "trace-fleet",
+                metrics_sources=[lambda: metrics.get_registry()
+                                 .render_prometheus()],
+                interval_s=0.2,
+                trace=operator_writer,
+                log=lambda m: None)
+            op_thread = threading.Thread(
+                target=lambda: reconciler.run(max_ticks=4), daemon=True)
+            op_thread.start()
+
+            t0 = time.perf_counter()
+
+            def fire(tr):
+                delay = tr.at - (time.perf_counter() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                responses[tr.request_id] = _post(router.url, {
+                    "tokens": tr.tokens,
+                    "max_new_tokens": tr.max_new_tokens,
+                    "session_id": tr.session_id})
+
+            threads = [threading.Thread(target=fire, args=(tr,))
+                       for tr in sched]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            op_thread.join(timeout=30)
+
+            # ---- exemplar resolution (while the engines are alive)
+            ttft = metrics.get_registry().histogram(
+                "tk8s_serve_ttft_seconds")
+            exemplar = ttft.exemplar_for_quantile(0.99)
+            resolved = None
+            if exemplar is not None:
+                for s in srvs:
+                    resolved = s.engine.flight.lookup(exemplar["trace_id"])
+                    if resolved is not None:
+                        break
+    finally:
+        for s in srvs:
+            s.stop()
+        for w in writers:
+            w.close()
+
+    # ---- span completeness across the per-process files
+    _, route_events = read_trace_jsonl(paths["router"])
+    placed = {}
+    for e in route_events:
+        if e["name"] == "route.place":
+            placed.setdefault(e["trace"], []).append(e["fields"])
+    replica_spans = {}
+    replicas_serving = 0
+    for i in range(2):
+        _, events = read_trace_jsonl(paths[f"replica-{i}"])
+        if any(e["name"] == "serve.finish" for e in events):
+            replicas_serving += 1
+        for e in events:
+            if e.get("trace"):
+                replica_spans.setdefault(
+                    e["trace"], set()).add(e["name"])
+
+    complete = 0
+    problems = []
+    for rid, resp in responses.items():
+        tid = resp.get("trace_id")
+        if not tid:
+            problems.append(f"{rid}: response carries no trace_id")
+            continue
+        if tid not in placed:
+            problems.append(f"{rid}: no route.place span for {tid}")
+            continue
+        missing = set(LIFECYCLE) - replica_spans.get(tid, set())
+        if missing:
+            problems.append(f"{rid}: replica spans missing {sorted(missing)}")
+            continue
+        complete += 1
+
+    # ---- phase attribution: sums == e2e; TTFT decomposition
+    phase_ok = 0
+    for rid, resp in responses.items():
+        phases = resp.get("phases") or {}
+        total = sum(phases.values())
+        if abs(total - resp.get("e2e_s", -1)) > EPSILON:
+            problems.append(
+                f"{rid}: phases sum {total} != e2e {resp.get('e2e_s')}")
+            continue
+        if resp["preemptions"] == 0 and abs(
+                phases["queue_s"] + phases["prefill_s"]
+                - resp["ttft_s"]) > EPSILON:
+            problems.append(
+                f"{rid}: queue+prefill != ttft ({phases}, "
+                f"{resp['ttft_s']})")
+            continue
+        phase_ok += 1
+
+    # ---- merged fleet timeline
+    merged = merge_trace_files([paths["router"], paths["replica-0"],
+                                paths["replica-1"], paths["operator"]])
+    schema_problems = validate_chrome_trace(merged)
+    fleet_path = os.path.join(out_dir, f"fleet-trace-{tag}.json")
+    with open(fleet_path, "w") as f:
+        json.dump(merged, f, indent=2, sort_keys=True)
+        f.write("\n")
+    op_ticks = sum(1 for e in merged["traceEvents"]
+                   if e.get("name") == "operator.tick")
+
+    report = {
+        "requests": len(sched),
+        "responses": len(responses),
+        "spans_complete": complete,
+        "phase_sums_ok": phase_ok,
+        "replicas_serving": replicas_serving,
+        "placement_reasons": sorted({f["reason"]
+                                     for fs in placed.values()
+                                     for f in fs}),
+        "operator_ticks_on_timeline": op_ticks,
+        "merged_events": len(merged["traceEvents"]),
+        "merged_schema_problems": schema_problems,
+        "fleet_trace": os.path.basename(fleet_path),
+        "p99_exemplar": exemplar,
+        "p99_exemplar_resolved": resolved is not None,
+        "p99_exemplar_phases": (
+            {k: round(v, 6) for k, v in resolved.phases.items()}
+            if resolved is not None else None),
+        "p99_exemplar_phases_sum_e2e": (
+            resolved is not None
+            and abs(sum(resolved.phases.values()) - resolved.e2e_s)
+            <= EPSILON),
+        "problems": problems,
+    }
+    return report
+
+
+def phase_overhead(params, cfg, out_dir, tag):
+    """Phase B: tracing-on vs tracing-off engine-direct A/B.
+
+    Three design choices, each against a measured noise source:
+
+    * **closed bursts** — all requests land at t=0 and the engine
+      drains flat out, so the wall clock sees only the tick path the
+      recorder instruments (an open-loop schedule would put `time.sleep`
+      jitter inside a measurement whose whole budget is 3%);
+    * **median of PAIRED ratios over many short interleaved bursts** —
+      each rep runs all three arms back to back (order rotating) and
+      contributes one on/off ratio, so epoch-scale drift — the dominant
+      noise on the virtualized runners this repo sees, where wall time
+      between *identical* arms swings 5x and per-arm minima never
+      converge (/proc/stat is zeroed there) — cancels within the pair,
+      and the median ignores the burst-level spikes that remain;
+    * **a null arm** — a second identical untraced engine, paired and
+      estimated the same way, calibrates what the box measures between
+      two engines that differ by NOTHING. The gate is
+      `overhead - null <= 3%`: tracing may not cost more than 3%
+      beyond the box's own resolution. On a quiet machine null ~ 0 and
+      this is exactly the plain 3% gate.
+    """
+    import gc
+
+    import tempfile
+
+    metrics.configure()
+    # The JSONL output itself is scratch (no gate reads it; nothing
+    # uploads it) but the "on" arm must pay the real writer cost, so
+    # it lands in a tempdir instead of polluting docs/ci-evidence.
+    flight = FlightRecorder(
+        limit=4096,
+        writer=TraceWriter(os.path.join(
+            tempfile.mkdtemp(prefix="tk8s-trace-ab-"),
+            f"trace-ab-{tag}.jsonl"), "ab"))
+    engines = {"off_a": make_engine(params, cfg),
+               "off_b": make_engine(params, cfg),
+               "on": make_engine(params, cfg, flight=flight)}
+    for engine in engines.values():
+        engine.submit(Request("warm", [1, 2, 3], 2))
+        engine.run_until_idle()
+
+    def burst(arm, rep):
+        engine = engines[arm]
+        reqs = [Request(f"{arm}-{rep}-{i}", [1 + i % 7, 2, 3, 4],
+                        AB_MAX_NEW, seed=i) for i in range(AB_BURST_N)]
+        # GC pauses inside a dispatch-heavy burst are a leading noise
+        # source; collect beforehand, keep the collector out of the
+        # measured window.
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            for r in reqs:
+                engine.submit(r)
+            done = engine.run_until_idle()
+            wall = time.perf_counter() - t0
+        finally:
+            gc.enable()
+        outputs = {d.request_id.split("-", 2)[2]: d.tokens for d in done}
+        return wall / sum(len(d.tokens) for d in done), outputs
+
+    for arm in engines:  # one unmeasured warm burst each (cold ~2x)
+        burst(arm, "wu")
+    per_token = {arm: [] for arm in engines}
+    outputs = {}
+    arms = list(engines)
+    for rep in range(AB_REPS):
+        # Rotate the within-rep order so slow epochs and any
+        # monotonic drift tax every arm equally across the run.
+        for arm in arms[rep % len(arms):] + arms[:rep % len(arms)]:
+            cost, outs = burst(arm, rep)
+            per_token[arm].append(cost)
+            outputs.setdefault(arm, outs)
+    flight.writer.close()
+
+    def median(xs):
+        s = sorted(xs)
+        mid = len(s) // 2
+        return s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+    # Paired per-rep ratios against the same off_a burst: the pair
+    # shares its epoch, so box-level drift divides out.
+    overhead = median(on / off for on, off in
+                      zip(per_token["on"], per_token["off_a"])) - 1.0
+    null = median(b / a for b, a in
+                  zip(per_token["off_b"], per_token["off_a"])) - 1.0
+    return {
+        "burst_requests": AB_BURST_N,
+        "reps_per_arm": AB_REPS,
+        "tokens_per_sec_tracing_off": round(
+            1.0 / median(per_token["off_a"]), 2),
+        "tokens_per_sec_tracing_on": round(
+            1.0 / median(per_token["on"]), 2),
+        "overhead_fraction": round(overhead, 4),
+        "null_fraction": round(null, 4),
+        "overhead_beyond_null": round(overhead - null, 4),
+        "outputs_identical_across_arms": (
+            outputs["on"] == outputs["off_a"] == outputs["off_b"]),
+    }
+
+
+def main(argv):
+    tag = argv[1] if len(argv) > 1 else "local"
+    out_dir = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        os.pardir, os.pardir, "docs", "ci-evidence"))
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"trace-evidence-{tag}.json")
+
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    fleet = phase_fleet(params, cfg, out_dir, tag)
+    overhead = phase_overhead(params, cfg, out_dir, tag)
+
+    evidence = {"tag": tag, "config": cfg.name, "epsilon": EPSILON,
+                "fleet": fleet, "overhead": overhead}
+    with open(out_path, "w") as f:
+        json.dump(evidence, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"trace evidence written: {out_path}")
+    print(json.dumps({k: fleet[k] for k in
+                      ("requests", "spans_complete", "phase_sums_ok",
+                       "replicas_serving", "p99_exemplar_resolved")}))
+    print(json.dumps(overhead))
+
+    failures = []
+    n = fleet["requests"]
+    if fleet["responses"] != n:
+        failures.append(f"only {fleet['responses']}/{n} responses")
+    if fleet["spans_complete"] != n:
+        failures.append(
+            f"span completeness {fleet['spans_complete']}/{n}: "
+            + "; ".join(fleet["problems"][:3]))
+    if fleet["phase_sums_ok"] != n:
+        failures.append(
+            f"phase attribution {fleet['phase_sums_ok']}/{n}: "
+            + "; ".join(fleet["problems"][:3]))
+    if fleet["replicas_serving"] != 2:
+        failures.append("a replica served no traffic — the fleet claim "
+                        "degenerated to one process")
+    if fleet["merged_schema_problems"]:
+        failures.append(
+            f"merged timeline invalid: {fleet['merged_schema_problems'][:3]}")
+    if fleet["operator_ticks_on_timeline"] < 1:
+        failures.append("no operator.tick span on the merged timeline")
+    if not fleet["p99_exemplar_resolved"]:
+        failures.append("p99 TTFT exemplar did not resolve to a trace")
+    if not fleet["p99_exemplar_phases_sum_e2e"]:
+        failures.append("p99 exemplar trace's phases do not sum to e2e")
+    if not overhead["outputs_identical_across_arms"]:
+        failures.append("tracing changed outputs")
+    if overhead["overhead_beyond_null"] > GATE_OVERHEAD:
+        failures.append(
+            f"tracing overhead {overhead['overhead_fraction']:.1%} "
+            f"(null {overhead['null_fraction']:.1%}) exceeds the "
+            f"{GATE_OVERHEAD:.0%}-beyond-null gate")
+    for f_ in failures:
+        print(f"FAIL: {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

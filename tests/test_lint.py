@@ -41,10 +41,11 @@ def hits(findings, code):
 
 # ---------------------------------------------------------------- registry
 
-def test_at_least_ten_active_rules():
+def test_at_least_eleven_active_rules():
     codes = {r.code for r in RULES}
-    assert len(codes) >= 10
-    assert codes == {f"TK8S10{i}" for i in range(1, 10)} | {"TK8S110"}
+    assert len(codes) >= 11
+    assert codes == ({f"TK8S10{i}" for i in range(1, 10)}
+                     | {"TK8S110", "TK8S111"})
 
 
 # ----------------------------------------------------------- TK8S101
@@ -400,6 +401,67 @@ def test_tk8s110_outside_operator_is_not_its_scope(tmp_path):
     })
     findings, _ = lint_project(root)
     assert hits(findings, "TK8S110") == []
+
+
+# ----------------------------------------------------------- TK8S111
+
+SPAN_TRACE_MODULE = """\
+    SPAN_CATALOG = {
+        "serve.documented": "a documented span",
+        "serve.undocumented": "declared but missing from the docs table",
+    }
+"""
+
+SPAN_DOCS = (
+    "### Span catalog\n"
+    "| span | meaning |\n"
+    "|---|---|\n"
+    "| `serve.documented` | a documented span |\n"
+    "| `serve.ghost` | only the docs know this one |\n"
+    "| `tk8s_serve_ttft_seconds` | a metrics row, not a span row |\n")
+
+
+def test_tk8s111_three_drift_directions(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/trace.py": SPAN_TRACE_MODULE,
+        "triton_kubernetes_tpu/serve/x.py": """\
+            def f(rec, rid, t):
+                rec.event(rid, "serve.documented", t)
+                rec.event(rid, "serve.rogue", t, pages=1)
+        """,
+        "docs/guide/observability.md": SPAN_DOCS,
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S111")
+    # rogue emission, undocumented SPAN_CATALOG entry, ghost docs row —
+    # the documented emission and the metrics-table row are NOT
+    # findings.
+    assert ("triton_kubernetes_tpu/serve/x.py", 3) in got
+    assert ("triton_kubernetes_tpu/utils/trace.py", 3) in got
+    assert ("docs/guide/observability.md", 5) in got
+    assert len(got) == 3
+
+
+def test_tk8s111_writer_style_first_arg_and_scope(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/trace.py": SPAN_TRACE_MODULE,
+        # TraceWriter-style emission: the name is the FIRST argument.
+        "triton_kubernetes_tpu/operator/x.py": """\
+            def tick(tw, t):
+                tw.event("operator.rogue", t, outcome="noop")
+        """,
+        # Outside serve//operator/: not this rule's scope (the CLI's
+        # threading.Event().set() world must not be mistaken for spans).
+        "triton_kubernetes_tpu/workflows/y.py": """\
+            def f(tw, t):
+                tw.event("workflow.unscoped", t)
+        """,
+        "docs/guide/observability.md": SPAN_DOCS,
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S111")
+    assert ("triton_kubernetes_tpu/operator/x.py", 2) in got
+    assert not any(p.endswith("workflows/y.py") for p, _ in got)
 
 
 # ------------------------------------------------- suppression round trip

@@ -192,6 +192,70 @@ def test_label_values_are_escaped():
     assert_valid_prometheus(text)
 
 
+def test_histogram_exemplars_per_bucket_last_wins():
+    reg = metrics.get_registry()
+    h = reg.histogram("t_ex_seconds", "latency", (), buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="t-a")
+    h.observe(0.07, exemplar="t-b")     # same bucket: last wins
+    h.observe(0.5)                      # no exemplar: bucket untouched
+    h.observe(5.0, exemplar="t-slow")   # the +Inf bucket
+    ex = h.exemplars()
+    assert ex["0.1"] == {"trace_id": "t-b", "value": 0.07}
+    assert "1" not in ex
+    assert ex["+Inf"] == {"trace_id": "t-slow", "value": 5.0}
+
+
+def test_exemplar_for_quantile_names_the_offending_trace():
+    reg = metrics.get_registry()
+    h = reg.histogram("t_q_seconds", "latency", (),
+                      buckets=(0.1, 0.5, 1.0))
+    for _ in range(98):
+        h.observe(0.05, exemplar="t-fast")
+    h.observe(0.4, exemplar="t-mid")
+    h.observe(0.9, exemplar="t-tail")
+    got = h.exemplar_for_quantile(0.99)
+    # p99 lands past the fast bucket; the resolved exemplar must be a
+    # tail trace, never the fast one.
+    assert got["trace_id"] in ("t-mid", "t-tail")
+    assert h.exemplar_for_quantile(0.5)["trace_id"] == "t-fast"
+    empty = reg.histogram("t_q2_seconds", "latency", ())
+    assert empty.exemplar_for_quantile(0.99) is None
+
+
+def test_openmetrics_rendering_carries_exemplars_plain_does_not():
+    reg = metrics.get_registry()
+    h = reg.histogram("t_om_seconds", "latency", ("route",),
+                      buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="t-om-1", route="/generate")
+    om = reg.render_openmetrics()
+    assert om.rstrip().endswith("# EOF")
+    assert ('t_om_seconds_bucket{route="/generate",le="0.1"} 1 '
+            '# {trace_id="t-om-1"} 0.05') in om
+    # The 0.0.4 surface is unchanged: no exemplar syntax, still strict-
+    # parseable (the operator's scrape path).
+    plain = reg.render_prometheus()
+    assert "# {" not in plain
+    assert_valid_prometheus(plain)
+    parsed = metrics.parse_prometheus(plain)
+    assert parsed["t_om_seconds"]["series"][0]["count"] == 1
+
+
+def test_openmetrics_counter_family_name_drops_total_suffix():
+    """OpenMetrics: a counter FAMILY must not end in _total — only its
+    sample carries the suffix. Strict OM parsers (Prometheus's
+    openmetrics textparse) reject the whole scrape otherwise."""
+    reg = metrics.get_registry()
+    reg.counter("t_om_requests_total", "requests", ("route",)).inc(
+        route="/generate")
+    om = reg.render_openmetrics()
+    assert "# TYPE t_om_requests counter" in om
+    assert "# TYPE t_om_requests_total" not in om
+    assert 't_om_requests_total{route="/generate"} 1' in om
+    # The 0.0.4 surface keeps the historical spelling end to end.
+    plain = reg.render_prometheus()
+    assert "# TYPE t_om_requests_total counter" in plain
+
+
 def test_snapshot_is_json_able():
     reg = metrics.get_registry()
     reg.register_catalog()

@@ -205,6 +205,36 @@ def test_journal_path_appends_jsonl(tmp_path):
     assert "observed" in lines[0] and "delta" in lines[0]
 
 
+def test_trace_jsonl_records_tick_spans_on_injected_clock(tmp_path):
+    """ISSUE 15: with a TraceWriter attached, every reconcile tick
+    lands as an operator.tick span timestamped on the INJECTED clock,
+    with the writer's meta anchor mapping it onto the wall timeline —
+    the operator leg of `tk8s trace merge`."""
+    from triton_kubernetes_tpu.utils.trace import (
+        TraceWriter, merge_trace_files, read_trace_jsonl,
+        validate_chrome_trace)
+
+    backend, ex, _ = make_world("op-trace")
+    clock = TickClock()
+    path = tmp_path / "operator.jsonl"
+    writer = TraceWriter(str(path), "operator", clock=clock,
+                         wall=lambda: 1000.0)
+    rec = make_reconciler(backend, ex, "op-trace", clock=clock,
+                          trace=writer)
+    rec.run(max_ticks=2)
+    meta, events = read_trace_jsonl(str(path))
+    assert meta["role"] == "operator"
+    ticks = [e for e in events if e["name"] == "operator.tick"]
+    assert [t["fields"]["tick"] for t in ticks] == [1, 2]
+    assert ticks[0]["fields"]["outcome"] == "acted"
+    assert ticks[1]["fields"]["outcome"] == "noop"
+    # The span's at/dur agree with the journal's injected-clock record.
+    assert ticks[0]["at"] == pytest.approx(rec.journal[0].at)
+    assert ticks[0]["dur_s"] == pytest.approx(rec.journal[0].duration_s)
+    doc = merge_trace_files([str(path)])
+    assert validate_chrome_trace(doc) == []
+
+
 def test_unknown_manager_is_typed_operator_error():
     from triton_kubernetes_tpu.operator import OperatorError
 

@@ -279,6 +279,61 @@ def test_router_http_surface(model):
         srv.stop()
 
 
+def test_router_trace_propagation_and_route_spans(model, tmp_path):
+    """ISSUE 15: the router mints seeded trace ids for headerless
+    requests, propagates a caller-supplied X-TK8S-Trace untouched, and
+    records every placement (with its affine/spill/eject reason) as a
+    route.place span under the request's trace id — which also shows
+    up in the replica's own trace file, joining the two processes."""
+    from triton_kubernetes_tpu.utils.trace import (
+        TraceWriter, mint_trace_id, read_trace_jsonl)
+    import random
+
+    srv = ServeHTTPServer(make_engine(model)).start()
+    replica_jsonl = str(tmp_path / "replica.jsonl")
+    replica_writer = TraceWriter(replica_jsonl, "replica-0")
+    srv.engine.flight.writer = replica_writer
+    router_jsonl = str(tmp_path / "router.jsonl")
+    router_writer = TraceWriter(router_jsonl, "router")
+    try:
+        with RouterHTTPServer(
+                [srv.url], trace_seed=11,
+                trace=router_writer) as router:
+            # Headerless: the router mints the seed-11 stream's first id.
+            out = _post(router.url, {"tokens": [5, 7, 9],
+                                     "max_new_tokens": 3})
+            want = mint_trace_id(random.Random(11))
+            assert out["trace_id"] == want
+            assert out["phases"]["prefill_s"] > 0
+            # Caller-supplied header: propagated end to end.
+            req = urllib.request.Request(
+                router.url + "/generate",
+                data=json.dumps({"tokens": [5, 7, 9],
+                                 "max_new_tokens": 3}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-TK8S-Trace": "t-upstream"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out2 = json.loads(r.read())
+            assert out2["trace_id"] == "t-upstream"
+    finally:
+        srv.stop()
+        replica_writer.close()
+        router_writer.close()
+    _, route_events = read_trace_jsonl(router_jsonl)
+    places = [e for e in route_events if e["name"] == "route.place"]
+    assert {e["trace"] for e in places} == {want, "t-upstream"}
+    for e in places:
+        assert e["fields"]["reason"] == "affine"
+        assert e["fields"]["status"] == 200
+        assert e["dur_s"] > 0
+    # The same trace ids appear in the REPLICA's file: one request, two
+    # processes, one joinable record.
+    _, serve_events = read_trace_jsonl(replica_jsonl)
+    replica_traces = {e.get("trace") for e in serve_events}
+    assert {want, "t-upstream"} <= replica_traces
+    assert any(e["name"] == "serve.step" for e in serve_events)
+
+
 def test_router_imports_without_jax():
     """The route verb's deployment story: a router box has no
     accelerator stack. Importing the router (and the serve package's

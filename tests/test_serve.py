@@ -433,13 +433,154 @@ def test_poisson_schedule_seeded_and_sorted():
         PoissonSchedule(rate=0.0, n=4, vocab_size=16)
 
 
-def test_percentile_nearest_rank():
+def test_percentile_linear_interpolation():
     vals = [float(i) for i in range(1, 101)]
-    assert percentile(vals, 50) == 50.0
-    assert percentile(vals, 99) == 99.0
+    # Linear interpolation between bracketing order statistics — no
+    # longer quantized to whichever sample nearest-rank snaps to.
+    assert percentile(vals, 50) == 50.5
+    assert percentile(vals, 99) == pytest.approx(99.01)
     assert percentile(vals, 100) == 100.0
+    assert percentile(vals, 0) == 1.0
     assert percentile([], 50) == 0.0
     assert percentile([3.0], 99) == 3.0
+    # Small-N continuity: p99 of 4 samples interpolates, not snaps.
+    assert percentile([1.0, 2.0, 3.0, 10.0], 99) == pytest.approx(9.79)
+    with pytest.raises(ValueError):
+        percentile(vals, 101)
+
+
+# -------------------------------------------------------- fleet tracing
+def test_traced_engine_phase_sums_and_output_parity(model):
+    """ISSUE 15 acceptance pin: under churn (staggered arrivals, a
+    forced preemption) every finished request's phase breakdown sums to
+    its e2e wall time exactly, recompute time is attributed, and the
+    traced engine's outputs are bitwise the untraced engine's."""
+    from triton_kubernetes_tpu.utils.trace import FlightRecorder
+
+    prompts = [
+        ([5, 7, 9, 11, 2, 4, 6, 8], 16),
+        ([3, 1, 4, 1, 5, 9, 2, 6], 16),
+        ([2, 2, 2], 5),
+        ([9, 8, 7, 6, 5, 4, 3, 2, 1, 0, 1, 2, 3], 7),
+    ]
+    arrivals = {0: [0], 1: [1, 2], 3: [3]}
+
+    def run(flight):
+        eng = make_engine(model, num_blocks=10, max_batch=3,
+                          max_model_len=32, flight=flight)
+        results, step = {}, 0
+        while eng.has_work or step < 5:
+            for idx in arrivals.get(step, []):
+                p, n = prompts[idx]
+                eng.submit(Request(f"r{idx}", p, n, trace_id=f"t-{idx}"))
+            for d in eng.step():
+                results[d.request_id] = d
+            step += 1
+            assert step < 500
+        return results
+
+    flight = FlightRecorder()
+    traced = run(flight)
+    plain = run(None)
+    preempted = [d for d in traced.values() if d.preemptions > 0]
+    assert preempted, "scenario no longer forces a preemption"
+    for rid, d in traced.items():
+        assert plain[rid].tokens == d.tokens  # tracing is invisible
+        assert d.trace_id == f"t-{rid[1:]}"
+        e2e = d.finished_at - d.submitted_at
+        assert sum(d.phases.values()) == pytest.approx(e2e, abs=1e-9)
+        assert d.phases["prefill_s"] > 0 and d.phases["decode_s"] > 0
+    for d in preempted:
+        # Re-prefill after the eviction books as recompute, not prefill.
+        assert d.phases["recompute_s"] > 0
+        assert flight.lookup(d.trace_id).preemptions == d.preemptions
+    for d in plain.values():
+        assert d.phases is None and d.trace_id is None
+
+
+def test_traced_spec_engine_reports_accept_stats(model):
+    from triton_kubernetes_tpu.utils.trace import FlightRecorder
+
+    motif = [4, 9, 2]
+    prompt = (motif * 8)[:20]
+    eng = make_engine(model, spec_k=2, flight=FlightRecorder())
+    eng.submit(Request("s0", prompt, 16, trace_id="t-spec"))
+    (done,) = eng.run_until_idle()
+    assert done.spec is not None and done.spec["proposed"] > 0
+    assert 0 <= done.spec["accepted"] <= done.spec["proposed"]
+    assert sum(done.phases.values()) == pytest.approx(
+        done.finished_at - done.submitted_at, abs=1e-9)
+    # Parity: the traced spec engine still emits the plain-decode tokens.
+    assert done.tokens == solo_run(model, prompt, 16)
+
+
+def test_http_trace_header_phases_and_exemplars(model):
+    """The wire contract: X-TK8S-Trace propagates into the engine, the
+    response carries the id + the phase breakdown, /stats exposes the
+    lifecycle, and the OpenMetrics exposition links the TTFT bucket to
+    the trace id as an exemplar."""
+    metrics.configure()
+    with ServeHTTPServer(make_engine(model)) as srv:
+        req = urllib.request.Request(
+            srv.url + "/generate",
+            data=json.dumps({"tokens": [5, 7, 9, 11, 2],
+                             "max_new_tokens": 6}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-TK8S-Trace": "t-wire-1"})
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["trace_id"] == "t-wire-1"
+        phases = out["phases"]
+        assert set(phases) == {"queue_s", "prefill_s", "decode_s",
+                               "recompute_s"}
+        assert sum(phases.values()) > 0
+
+        # Headerless traffic still traces under the local request id.
+        out2 = _post(srv.url, {"tokens": [5, 7, 9], "max_new_tokens": 2})
+        assert out2["trace_id"] == out2["request_id"]
+
+        with urllib.request.urlopen(srv.url + "/stats") as r:
+            stats = json.loads(r.read())
+        finished = stats["tracing"]["finished"]
+        assert "t-wire-1" in {f["trace_id"] for f in finished}
+        assert stats["tracing"]["in_flight"] == 0
+
+        with urllib.request.urlopen(
+                srv.url + "/metrics?format=openmetrics") as r:
+            assert "openmetrics-text" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert text.rstrip().endswith("# EOF")
+        assert 'tk8s_serve_ttft_seconds_bucket' in text
+        assert '# {trace_id="' in text
+        # The plain scrape stays strict 0.0.4: parseable, no exemplars.
+        with urllib.request.urlopen(srv.url + "/metrics") as r:
+            plain = r.read().decode()
+        assert "# {" not in plain
+        metrics.parse_prometheus(plain)
+
+
+def test_http_loop_death_flushes_flight_recorder(model):
+    """ISSUE 15 satellite: a dead engine loop must not lose the killed
+    requests' partial lifecycles — they land in the recorder as
+    `aborted` traces (the post-mortem the 503 cannot carry)."""
+    import time as _time
+
+    srv = ServeHTTPServer(make_engine(model))
+    srv.engine.step = None  # type: ignore[assignment]
+    with srv:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(srv.url, {"tokens": [1, 2, 3], "max_new_tokens": 4})
+        assert err.value.code == 503
+        # The flush runs just after the waiters are released; poll.
+        flight = srv.engine.flight
+        deadline = _time.monotonic() + 5.0
+        while not flight.finished and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert flight.finished, "no post-mortem trace flushed"
+        rec = flight.finished[-1]
+        assert rec.outcome == "aborted"
+        assert any(e["name"] == "serve.abort" for e in rec.events)
+        assert sum(rec.phases.values()) == pytest.approx(rec.e2e_s)
 
 
 # ------------------------------------------------------------------ CLI

@@ -1,0 +1,286 @@
+"""utils/trace.py direct coverage (ISSUE 15): span nesting through the
+logger, failure-path export, the TraceWriter JSONL contract, the
+FlightRecorder's exact phase partition, and the multi-process merge's
+clock-alignment math under deliberately skewed fake clocks.
+"""
+
+import io
+import json
+
+import pytest
+
+from triton_kubernetes_tpu.cli.main import main as cli_main
+from triton_kubernetes_tpu.utils.logging import Logger
+from triton_kubernetes_tpu.utils.trace import (
+    SPAN_CATALOG,
+    FlightRecorder,
+    TraceCollector,
+    TraceMergeError,
+    TraceWriter,
+    merge_trace_files,
+    mint_trace_id,
+    read_trace_jsonl,
+    valid_trace_id,
+    validate_chrome_trace,
+)
+
+
+# ----------------------------------------------------- span collection
+
+def test_span_nesting_exports_full_path():
+    trace = TraceCollector()
+    log = Logger(stream=io.StringIO(), trace=trace)
+    with log.span("apply"):
+        with log.span("module.a", action="create"):
+            pass
+        with log.span("module.b"):
+            pass
+    events = trace.events()
+    assert [e["name"] for e in events] == ["module.a", "module.b", "apply"]
+    paths = {e["name"]: e["args"]["path"] for e in events}
+    assert paths == {"module.a": "apply/module.a",
+                     "module.b": "apply/module.b", "apply": "apply"}
+    assert events[0]["args"]["action"] == "create"
+
+
+def test_failed_span_exports_error_and_reraises():
+    trace = TraceCollector()
+    log = Logger(stream=io.StringIO(), trace=trace)
+    with pytest.raises(RuntimeError, match="boom"):
+        with log.span("apply"):
+            with log.span("module.bad"):
+                raise RuntimeError("boom")
+    events = {e["name"]: e for e in trace.events()}
+    # BOTH spans export (the crashed apply's trace is the one you most
+    # want to open), each carrying the error and the error category.
+    for name in ("module.bad", "apply"):
+        assert events[name]["cat"] == "span,error"
+        assert "boom" in events[name]["args"]["error"]
+
+
+# -------------------------------------------------------- trace writer
+
+def test_trace_writer_meta_anchor_and_events(tmp_path):
+    path = str(tmp_path / "w.jsonl")
+    w = TraceWriter(path, "replica-0", clock=lambda: 5.0,
+                    wall=lambda: 100.0, pid=42)
+    w.event("serve.submitted", 6.0, trace="t1", request="r1")
+    w.event("serve.phase", 6.0, 1.5, trace="t1", state="queue")
+    w.close()
+    w.event("serve.finish", 9.0)  # after close: dropped, not a crash
+    meta, events = read_trace_jsonl(path)
+    assert meta == {"type": "meta", "version": 1, "role": "replica-0",
+                    "pid": 42, "clock": 5.0, "wall": 100.0}
+    assert [e["name"] for e in events] == ["serve.submitted",
+                                           "serve.phase"]
+    assert events[1]["dur_s"] == 1.5
+    assert events[0]["trace"] == "t1" and events[0]["request"] == "r1"
+
+
+def test_mint_trace_id_seeded_and_16_hex():
+    import random
+
+    a = mint_trace_id(random.Random(7))
+    b = mint_trace_id(random.Random(7))
+    assert a == b and len(a) == 16
+    int(a, 16)  # hex
+    assert mint_trace_id(random.Random(8)) != a
+
+
+# ----------------------------------------------------- flight recorder
+
+def test_flight_recorder_phases_partition_lifetime():
+    fr = FlightRecorder()
+    fr.begin("r1", "t-1", 0.0)
+    fr.event("r1", "serve.admitted", 2.0, recompute=False)
+    fr.event("r1", "serve.prefill", 2.0, offset=0, tokens=8)
+    fr.event("r1", "serve.first_token", 3.5)
+    fr.event("r1", "serve.preempt", 5.0)
+    fr.event("r1", "serve.admitted", 6.0, recompute=True)
+    fr.event("r1", "serve.resume", 8.0)
+    rec = fr.finish("r1", 10.0, "length")
+    assert rec.phases == {"queue_s": 3.0, "prefill_s": 1.5,
+                          "decode_s": 3.5, "recompute_s": 2.0}
+    assert sum(rec.phases.values()) == pytest.approx(rec.e2e_s)
+    assert rec.preemptions == 1 and rec.outcome == "length"
+    # Segments tile the lifetime: contiguous, gap-free.
+    assert rec.segments[0][1] == 0.0 and rec.segments[-1][2] == 10.0
+    for (_, _, end), (_, start, _) in zip(rec.segments,
+                                          rec.segments[1:]):
+        assert end == start
+    assert fr.lookup("t-1") is rec
+    assert fr.lookup("nope") is None
+
+
+def test_flight_recorder_bounds_and_event_cap():
+    fr = FlightRecorder(limit=2, events_per_request=3)
+    for i in range(4):
+        rid = f"r{i}"
+        fr.begin(rid, None, float(i))
+        for j in range(5):
+            fr.event(rid, "serve.grow", float(i) + 0.1 * j, pages=1)
+        fr.finish(rid, float(i) + 1.0, "eos")
+    assert len(fr.finished) == 2  # oldest evicted
+    rec = fr.finished[-1]
+    assert rec.trace_id == "r3"  # trace id falls back to the request id
+    assert len(rec.events) == 3 and rec.events_dropped > 0
+    # The phase math never degrades under the cap: still exact.
+    assert sum(rec.phases.values()) == pytest.approx(rec.e2e_s)
+
+
+def test_flight_recorder_spec_accounting_and_snapshot():
+    fr = FlightRecorder()
+    fr.begin("r1", "t-1", 0.0)
+    fr.event("r1", "serve.admitted", 1.0, recompute=False)
+    fr.event("r1", "serve.first_token", 2.0)
+    fr.event("r1", "serve.verify", 3.0, proposed=4, accepted=2)
+    fr.event("r1", "serve.verify", 4.0, proposed=3, accepted=3)
+    assert fr.in_flight == 1
+    rec = fr.finish("r1", 5.0, "eos")
+    assert rec.spec_proposed == 7 and rec.spec_accepted == 5
+    snap = fr.snapshot()
+    assert snap["in_flight"] == 0
+    assert snap["finished"][0]["spec"] == {"proposed": 7, "accepted": 5}
+    assert snap["finished"][0]["trace_id"] == "t-1"
+
+
+def test_flight_recorder_flush_aborted_preserves_partials(tmp_path):
+    path = str(tmp_path / "dead.jsonl")
+    w = TraceWriter(path, "replica-0", clock=lambda: 0.0,
+                    wall=lambda: 0.0)
+    fr = FlightRecorder(writer=w)
+    fr.begin("r1", "t-1", 0.0)
+    fr.event("r1", "serve.admitted", 1.0, recompute=False)
+    fr.begin("r2", "t-2", 0.5)
+    aborted = fr.flush_aborted(2.0, "RuntimeError: engine died")
+    assert {r.request_id for r in aborted} == {"r1", "r2"}
+    assert fr.in_flight == 0
+    for rec in fr.finished:
+        assert rec.outcome == "aborted"
+        assert sum(rec.phases.values()) == pytest.approx(rec.e2e_s)
+    # The JSONL post-mortem carries the abort events (already flushed
+    # line by line — a crashed process leaves them on disk).
+    _, events = read_trace_jsonl(path)
+    aborts = [e for e in events if e["name"] == "serve.abort"]
+    assert {e["trace"] for e in aborts} == {"t-1", "t-2"}
+    assert all("engine died" in e["fields"]["error"] for e in aborts)
+
+
+# ------------------------------------------------------ merge + align
+
+def _write(tmp_path, name, role, clock0, wall0, events):
+    path = str(tmp_path / name)
+    w = TraceWriter(path, role, clock=lambda: clock0,
+                    wall=lambda: wall0)
+    for args in events:
+        w.event(*args[:2], **args[2] if len(args) > 2 else {})
+    w.close()
+    return path
+
+
+def test_merge_aligns_skewed_clocks(tmp_path):
+    # Three processes whose span clocks disagree wildly (a monotonic
+    # clock, a ManualClock starting at 0, an NTP-skewed one) but whose
+    # wall anchors say when each clock was read: events that happened
+    # at the same wall moment must land at the same merged ts.
+    pa = _write(tmp_path, "a.jsonl", "router", 1000.0, 500.0,
+                [("route.place", 1003.0, {"trace": "t1"})])
+    pb = _write(tmp_path, "b.jsonl", "replica-0", 0.0, 497.0,
+                [("serve.submitted", 6.0, {"trace": "t1"})])
+    pc = _write(tmp_path, "c.jsonl", "operator", -50.0, 503.0,
+                [("operator.tick", -50.0, {})])
+    doc = merge_trace_files([pa, pb, pc])
+    assert validate_chrome_trace(doc) == []
+    spans = {e["name"]: e for e in doc["traceEvents"]
+             if e["ph"] != "M"}
+    # router event: wall 500 + (1003-1000) = 503; replica: 497 + 6 =
+    # 503; operator: 503 + 0 = 503 — all coincide despite the skew.
+    for name in ("route.place", "serve.submitted", "operator.tick"):
+        assert spans[name]["ts"] == pytest.approx(503e6)
+    # One pid per process, named by role; same trace id -> its own tid.
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {"router", "replica-0", "operator"}
+    assert spans["route.place"]["pid"] != spans["serve.submitted"]["pid"]
+    assert spans["route.place"]["tid"] == 1  # per-trace track
+    assert spans["operator.tick"]["tid"] == 0  # process-level track
+
+
+def test_merge_rejects_malformed_inputs(tmp_path):
+    no_meta = tmp_path / "no-meta.jsonl"
+    no_meta.write_text(json.dumps(
+        {"type": "event", "name": "serve.step", "at": 1.0}) + "\n")
+    with pytest.raises(TraceMergeError, match="before the meta"):
+        merge_trace_files([str(no_meta)])
+    bad_json = tmp_path / "bad.jsonl"
+    bad_json.write_text("{not json\n")
+    with pytest.raises(TraceMergeError, match="not valid JSON"):
+        merge_trace_files([str(bad_json)])
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(TraceMergeError, match="no meta anchor"):
+        merge_trace_files([str(empty)])
+
+
+def test_validate_chrome_trace_catches_shape_errors():
+    assert validate_chrome_trace([]) == ["top level is not an object"]
+    assert validate_chrome_trace({}) == [
+        "traceEvents is missing or not a list"]
+    bad = {"traceEvents": [
+        {"ph": "X", "name": "x", "pid": 0, "tid": 0, "ts": 1.0},
+        {"ph": "i", "name": "y", "pid": 0, "tid": 0, "ts": 1.0},
+        {"ph": "Q", "name": "z", "pid": 0, "tid": 0, "ts": 1.0},
+    ]}
+    problems = validate_chrome_trace(bad)
+    assert any("dur" in p for p in problems)
+    assert any("scope" in p for p in problems)
+    assert any("phase" in p for p in problems)
+
+
+# ----------------------------------------------------------- CLI verb
+
+def test_cli_trace_merge(tmp_path, capsys):
+    pa = _write(tmp_path, "a.jsonl", "router", 0.0, 0.0,
+                [("route.place", 1.0, {"trace": "t1"})])
+    pb = _write(tmp_path, "b.jsonl", "replica-0", 0.0, 0.0,
+                [("serve.submitted", 1.5, {"trace": "t1"})])
+    out = str(tmp_path / "fleet.json")
+    assert cli_main(["trace", "merge", pa, pb, "--out", out]) == 0
+    assert "merged 2 trace files" in capsys.readouterr().out
+    doc = json.loads(open(out).read())
+    assert validate_chrome_trace(doc) == []
+    assert cli_main(["trace", "merge", str(tmp_path / "absent.jsonl"),
+                     "--out", out]) == 1
+
+
+# ----------------------------------------------- trace-id hostility
+
+def test_valid_trace_id_is_the_header_gate():
+    assert valid_trace_id(mint_trace_id(__import__("random").Random(0)))
+    assert valid_trace_id("upstream-proxy.id_01")
+    for bad in ('a"b', "", "x" * 129, "tab\tid", "nl\nid", None, 7,
+                'a}b{', "café"):
+        assert not valid_trace_id(bad), bad
+
+
+def test_writer_escapes_hostile_trace_and_request_ids(tmp_path):
+    """Embedders bypass the HTTP gate and call event() directly: a
+    trace/request id that needs escaping must yield a VALID line, not
+    corrupt the file for every later reader."""
+    path = str(tmp_path / "t.jsonl")
+    w = TraceWriter(path, "r")
+    w.event("serve.submitted", 1.0, trace='a"b\\c', request='r"1')
+    w.event("serve.finish", 2.0, trace="café")
+    w.close()
+    _, events = read_trace_jsonl(path)
+    assert [e["trace"] for e in events] == ['a"b\\c', "café"]
+    assert events[0]["request"] == 'r"1'
+
+
+# -------------------------------------------------------- the catalog
+
+def test_span_catalog_is_namespaced_and_described():
+    for name, help_text in SPAN_CATALOG.items():
+        head = name.split(".", 1)[0]
+        assert head in ("serve", "route", "operator"), name
+        assert help_text.strip()

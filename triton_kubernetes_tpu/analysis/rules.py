@@ -453,6 +453,14 @@ class MetricsCatalogDrift(Rule):
                 continue
             if docs[m.end():m.end() + 2].startswith(("_*", "*")):
                 continue  # wildcard prose mention, e.g. tk8s_train_*
+            for suffix in ("_bucket", "_sum", "_count"):
+                # Exposition-sample spellings (the exemplar example in
+                # the docs shows literal _bucket lines) resolve to
+                # their histogram family, exactly as parse_prometheus
+                # reassembles them.
+                if fam.endswith(suffix) and fam[: -len(suffix)] in catalog:
+                    fam = fam[: -len(suffix)]
+                    break
             if fam not in catalog:
                 line = docs.count("\n", 0, m.start()) + 1
                 yield self.finding(
@@ -614,6 +622,119 @@ class OperatorDeterminism(Rule):
                     f"random.Random instead; nondeterminism here "
                     f"breaks tick-journal replay and the chaos "
                     f"harness's preempt-mid-reconcile pins")
+
+
+# ---------------------------------------------------------------------------
+# TK8S111 — span-catalog drift
+# ---------------------------------------------------------------------------
+
+@register
+class SpanCatalogDrift(Rule):
+    """Every span/event name the engine, router, or operator emits must
+    be declared in utils/trace.py SPAN_CATALOG, every catalog entry
+    must appear in the span-catalog table of
+    docs/guide/observability.md, and every span the table names must
+    exist in the catalog.
+
+    History: the TK8S105 pattern applied to traces. The fleet-merged
+    Perfetto timeline and the flight recorder's /stats surface are only
+    debuggable if span names are a closed, documented vocabulary — an
+    ad-hoc emission would appear on operator timelines undocumented,
+    and a renamed span would strand the docs (and any trace-processing
+    script keyed on the old name) silently.
+    """
+
+    code = "TK8S111"
+    name = "span-catalog-drift"
+    summary = ("emitted span names must agree across serve/operator "
+               "call sites, utils/trace.py SPAN_CATALOG, and the docs "
+               "span table")
+
+    CATALOG_FILE = f"{PKG}/utils/trace.py"
+    DOCS_FILE = "docs/guide/observability.md"
+    SCOPES = (f"{PKG}/serve/", f"{PKG}/operator/")
+    FILES = (CATALOG_FILE,)
+    # A span name: dotted lowercase (`serve.prefill`, `route.place`).
+    NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+$")
+    # A docs span-table row: first cell is the backticked span name.
+    ROW_RE = re.compile(
+        r"^\|\s*`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`\s*\|", re.MULTILINE)
+
+    def _catalog(self, ctx: FileContext) -> Optional[Dict[str, int]]:
+        for n in ast.walk(ctx.tree):
+            value = None
+            if (isinstance(n, ast.AnnAssign)
+                    and isinstance(n.target, ast.Name)
+                    and n.target.id == "SPAN_CATALOG"):
+                value = n.value
+            elif isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "SPAN_CATALOG"
+                    for t in n.targets):
+                value = n.value
+            if isinstance(value, ast.Dict):
+                return {k.value: k.lineno for k in value.keys
+                        if isinstance(k, ast.Constant)}
+        return None
+
+    def _emitted_name(self, call: ast.Call) -> Optional[ast.Constant]:
+        """The span-name literal of a ``*.event(...)`` call: the first
+        string constant among the leading positional args (position 0
+        for TraceWriter.event, 1 for FlightRecorder.event — the
+        request id ahead of it is never a literal)."""
+        for a in call.args[:2]:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return a
+        return None
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        cat_ctx = project.file(self.CATALOG_FILE)
+        if cat_ctx is None:
+            return
+        catalog = self._catalog(cat_ctx)
+        if catalog is None:
+            yield self.finding(
+                self.CATALOG_FILE, 1, 0,
+                "no SPAN_CATALOG dict found in the trace module")
+            return
+        # emissions -> catalog
+        for rel, ctx in list(project.files.items()):
+            if not (rel.startswith(self.SCOPES) or rel in self.FILES):
+                continue
+            for n in ast.walk(ctx.tree):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "event"):
+                    continue
+                lit = self._emitted_name(n)
+                if lit is None:
+                    continue
+                if lit.value not in catalog:
+                    yield self.finding(
+                        rel, n.lineno, n.col_offset,
+                        f"span name {lit.value!r} is not declared in "
+                        f"utils/trace.py SPAN_CATALOG — add it there "
+                        f"(and to the span-catalog table in "
+                        f"{self.DOCS_FILE})")
+        docs = project.read_text(self.DOCS_FILE)
+        if docs is None:
+            return
+        table = {m.group(1): docs.count("\n", 0, m.start()) + 1
+                 for m in self.ROW_RE.finditer(docs)}
+        # catalog -> docs table
+        for span, lineno in sorted(catalog.items()):
+            if span not in table:
+                yield self.finding(
+                    self.CATALOG_FILE, lineno, 0,
+                    f"SPAN_CATALOG entry {span!r} is missing from the "
+                    f"span-catalog table in {self.DOCS_FILE}")
+        # docs table -> catalog
+        for span, lineno in sorted(table.items()):
+            if span not in catalog:
+                yield self.finding(
+                    self.DOCS_FILE, lineno, 0,
+                    f"docs span table names {span!r} which is not in "
+                    f"utils/trace.py SPAN_CATALOG — stale docs or a "
+                    f"typo'd span name")
 
 
 # ---------------------------------------------------------------------------

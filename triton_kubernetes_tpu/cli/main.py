@@ -18,7 +18,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+from typing import Any, List, Optional
 
 from .. import __version__
 from ..backends import Backend, LocalBackend, ObjectStoreBackend
@@ -335,6 +335,13 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--seed", type=int, default=0, metavar="N",
                        help="parameter-init seed for the randomly "
                             "initialized model (default: 0)")
+    serve.add_argument("--trace-jsonl", default=None, metavar="FILE",
+                       help="append this replica's request-lifecycle "
+                            "spans (admit/prefill/first-token/preempt/"
+                            "finish + engine ticks) as trace JSON "
+                            "lines — one input of `tk8s trace merge` "
+                            "(docs/guide/observability.md §Fleet "
+                            "tracing)")
 
     route = sub.add_parser(
         "route",
@@ -371,6 +378,16 @@ def build_parser() -> argparse.ArgumentParser:
                        metavar="SECONDS",
                        help="per-attempt timeout for proxied /generate "
                             "calls (default: 120)")
+    route.add_argument("--trace-jsonl", default=None, metavar="FILE",
+                       help="append every placement decision as a "
+                            "route.place span (replica + "
+                            "affine/spill/eject reason, trace id) — "
+                            "one input of `tk8s trace merge`")
+    route.add_argument("--trace-seed", type=int, default=0, metavar="N",
+                       help="seed of the router's trace-id minting "
+                            "stream: requests arriving without an "
+                            "X-TK8S-Trace header get deterministic ids "
+                            "(default: 0)")
 
     operate = sub.add_parser(
         "operate",
@@ -445,9 +462,51 @@ def build_parser() -> argparse.ArgumentParser:
                          help="append every reconcile tick's journal "
                               "record as a JSON line (the decision "
                               "audit trail CI evidence reads)")
+    operate.add_argument("--trace-jsonl", default=None, metavar="FILE",
+                         help="append every reconcile tick and "
+                              "autoscale actuation as operator.tick/"
+                              "operator.scale spans — one input of "
+                              "`tk8s trace merge`, putting operator "
+                              "actions on the same timeline as router "
+                              "placements and replica engine ticks")
+
+    tracecmd = sub.add_parser(
+        "trace",
+        help="fleet-trace tooling: `trace merge` aligns the per-process "
+             "trace JSONL files (serve/route/operate --trace-jsonl) "
+             "through their clock anchors and writes ONE Perfetto "
+             "timeline (docs/guide/observability.md §Fleet tracing)")
+    tracecmd.add_argument("action", choices=["merge"])
+    tracecmd.add_argument("inputs", nargs="+", metavar="JSONL",
+                          help="per-process trace JSONL files to merge")
+    tracecmd.add_argument("--out", "-o", default="fleet-trace.json",
+                          metavar="FILE",
+                          help="merged Chrome/Perfetto trace output "
+                               "(default: fleet-trace.json; open in "
+                               "ui.perfetto.dev)")
 
     sub.add_parser("version", help="print version")
     return p
+
+
+def _sigterm_runs_finally() -> None:
+    """Long-running verbs (serve/route/operate) install this before
+    blocking: SIGTERM — how Kubernetes stops a pod — becomes
+    SystemExit(143) so the verb's ``finally`` runs and buffered trace
+    JSONL reaches disk. Without it the default handler kills the
+    process mid-buffer and a terminated pod's trace file holds only
+    its meta anchor."""
+    import signal
+
+    def _exit(signum: int, frame: Any) -> None:
+        raise SystemExit(143)
+
+    try:
+        signal.signal(signal.SIGTERM, _exit)
+    except ValueError:
+        # Not the main thread (embedded callers drive main() from
+        # worker threads in tests): the caller owns signal handling.
+        pass
 
 
 def main(argv: Optional[List[str]] = None,
@@ -509,6 +568,34 @@ def main(argv: Optional[List[str]] = None,
         if trace is not None:
             trace.write(args.trace_out)
         return 1 if findings else 0
+
+    if args.command == "trace":
+        # Pure JSON alignment work: no backend, no config, no jax.
+        from ..utils.trace import (
+            TraceMergeError,
+            merge_trace_files,
+            validate_chrome_trace,
+        )
+
+        try:
+            doc = merge_trace_files(args.inputs)
+        except (TraceMergeError, OSError) as e:
+            logger.error(str(e), kind=type(e).__name__)
+            return 1
+        problems = validate_chrome_trace(doc)
+        if problems:  # merge emitted something malformed: a bug, loudly
+            for problem in problems:
+                logger.error(problem, kind="TraceValidation")
+            return 1
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        spans = sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+        print(f"merged {len(args.inputs)} trace files -> {args.out} "
+              f"({spans} spans; open in ui.perfetto.dev)")
+        if trace is not None:
+            trace.write(args.trace_out)
+        return 0
 
     if args.command == "chaos":
         # Pure cloudsim work: needs no backend choice, no config, no jax.
@@ -601,6 +688,14 @@ def main(argv: Optional[List[str]] = None,
         server = ServeHTTPServer(engine, host=args.serve_host,
                                  port=args.port)
         host, port = server.address
+        if args.trace_jsonl:
+            from ..utils.trace import TraceWriter
+
+            # The served engine always has a bounded flight recorder
+            # (ServeHTTPServer attaches one); the writer upgrades it to
+            # spill every lifecycle event to disk for `trace merge`.
+            engine.flight.writer = TraceWriter(
+                args.trace_jsonl, role=f"replica:{host}:{port}")
         logger.info("serving", url=f"http://{host}:{port}",
                     model=args.model, block_size=args.block_size,
                     num_blocks=args.num_blocks, max_batch=args.max_batch,
@@ -609,11 +704,14 @@ def main(argv: Optional[List[str]] = None,
                     prefix_cache=prefix_cache, spec_k=args.spec_k)
         print(f"serving {args.model} on http://{host}:{port} "
               f"(POST /generate, GET /metrics, GET /healthz)", flush=True)
+        _sigterm_runs_finally()
         try:
             server.serve_forever()
         except KeyboardInterrupt:
             print("\nstopped", file=sys.stderr)
         finally:
+            if engine.flight is not None and engine.flight.writer is not None:
+                engine.flight.writer.close()
             if trace is not None:
                 trace.write(args.trace_out)
         return 0
@@ -625,13 +723,20 @@ def main(argv: Optional[List[str]] = None,
         from ..utils import metrics as _metrics
 
         _metrics.get_registry().register_catalog()
+        route_writer = None
+        if args.trace_jsonl:
+            from ..utils.trace import TraceWriter
+
+            route_writer = TraceWriter(args.trace_jsonl, role="router")
         try:
             router = RouterHTTPServer(
                 args.replicas, host=args.route_host, port=args.port,
                 health_interval_s=args.health_interval,
                 spill_threshold=args.spill_threshold,
                 virtual_nodes=args.virtual_nodes,
-                request_timeout_s=args.request_timeout)
+                request_timeout_s=args.request_timeout,
+                trace_seed=args.trace_seed,
+                trace=route_writer)
         except ValueError as e:
             logger.error(str(e), kind="ValueError")
             return 2
@@ -642,11 +747,14 @@ def main(argv: Optional[List[str]] = None,
         print(f"routing {len(args.replicas)} replicas on "
               f"http://{host}:{port} (POST /generate, GET /metrics, "
               f"GET /healthz, GET /stats)", flush=True)
+        _sigterm_runs_finally()
         try:
             router.serve_forever()
         except KeyboardInterrupt:
             print("\nstopped", file=sys.stderr)
         finally:
+            if route_writer is not None:
+                route_writer.close()
             if trace is not None:
                 trace.write(args.trace_out)
         return 0
@@ -741,6 +849,12 @@ def main(argv: Optional[List[str]] = None,
                 except ValueError as e:
                     logger.error(str(e), kind="ValueError")
                     return 2
+            operate_writer = None
+            if args.trace_jsonl:
+                from ..utils.trace import TraceWriter
+
+                operate_writer = TraceWriter(args.trace_jsonl,
+                                             role="operator")
             reconciler = Reconciler(
                 be, ex, manager,
                 autoscaler=autoscaler,
@@ -748,6 +862,7 @@ def main(argv: Optional[List[str]] = None,
                 metrics_sources=list(args.scrape_urls),
                 interval_s=args.interval,
                 journal_path=args.journal_out,
+                trace=operate_writer,
                 log=logger.info)
             server = None
             if args.operator_port is not None:
@@ -783,6 +898,7 @@ def main(argv: Optional[List[str]] = None,
                         autoscale_cluster=args.autoscale_cluster or "",
                         interval_s=args.interval,
                         scrapes=len(args.scrape_urls))
+            _sigterm_runs_finally()
             try:
                 ticks = reconciler.run(
                     max_ticks=args.max_ticks,
@@ -795,6 +911,8 @@ def main(argv: Optional[List[str]] = None,
                 logger.error(str(e), kind="OperatorError")
                 return 1
             finally:
+                if operate_writer is not None:
+                    operate_writer.close()
                 if server is not None:
                     server.close()
             return 0

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..state import StateDocument
 from ..utils import metrics
+from ..utils.trace import TraceWriter
 from .autoscaler import Autoscaler, ScaleDecision, apply_decision, \
     record_decision
 from .observe import MetricsWatcher, MetricsSource, ObservedState, observe
@@ -97,6 +98,7 @@ class Reconciler:
                  interval_s: float = 10.0,
                  journal_path: Optional[str] = None,
                  journal_limit: int = 1000,
+                 trace: Optional[TraceWriter] = None,
                  log: Optional[Callable[[str], None]] = None,
                  between_observe_and_act: Optional[
                      Callable[[ObservedState], None]] = None):
@@ -113,6 +115,12 @@ class Reconciler:
         self.interval_s = float(interval_s)
         self.journal_path = journal_path
         self.journal_limit = int(journal_limit)
+        # Optional fleet-trace writer (utils/trace.py): every tick and
+        # every scale actuation lands as a span on the SAME merged
+        # Perfetto timeline the router and the serving replicas feed,
+        # timestamped on the injected clock (the writer's meta anchor
+        # maps it onto the shared wall timeline).
+        self.trace = trace
         self.journal: List[ReconcileTick] = []
         self.log = log or (lambda m: get_logger().info(m))
         self._between = between_observe_and_act
@@ -240,6 +248,21 @@ class Reconciler:
                 cluster=self.autoscale_cluster)
         record.duration_s = self.clock() - t0
         self.last_tick_at = self.clock()
+        if self.trace is not None:
+            self.trace.event("operator.tick", t0, record.duration_s,
+                             tick=self._ticks, outcome=record.outcome)
+            if decision is not None and decision.direction in ("grow",
+                                                               "drain"):
+                self.trace.event("operator.scale", t0,
+                                 record.duration_s,
+                                 direction=decision.direction,
+                                 reason=decision.reason,
+                                 pools=decision.pools)
+            # Ticks are seconds apart — the writer's event batching
+            # (sized for the engine's hot tick path) would hold the
+            # last ticks in memory exactly when a crashed operator
+            # needs them on disk. Flush each tick.
+            self.trace.flush()
         metrics.counter("tk8s_operator_reconciles_total").inc(
             outcome=record.outcome)
         metrics.histogram(

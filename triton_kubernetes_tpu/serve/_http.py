@@ -13,6 +13,7 @@ import json
 import os
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict
+from urllib.parse import parse_qs
 
 # Both endpoints expose the same wire surface; unknown paths are
 # bucketed as "other" in the HTTP counters so label cardinality cannot
@@ -22,6 +23,13 @@ ROUTES = ("/healthz", "/metrics", "/stats", "/generate")
 
 def route_label(path: str) -> str:
     return path if path in ROUTES else "other"
+
+
+def wants_openmetrics(query: str) -> bool:
+    """True when ``/metrics?format=openmetrics`` asks for the exemplar-
+    carrying exposition (the plain scrape stays 0.0.4 — the operator's
+    strict parser never sees exemplar syntax unless it asks)."""
+    return "openmetrics" in parse_qs(query).get("format", [])
 
 
 class JSONHandler(BaseHTTPRequestHandler):
@@ -41,11 +49,25 @@ class JSONHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _prometheus(self, text: str) -> None:
+    def _text(self, text: str, content_type: str) -> None:
         body = text.encode()
         self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _prometheus(self, text: str) -> None:
+        self._text(text, "text/plain; version=0.0.4; charset=utf-8")
+
+    def _metrics_response(self, registry: Any, query: str) -> None:
+        """The shared ``/metrics`` surface: plain 0.0.4 exposition by
+        default, the exemplar-carrying OpenMetrics rendering behind
+        ``?format=openmetrics`` — one dispatch for every endpoint that
+        serves a registry."""
+        if wants_openmetrics(query):
+            self._text(registry.render_openmetrics(),
+                       "application/openmetrics-text; version=1.0.0; "
+                       "charset=utf-8")
+        else:
+            self._prometheus(registry.render_prometheus())

@@ -91,6 +91,7 @@ from ..models.paged import (
 from ..ops.paged_attention import TRASH_PAGE, blocks_for
 from ..train.precision import quantize_for_decode
 from ..utils import metrics
+from ..utils.trace import FlightRecorder
 from .blocks import BlockAllocator, OutOfBlocksError, PrefixCache
 from .speculation import draft_ngram, longest_agreeing_prefix
 
@@ -115,7 +116,10 @@ class ManualClock:
 @dataclass
 class Request:
     """One generation request. ``seed`` keys this request's sampling
-    stream independently of batch composition (solo == batched)."""
+    stream independently of batch composition (solo == batched).
+    ``trace_id`` is the fleet-wide correlation id (router-minted,
+    propagated via the ``X-TK8S-Trace`` header); None falls back to
+    the request id in the flight recorder."""
 
     request_id: str
     tokens: List[int]
@@ -125,6 +129,7 @@ class Request:
     top_p: float = 1.0
     eos_id: Optional[int] = None
     seed: int = 0
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -137,6 +142,12 @@ class FinishedRequest:
     first_token_at: float
     finished_at: float
     preemptions: int = 0
+    # Tracing ride-alongs (None with the flight recorder off): the
+    # fleet trace id and the exact per-phase latency attribution
+    # (queue_s + prefill_s + decode_s + recompute_s == e2e).
+    trace_id: Optional[str] = None
+    phases: Optional[Dict[str, float]] = None
+    spec: Optional[Dict[str, int]] = None
 
     @property
     def ttft(self) -> float:
@@ -206,6 +217,7 @@ class ServeEngine:
         prefix_cache: bool = False,
         spec_k: int = 0,
         clock: Callable[[], float] = time.monotonic,
+        flight: Optional[FlightRecorder] = None,
     ):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
@@ -243,6 +255,11 @@ class ServeEngine:
         self.prefill_chunk = prefill_chunk
         self.spec_k = spec_k
         self.clock = clock
+        # Optional per-request lifecycle recorder (utils/trace.py).
+        # None (the default) means zero tracing work AND zero extra
+        # clock() reads, so untraced engines behave bit-for-bit as
+        # before — the tracing-off arm of the overhead A/B.
+        self.flight = flight
         # One table width serves prefill and decode: enough pages for a
         # full-length sequence, prompt width padded up to whole pages —
         # and, under chunked prefill, up to whole chunk windows, so
@@ -363,7 +380,12 @@ class ServeEngine:
 
     def submit(self, request: Request) -> None:
         self.validate_request(request)
-        self.waiting.append(_Sequence(request, submitted_at=self.clock()))
+        t = self.clock()
+        self.waiting.append(_Sequence(request, submitted_at=t))
+        if self.flight is not None:
+            # One shared clock read: the recorder's queue phase starts
+            # at exactly the submitted_at the TTFT math uses.
+            self.flight.begin(request.request_id, request.trace_id, t)
 
     @property
     def has_work(self) -> bool:
@@ -376,6 +398,12 @@ class ServeEngine:
     # ----------------------------------------------------------- stepping
     def step(self) -> List[FinishedRequest]:
         """One scheduler tick; returns requests that completed in it."""
+        # Tick spans only when a JSONL writer rides along (they are the
+        # "replica engine ticks" track of the merged fleet timeline);
+        # the bounded recorder alone never pays the extra clock reads.
+        tick_span = (self.flight is not None
+                     and self.flight.writer is not None)
+        t0 = self.clock() if tick_span else 0.0
         finished: List[FinishedRequest] = []
         self._admit(finished)
         if self.prefill_chunk is not None:
@@ -389,6 +417,8 @@ class ServeEngine:
                 self._decode_once(finished)
         self._steps += 1
         self._update_gauges()
+        if tick_span:
+            self.flight.step(t0, self.clock() - t0, len(finished))
         return finished
 
     def run_until_idle(self, max_steps: int = 100_000,
@@ -444,6 +474,14 @@ class ServeEngine:
             seq.target = len(prompt)
             seq.prefilled = len(reuse) * self.block_size
             self.slots[slot] = seq
+            if self.flight is not None:
+                # recompute=True re-prefills the sequence's own history
+                # after a preemption — the recorder books the window as
+                # recompute_s, not prefill_s.
+                self.flight.event(
+                    seq.request.request_id, "serve.admitted",
+                    self.clock(), slot=slot, reused_pages=len(reuse),
+                    recompute=seq.preemptions > 0)
             if seq.prefilled:
                 # Tokens whose prefill compute the radix cache absorbed —
                 # the O(users) -> O(1) system-prompt win, measured.
@@ -483,6 +521,9 @@ class ServeEngine:
         c = self.prefill_chunk
         off = seq.prefilled
         clen = min(c, seq.target - off)
+        if self.flight is not None:
+            self.flight.event(seq.request.request_id, "serve.prefill",
+                              self.clock(), offset=off, tokens=clen)
         toks = prompt[off:off + clen] + [0] * (c - clen)
         table = seq.pages + [TRASH_PAGE] * (self.blocks_per_seq
                                             - len(seq.pages))
@@ -526,6 +567,15 @@ class ServeEngine:
         seq.generated.append(tok)
         if seq.first_token_at is None:
             seq.first_token_at = self.clock()
+            if self.flight is not None:
+                self.flight.event(seq.request.request_id,
+                                  "serve.first_token",
+                                  seq.first_token_at)
+        elif self.flight is not None:
+            # Re-prefill of a preempted sequence just completed: the
+            # recorder's recompute phase ends here.
+            self.flight.event(seq.request.request_id, "serve.resume",
+                              self.clock())
         self._maybe_finish(i, finished)
 
     def _pool(self) -> tuple:
@@ -537,6 +587,9 @@ class ServeEngine:
         return (c.k, c.v)
 
     def _prefill_sequence(self, seq: _Sequence, prompt: List[int]) -> None:
+        if self.flight is not None:
+            self.flight.event(seq.request.request_id, "serve.prefill",
+                              self.clock(), offset=0, tokens=len(prompt))
         padded = prompt + [0] * (self.prefill_width - len(prompt))
         table = seq.pages + [TRASH_PAGE] * (self.blocks_per_seq
                                             - len(seq.pages))
@@ -563,6 +616,13 @@ class ServeEngine:
         seq.generated.append(tok)
         if seq.first_token_at is None:
             seq.first_token_at = self.clock()
+            if self.flight is not None:
+                self.flight.event(seq.request.request_id,
+                                  "serve.first_token",
+                                  seq.first_token_at)
+        elif self.flight is not None:
+            self.flight.event(seq.request.request_id, "serve.resume",
+                              self.clock())
 
     # ------------------------------------------------- growth/preemption
     def _ensure_growth_pages(self) -> None:
@@ -578,10 +638,12 @@ class ServeEngine:
                 # Still prefilling: its pages already cover the whole
                 # prompt; growth starts once it decodes.
                 continue
+            grew = 0
             while blocks_for(seq.length + 1,
                              self.block_size) > len(seq.pages):
                 try:
                     seq.pages.extend(self.allocator.alloc(1))
+                    grew += 1
                 except OutOfBlocksError:
                     if self.prefix is not None and self.prefix.evict(1):
                         continue
@@ -592,6 +654,10 @@ class ServeEngine:
                     self._preempt(victim)
                     if victim == i:
                         break  # preempted ourselves; re-admit later
+            if grew and self.flight is not None \
+                    and self.slots[i] is seq:
+                self.flight.event(seq.request.request_id, "serve.grow",
+                                  self.clock(), pages=grew)
         if self.spec_k > 0:
             # Speculative allocation runs as a SECOND pass, only after
             # every sequence's mandatory next-token page landed above:
@@ -608,6 +674,7 @@ class ServeEngine:
     def _preempt(self, slot: int) -> None:
         seq = self.slots[slot]
         assert seq is not None
+        freed = len(seq.pages)
         self.allocator.free(seq.pages)
         seq.pages = []
         seq.admit_seq = -1
@@ -617,6 +684,9 @@ class ServeEngine:
         self.slots[slot] = None
         self.waiting.appendleft(seq)
         metrics.counter("tk8s_serve_preemptions_total").inc()
+        if self.flight is not None:
+            self.flight.event(seq.request.request_id, "serve.preempt",
+                              self.clock(), pages_freed=freed)
 
     def _draft_and_grow(self, seq: _Sequence) -> None:
         """Self-draft this tick's proposal and allocate the pages its
@@ -756,6 +826,10 @@ class ServeEngine:
             keep[i] = cut
             proposed += nd
             accepted += min(a, cut)
+            if self.flight is not None and nd:
+                self.flight.event(seq.request.request_id, "serve.verify",
+                                  self.clock(), proposed=nd,
+                                  accepted=min(a, cut))
         if any(keep[i] < s_width for i in active):
             # Roll back every rejected (and pad) write BEFORE any page
             # can be freed or re-handed: after this the pool is
@@ -833,11 +907,25 @@ class ServeEngine:
             submitted_at=seq.submitted_at,
             first_token_at=seq.first_token_at or now,
             finished_at=now, preemptions=seq.preemptions)
+        if self.flight is not None:
+            rec = self.flight.finish(r.request_id, now, reason)
+            if rec is not None:
+                done.trace_id = rec.trace_id
+                done.phases = dict(rec.phases)
+                if rec.spec_proposed:
+                    done.spec = {"proposed": rec.spec_proposed,
+                                 "accepted": rec.spec_accepted}
         finished.append(done)
         metrics.counter("tk8s_serve_requests_total").inc(outcome=reason)
-        metrics.histogram("tk8s_serve_ttft_seconds").observe(done.ttft)
+        # The trace id rides the latency observations as an OpenMetrics
+        # exemplar: each bucket remembers the last trace that landed in
+        # it, so a breaching TTFT p99 resolves to a concrete request
+        # whose phase breakdown explains the latency.
+        metrics.histogram("tk8s_serve_ttft_seconds").observe(
+            done.ttft, exemplar=done.trace_id)
         if len(done.tokens) > 1:
-            metrics.histogram("tk8s_serve_tpot_seconds").observe(done.tpot)
+            metrics.histogram("tk8s_serve_tpot_seconds").observe(
+                done.tpot, exemplar=done.trace_id)
         return True
 
     # ------------------------------------------------------------ metrics
@@ -875,7 +963,18 @@ class ServeEngine:
             "prefix_cache": self.prefix is not None,
             "prefix_cache_pages": (self.prefix.pages
                                    if self.prefix is not None else 0),
+            "tracing": (self.flight.snapshot()
+                        if self.flight is not None else None),
         }
+
+    def abort_inflight(self, error: str) -> int:
+        """Flush every in-flight request's lifecycle as ``aborted``
+        (engine-loop death): the partial phase attribution of exactly
+        the requests the crash killed survives into the bounded store
+        and the JSONL trace. Returns the number flushed."""
+        if self.flight is None:
+            return 0
+        return len(self.flight.flush_aborted(self.clock(), error))
 
     def release_prefix_cache(self) -> int:
         """Drop every cache-held page reference (pages still mapped by

@@ -311,9 +311,24 @@ class DiurnalSchedule:
 
 
 def percentile(values: Sequence[float], p: float) -> float:
-    """Nearest-rank percentile (p in [0, 100]); 0.0 on empty input."""
+    """Linear-interpolation percentile (p in [0, 100]); 0.0 on empty
+    input.
+
+    Interpolates between the two bracketing order statistics (the
+    sample-side analog of ``utils/metrics.histogram_quantile``'s
+    within-bucket interpolation), so a p99 over a few dozen requests is
+    a continuous function of the data instead of snapping to whichever
+    single sample nearest-rank lands on — the quantization that let a
+    one-sample outlier swing small-N evidence gates by a whole sample.
+    """
+    if not 0.0 <= p <= 100.0:
+        raise ValueError(f"percentile must be in [0, 100], got {p}")
     if not values:
         return 0.0
     ordered = sorted(values)
-    rank = max(1, -(-len(ordered) * p // 100))  # ceil
-    return ordered[int(rank) - 1]
+    if len(ordered) == 1:
+        return ordered[0]
+    rank = (len(ordered) - 1) * p / 100.0
+    lo = min(int(rank), len(ordered) - 2)
+    frac = rank - lo
+    return ordered[lo] + (ordered[lo + 1] - ordered[lo]) * frac

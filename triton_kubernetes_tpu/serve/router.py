@@ -46,16 +46,20 @@ from __future__ import annotations
 import bisect
 import hashlib
 import json
+import random
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass
 from http.server import ThreadingHTTPServer
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import urlparse
 
 from ..utils import metrics
+from ..utils.trace import TRACE_HEADER, TraceWriter, mint_trace_id, \
+    valid_trace_id
 from ._http import JSONHandler
 
 
@@ -120,6 +124,9 @@ class Router:
         virtual_nodes: int = 64,
         request_timeout_s: float = 120.0,
         health_timeout_s: float = 2.0,
+        trace_seed: int = 0,
+        trace: Optional[TraceWriter] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if not replica_urls:
             raise ValueError("need at least one replica URL")
@@ -129,6 +136,14 @@ class Router:
         self.request_timeout_s = request_timeout_s
         self.health_timeout_s = health_timeout_s
         self.spill_threshold = spill_threshold
+        # Trace minting: the router is where a fleet-wide trace id is
+        # born (requests that arrive already carrying X-TK8S-Trace keep
+        # theirs). Seeded so a replayed schedule mints the identical
+        # ids; `trace` (a TraceWriter) additionally records each
+        # placement as a route.place span on the merged timeline.
+        self._trace_rng = random.Random(trace_seed)
+        self.trace = trace
+        self.clock = clock
         self._lock = threading.Lock()
         self.replicas: Dict[str, ReplicaState] = {}
         for i, url in enumerate(replica_urls):
@@ -181,13 +196,24 @@ class Router:
             return owner, "affine"
 
     # ----------------------------------------------------------- forward
-    def forward(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    def forward(self, payload: Dict[str, Any],
+                trace_id: Optional[str] = None,
+                ) -> Tuple[int, Dict[str, Any]]:
         """Route one /generate payload: returns (status, body). Retries
         on a fresh replica after a connection failure or 503, marking
         the failed one unhealthy; client errors (4xx) pass through —
         they would fail identically anywhere; a per-attempt timeout is
         a 504 to the caller, never an ejection (the slow replica is
-        still computing — see the module docstring)."""
+        still computing — see the module docstring).
+
+        ``trace_id`` is the fleet-wide correlation id: the caller's
+        (from the X-TK8S-Trace header) when present, freshly minted
+        here otherwise. It is forwarded to the replica in the same
+        header, recorded on every route.place span with the placement
+        reason, and echoed in the response body."""
+        if trace_id is None:
+            with self._lock:
+                trace_id = mint_trace_id(self._trace_rng)
         key = self.route_key(payload)
         body = json.dumps(payload).encode()
         tried: set = set()
@@ -202,11 +228,17 @@ class Router:
             with self._lock:
                 replica.in_flight += 1
                 replica.requests += 1
+            t0 = self.clock()
             try:
-                status, out = self._post(replica.url + "/generate", body)
+                status, out = self._post(replica.url + "/generate", body,
+                                         trace_id)
             finally:
                 with self._lock:
                     replica.in_flight -= 1
+            if self.trace is not None:
+                self.trace.event("route.place", t0, self.clock() - t0,
+                                 trace=trace_id, replica=replica.name,
+                                 reason=reason, status=status)
             if status == 503 or status == -1:
                 # Failed attempts are not placements: the counter only
                 # ever records requests a replica actually served.
@@ -228,16 +260,19 @@ class Router:
             metrics.counter("tk8s_route_requests_total").inc(
                 replica=replica.name, reason=reason)
             if isinstance(out, dict):
-                out = dict(out, replica=replica.name)
+                out = dict(out, replica=replica.name, trace_id=trace_id)
             return status, out
         return last
 
-    def _post(self, url: str, body: bytes) -> Tuple[int, Dict[str, Any]]:
+    def _post(self, url: str, body: bytes, trace_id: Optional[str] = None,
+              ) -> Tuple[int, Dict[str, Any]]:
         """(status, parsed body); -1 means unreachable (eject + retry),
         -2 means the attempt timed out on a live replica (504, no
         eject — the generation is still burning compute there)."""
-        req = urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/json"})
+        headers = {"Content-Type": "application/json"}
+        if trace_id is not None:
+            headers[TRACE_HEADER] = trace_id
+        req = urllib.request.Request(url, data=body, headers=headers)
         try:
             with urllib.request.urlopen(
                     req, timeout=self.request_timeout_s) as r:
@@ -308,7 +343,8 @@ class _Handler(JSONHandler):
     route: "RouterHTTPServer"  # injected by RouterHTTPServer
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
-        path = urlparse(self.path).path
+        parsed = urlparse(self.path)
+        path = parsed.path
         router = self.route.router
         if path == "/healthz":
             # The router is alive iff it can place a request somewhere.
@@ -319,7 +355,7 @@ class _Handler(JSONHandler):
                 self._json(503, {"ok": False,
                                  "error": "no healthy replica"})
         elif path == "/metrics":
-            self._prometheus(metrics.get_registry().render_prometheus())
+            self._metrics_response(metrics.get_registry(), parsed.query)
         elif path == "/stats":
             self._json(200, router.stats())
         else:
@@ -337,7 +373,13 @@ class _Handler(JSONHandler):
         except ValueError as e:
             self._json(400, {"type": "error", "message": str(e)})
             return
-        status, out = self.route.router.forward(payload)
+        # An invalid header (shape-wise: hostile, truncated, binary) is
+        # treated as absent — the router mints a fresh id rather than
+        # letting arbitrary bytes ride into span fields and exemplars.
+        upstream = self.headers.get(TRACE_HEADER)
+        status, out = self.route.router.forward(
+            payload,
+            trace_id=upstream if valid_trace_id(upstream) else None)
         self._json(status, out)
 
 

@@ -37,6 +37,7 @@ from typing import Any, Dict, Optional, Tuple
 from urllib.parse import urlparse
 
 from ..utils import metrics
+from ..utils.trace import TRACE_HEADER, FlightRecorder, valid_trace_id
 from ._http import JSONHandler, route_label
 from .engine import FinishedRequest, Request, ServeEngine
 
@@ -78,7 +79,8 @@ class _Handler(JSONHandler):
         self._counted(self._post)
 
     def _get(self) -> None:
-        path = urlparse(self.path).path
+        parsed = urlparse(self.path)
+        path = parsed.path
         if path == "/healthz":
             # Health is the ENGINE LOOP's, not this handler thread's: a
             # dead scheduler must flip the liveness probe (the rendered
@@ -91,7 +93,7 @@ class _Handler(JSONHandler):
             self._json(200, {"ok": True,
                              "model": self.serve.engine.config.name})
         elif path == "/metrics":
-            self._prometheus(metrics.get_registry().render_prometheus())
+            self._metrics_response(metrics.get_registry(), parsed.query)
         elif path == "/stats":
             self._json(200, self.serve.engine.stats())
         else:
@@ -130,8 +132,17 @@ class _Handler(JSONHandler):
             # the caller's fault, not a handler crash.
             self._json(400, {"type": "error", "message": str(e)})
             return
+        # The trace-context header: the router (or any upstream) minted
+        # the id; this replica propagates it through the engine so its
+        # whole lifecycle is recorded under the fleet-wide id. Absent
+        # OR invalid header (hostile/binary bytes must not ride into
+        # span fields) = direct traffic; the engine falls back to the
+        # local request id.
+        trace_id = self.headers.get(TRACE_HEADER)
+        if not valid_trace_id(trace_id):
+            trace_id = None
         try:
-            done = self.serve.generate(tokens, **opts)
+            done = self.serve.generate(tokens, trace_id=trace_id, **opts)
         except ValueError as e:  # engine validation: caller's fault
             self._json(400, {"type": "error", "message": str(e)})
             return
@@ -145,7 +156,7 @@ class _Handler(JSONHandler):
         except RuntimeError as e:  # engine-loop death: liveness event
             self._json(503, {"type": "error", "message": str(e)})
             return
-        self._json(200, {
+        body: Dict[str, Any] = {
             "request_id": done.request_id,
             "tokens": done.tokens,
             "prompt_len": done.prompt_len,
@@ -153,7 +164,16 @@ class _Handler(JSONHandler):
             "ttft_s": done.ttft,
             "tpot_s": done.tpot,
             "preemptions": done.preemptions,
-        })
+        }
+        if done.trace_id is not None:
+            # The per-phase latency attribution rides the response: the
+            # four phases sum to e2e_s exactly (the evidence-gate pin).
+            body["trace_id"] = done.trace_id
+            body["phases"] = done.phases
+            body["e2e_s"] = done.finished_at - done.submitted_at
+            if done.spec is not None:
+                body["spec"] = done.spec
+        self._json(200, body)
 
 
 class ServeHTTPServer:
@@ -162,8 +182,15 @@ class ServeHTTPServer:
     ``serve_forever`` under ``tk8s serve``."""
 
     def __init__(self, engine: ServeEngine, host: str = "127.0.0.1",
-                 port: int = 0, request_timeout_s: float = 120.0):
+                 port: int = 0, request_timeout_s: float = 120.0,
+                 tracing: bool = True):
         self.engine = engine
+        if tracing and engine.flight is None:
+            # Served engines trace by default (a bounded in-memory
+            # recorder; JSONL export only when the caller attached a
+            # writer): /generate then always carries the phase
+            # breakdown. tracing=False is the overhead-A/B off arm.
+            engine.flight = FlightRecorder()
         self.request_timeout_s = request_timeout_s
         self._inbox: "queue.Queue[Tuple[Request, _Waiter]]" = queue.Queue()
         self._waiters: Dict[str, _Waiter] = {}
@@ -189,6 +216,7 @@ class ServeHTTPServer:
             "top_p": opts.get("top_p", 1.0),
             "eos_id": opts.get("eos_id"),
             "seed": opts.get("seed", 0),
+            "trace_id": opts.get("trace_id"),
         })
         # Fail fast off-loop; the loop's own submit re-validates.
         self.engine.validate_request(request)
@@ -249,6 +277,14 @@ class ServeHTTPServer:
             # liveness probe restarts the pod) and every blocked or
             # future client gets a 503 instead of a silent 200 zombie.
             self._fail_pending()
+            # Flush the flight recorder LAST: the killed requests'
+            # partial lifecycles survive as post-mortem traces (and as
+            # already-flushed JSONL lines) even though their clients
+            # only ever saw a 503.
+            try:
+                self.engine.abort_inflight(self._loop_error)
+            except Exception:
+                pass  # post-mortem best effort: the 503 path already ran
 
     def _fail_pending(self) -> None:
         """Release every blocked client as 503 instead of a 120s hang:

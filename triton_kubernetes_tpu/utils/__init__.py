@@ -14,18 +14,33 @@ from .metrics import (
     MetricsRegistry,
     get_registry,
 )
-from .trace import TraceCollector
+from .trace import (
+    SPAN_CATALOG,
+    TRACE_HEADER,
+    FlightRecorder,
+    RequestTrace,
+    TraceCollector,
+    TraceWriter,
+    merge_trace_files,
+    validate_chrome_trace,
+)
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Logger",
     "MetricsRegistry",
+    "RequestTrace",
+    "SPAN_CATALOG",
     "Span",
+    "TRACE_HEADER",
     "TraceCollector",
+    "TraceWriter",
     "configure",
     "get_logger",
     "get_registry",
-    "metrics",
+    "merge_trace_files",
+    "validate_chrome_trace",
 ]

@@ -402,19 +402,29 @@ class Histogram(_Metric):
             raise ValueError(f"histogram {name!r} needs at least one bucket")
         self.buckets = bs
 
-    def observe(self, value: float, **labels: Any) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels: Any) -> None:
+        """Record ``value``; an ``exemplar`` (a trace id) is pinned to
+        the landing bucket, last-writer-wins — the link from a latency
+        histogram back to the concrete request trace that landed there
+        (OpenMetrics exemplar semantics, one per bucket)."""
         key = self._key(labels)
         v = float(value)
         with self._lock:
             series = self._series.get(key)
             if series is None:
                 series = {"counts": [0] * len(self.buckets),
-                          "sum": 0.0, "count": 0}
+                          "sum": 0.0, "count": 0, "exemplars": {}}
                 self._series[key] = series
+            idx = len(self.buckets)  # the implicit +Inf bucket
             for i, le in enumerate(self.buckets):
                 if v <= le:
                     series["counts"][i] += 1
+                    idx = i
                     break  # counts are per-bucket here; cumulated on render
+            if exemplar is not None:
+                series["exemplars"][idx] = {"trace_id": str(exemplar),
+                                            "value": v}
             series["sum"] += v
             series["count"] += 1
 
@@ -441,6 +451,51 @@ class Histogram(_Metric):
                             "buckets": buckets, "sum": series["sum"],
                             "count": series["count"]})
         return out
+
+    def _le_str(self, idx: int) -> str:
+        return ("+Inf" if idx >= len(self.buckets)
+                else _format_value(self.buckets[idx]))
+
+    def exemplars(self, **labels: Any) -> Dict[str, Dict[str, Any]]:
+        """Per-bucket last exemplars for one series, keyed by the
+        bucket's ``le`` exposition string (incl. ``"+Inf"``)."""
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            if series is None:
+                return {}
+            return {self._le_str(i): dict(ex)
+                    for i, ex in sorted(series.get("exemplars",
+                                                   {}).items())}
+
+    def exemplar_for_quantile(self, q: float,
+                              **labels: Any) -> Optional[Dict[str, Any]]:
+        """The exemplar of the bucket quantile ``q`` lands in — what
+        links "TTFT p99 is breaching" to one offending request trace.
+        Walks down to the nearest lower populated-exemplar bucket when
+        the landing bucket has none (its last traced observation may
+        have been evicted by a registry reset)."""
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            if series is None or series["count"] == 0:
+                return None
+            rank = q * series["count"]
+            cum = 0.0
+            landing = len(self.buckets)
+            for i, c in enumerate(series["counts"]):
+                cum += c
+                if cum >= rank:
+                    landing = i
+                    break
+            exemplars = series.get("exemplars", {})
+            # Landing bucket first; then higher buckets (slower traces
+            # — they explain a tail breach at least as well); then
+            # lower as a last resort.
+            order = list(range(landing, len(self.buckets) + 1)) \
+                + list(range(landing - 1, -1, -1))
+            for i in order:
+                if i in exemplars:
+                    return dict(exemplars[i], le=self._le_str(i))
+        return None
 
 
 class MetricsRegistry:
@@ -578,6 +633,64 @@ class MetricsRegistry:
                     lines.append(
                         f"{fam.name}{suffix} {_format_value(s['value'])}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics text exposition: the Prometheus rendering plus
+        per-bucket **exemplars** (``# {trace_id="..."} value``) on
+        histogram ``_bucket`` lines and the mandatory ``# EOF``
+        terminator. This is the surface that links a latency histogram
+        to the concrete request trace last seen in each bucket — e.g.
+        the operator's windowed TTFT p99 resolves to the offending
+        trace id. Served at ``/metrics?format=openmetrics``; the plain
+        0.0.4 rendering (and its strict parser) is unchanged."""
+        with self._lock:
+            fams = list(self._families.values())
+        lines: List[str] = []
+        for fam in sorted(fams, key=lambda f: f.name):
+            # OpenMetrics counter naming: the FAMILY name must not end
+            # in _total; only the sample carries the suffix. Our
+            # catalog names counters tk8s_*_total (Prometheus 0.0.4
+            # style), so strip it for HELP/TYPE and re-suffix the
+            # sample lines — a strict OM parser drops the whole scrape
+            # otherwise.
+            om_name = fam.name
+            if fam.kind == "counter" and om_name.endswith("_total"):
+                om_name = om_name[: -len("_total")]
+            if fam.help:
+                lines.append(f"# HELP {om_name} {fam.help}")
+            kind = "unknown" if fam.kind == "untyped" else fam.kind
+            lines.append(f"# TYPE {om_name} {kind}")
+            if isinstance(fam, Histogram):
+                for s in fam.samples():
+                    base = [(n, s["labels"][n]) for n in fam.labelnames]
+                    exemplars = fam.exemplars(**s["labels"])
+                    for le, cum in s["buckets"].items():
+                        pairs = ",".join(
+                            [f'{n}="{_escape_label(v)}"' for n, v in base]
+                            + [f'le="{le}"'])
+                        line = f"{fam.name}_bucket{{{pairs}}} {cum}"
+                        ex = exemplars.get(le)
+                        if ex is not None:
+                            line += (f' # {{trace_id="'
+                                     f'{_escape_label(ex["trace_id"])}"}} '
+                                     f'{_format_value(ex["value"])}')
+                        lines.append(line)
+                    suffix = fam._label_str(
+                        tuple(s["labels"][n] for n in fam.labelnames))
+                    lines.append(f"{fam.name}_sum{suffix} "
+                                 f"{_format_value(s['sum'])}")
+                    lines.append(f"{fam.name}_count{suffix} {s['count']}")
+            else:
+                sample_name = (f"{om_name}_total"
+                               if fam.kind == "counter" else fam.name)
+                for s in fam.samples():
+                    suffix = fam._label_str(
+                        tuple(s["labels"][n] for n in fam.labelnames))
+                    lines.append(
+                        f"{sample_name}{suffix} "
+                        f"{_format_value(s['value'])}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
 
 _default = MetricsRegistry()

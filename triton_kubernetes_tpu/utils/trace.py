@@ -1,25 +1,117 @@
-"""Span tree -> Chrome trace-event JSON (Perfetto / chrome://tracing).
+"""Request/span tracing: single-process collection, fleet-wide merge.
 
-:class:`~.logging.Span` already times every provisioning phase; this
-module makes those timings machine-readable. A :class:`TraceCollector`
-attached to the logger (``configure(trace=...)``, or the CLI's global
-``--trace-out FILE``) receives one complete event per finished span and
-serializes the Trace Event Format's JSON object form, so any
-``apply``/``destroy``/``repair`` run opens directly in
-https://ui.perfetto.dev.
+Three layers, all dependency-free:
 
-Events use the ``"ph": "X"`` (complete) phase: wall-clock ``ts`` plus
-monotonic-derived ``dur``, both in microseconds, with the span's nesting
-path and fields under ``args``. Thread ids are real, so concurrent
-spans (threaded workflows) land on separate tracks.
+* :class:`TraceCollector` — the original CLI surface: one Chrome trace
+  event per finished :class:`~.logging.Span` (``--trace-out FILE``), so
+  any ``apply``/``destroy``/``repair`` run opens directly in
+  https://ui.perfetto.dev.
+* :class:`TraceWriter` + :class:`FlightRecorder` — the serving fleet's
+  distributed-request story. Every traced process (router, each serving
+  replica, the operator) appends span events as JSON lines through a
+  :class:`TraceWriter`, whose first line anchors the process's
+  *injectable* clock to the wall clock; the engine's
+  :class:`FlightRecorder` additionally keeps a bounded in-memory
+  lifecycle per request (submitted → admitted → prefill windows → first
+  token → grows → preempt/re-prefill → verify → finish) and folds it
+  into an exact per-phase latency attribution
+  (``queue_s + prefill_s + decode_s + recompute_s == e2e`` by
+  construction — the segments partition the request's lifetime).
+* :func:`merge_trace_files` — ``tk8s trace merge``: aligns each file's
+  clock through its meta anchor and emits ONE Perfetto timeline where
+  router placements, replica engine ticks, and operator actuations
+  appear side by side, each request's lifecycle on its own track.
+
+Span/event *names* are namespaced (``serve.*`` / ``route.*`` /
+``operator.*``) and must be declared in :data:`SPAN_CATALOG` — lint
+rule TK8S111 keeps emissions, this catalog, and the span table in
+docs/guide/observability.md agreeing, the TK8S105 pattern applied to
+traces.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, \
+    Tuple
+
+#: The HTTP header carrying the request's trace id across process
+#: boundaries (router -> replica; any upstream proxy -> router). The
+#: router mints ids (seeded, injectable) for requests that arrive
+#: without one; a replica serving direct traffic falls back to its own
+#: request id — every /generate response echoes the id it served under.
+TRACE_HEADER = "X-TK8S-Trace"
+
+_INF = float("inf")
+_NINF = float("-inf")
+
+#: The only shapes an X-TK8S-Trace header may carry. Ids the fleet
+#: mints are 16-hex, but an upstream proxy may send its own — anything
+#: outside this set is treated as ABSENT at the HTTP boundary (router
+#: mints a fresh id; a replica falls back to the request id), because a
+#: hostile header would otherwise ride verbatim into span fields, file
+#: names, and metrics exemplars.
+_TRACE_ID_RE = re.compile(r"^[0-9A-Za-z._-]{1,128}$")
+
+
+def valid_trace_id(s: Any) -> bool:
+    """True when ``s`` is usable as a fleet trace id (see
+    :data:`_TRACE_ID_RE`). The router and the serving replicas gate the
+    incoming trace-context header on this."""
+    return isinstance(s, str) and _TRACE_ID_RE.match(s) is not None
+
+#: name -> one-line meaning. The single source of truth the emitting
+#: call sites (serve/, operator/, this module) and the span-catalog
+#: table in docs/guide/observability.md share; lint rule TK8S111
+#: enforces three-way agreement exactly as TK8S105 does for metrics.
+SPAN_CATALOG: Dict[str, str] = {
+    "serve.submitted": "request entered the engine's waiting queue",
+    "serve.admitted": "request took a decode slot and its prompt pages "
+                      "(recompute=True after a preemption)",
+    "serve.prefill": "one prefill window ran (offset/tokens fields; the "
+                     "whole prompt in legacy non-chunked mode)",
+    "serve.first_token": "the first token sampled — TTFT stops here",
+    "serve.resume": "a preempted request finished re-prefilling its own "
+                    "history and rejoined decode",
+    "serve.grow": "KV pages allocated for upcoming decode writes",
+    "serve.preempt": "request evicted to free pages; re-queued for "
+                     "recompute",
+    "serve.verify": "one speculative verify pass for this request "
+                    "(proposed/accepted fields)",
+    "serve.finish": "request completed (reason field: eos/length)",
+    "serve.abort": "engine loop died with the request in flight; "
+                   "lifecycle flushed post-mortem",
+    "serve.phase": "one attributed latency segment (state field: "
+                   "queue/prefill/decode/recompute) — segments tile "
+                   "submit..finish exactly",
+    "serve.step": "one engine scheduler tick (finished-count field)",
+    "route.place": "router placed a request on a replica (replica, "
+                   "reason=affine/spill/eject, status fields)",
+    "operator.tick": "one reconcile observe->diff->act cycle (outcome "
+                     "field)",
+    "operator.scale": "autoscaler actuation (direction/reason/pools "
+                      "fields)",
+}
+
+#: Scheduling states a request moves through; phase keys are what the
+#: breakdown dict carries (`<state>_s`).
+PHASE_STATES = ("queue", "prefill", "decode", "recompute")
+
+# Lifecycle events that unconditionally move the request to a new
+# scheduling state ("serve.admitted" is handled separately: it lands in
+# `prefill` on first admission and `recompute` after a preemption).
+_EVENT_STATE = {
+    "serve.submitted": "queue",
+    "serve.preempt": "queue",
+    "serve.first_token": "decode",
+    "serve.resume": "decode",
+}
 
 
 class TraceCollector:
@@ -71,3 +163,459 @@ class TraceCollector:
             json.dump(self.to_dict(), f, indent=2, sort_keys=True)
             f.write("\n")
         os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Per-process trace JSONL (the fleet-merge input)
+# ---------------------------------------------------------------------------
+
+def mint_trace_id(rng) -> str:
+    """A 16-hex trace id from a seeded ``random.Random`` — the router's
+    injectable minting seam (deterministic schedules replay with
+    deterministic ids)."""
+    return f"{rng.getrandbits(64):016x}"
+
+
+class TraceWriter:
+    """Appends span events as JSON lines, one file per traced process.
+
+    The first line is a *meta anchor*: the process role plus a
+    simultaneous reading of its span clock and the wall clock. Every
+    event timestamp is on the span clock (the engine's injectable
+    ``clock`` seam, the router's monotonic clock, the operator's
+    injected tick clock) — the merge maps it onto the shared wall
+    timeline as ``wall + (at - clock)``, which is what lets processes
+    with arbitrarily skewed/offset clocks land on one coherent fleet
+    view. Writes are buffered and flushed every ``flush_every`` events
+    (per-line flushes measurably tax the engine's tick path — the
+    tracing-overhead gate in scripts/ci/trace_evidence.py); the
+    post-mortem paths (the recorder's abort flush, ``close``) force a
+    :meth:`flush`, so a dead engine loop's traces still land on disk.
+    """
+
+    def __init__(self, path: str, role: str, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time,
+                 pid: Optional[int] = None, flush_every: int = 32):
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self.path = path
+        self.role = role
+        self.flush_every = max(1, int(flush_every))
+        self._pending = 0
+        self._lock = threading.Lock()
+        self._f = open(path, "w", encoding="utf-8")
+        self._write({
+            "type": "meta", "version": 1, "role": role,
+            "pid": pid if pid is not None else os.getpid(),
+            "clock": clock(), "wall": wall(),
+        })
+        self.flush()  # the anchor lands immediately: a live file parses
+
+    def _write(self, record: Dict[str, Any]) -> None:
+        self._write_line(json.dumps(record, sort_keys=True, default=str))
+
+    def _write_line(self, line: str) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line + "\n")
+            self._pending += 1
+            if self._pending >= self.flush_every:
+                self._f.flush()
+                self._pending = 0
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._pending = 0
+
+    def event(self, name: str, at: float, dur_s: float = 0.0, *,
+              trace: Optional[str] = None, request: Optional[str] = None,
+              **fields: Any) -> None:
+        """One span event at ``at`` (span-clock seconds), ``dur_s`` long
+        (0 = instant). ``trace`` groups events onto one per-request
+        track in the merged timeline.
+
+        This is the engine tick path's only serialization site, so the
+        line is built by hand: ``name``/``trace`` come from trusted
+        sources (the span catalog; engine-minted hex ids) and numeric
+        fields self-serialize, leaving ``json.dumps`` — ~2.5x the cost
+        of the whole f-string path on the boxes this repo measures —
+        only for strings that genuinely need escaping.
+        """
+        parts = [f'{{"type":"event","name":"{name}","at":{at:.9f}'
+                 f',"dur_s":{dur_s:.9f}']
+        if trace is not None:
+            # The HTTP boundary only admits valid_trace_id() strings,
+            # but embedders call this directly — anything that could
+            # need escaping goes through json.dumps rather than
+            # corrupting the line (and every line after it a reader
+            # would misparse).
+            if trace.isascii() and trace.isalnum():
+                parts.append(f',"trace":"{trace}"')
+            else:
+                parts.append(',"trace":' + json.dumps(trace))
+        if request is not None:
+            parts.append(',"request":' + json.dumps(request))
+        if fields:
+            fs = ",".join(
+                f'"{k}":{v}'
+                if (type(v) is int) or (type(v) is float
+                                        and _NINF < v < _INF)
+                else f'"{k}":' + json.dumps(v, default=str)
+                for k, v in fields.items())
+            parts.append(',"fields":{' + fs + "}")
+        parts.append("}")
+        self._write_line("".join(parts))
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: bounded per-request lifecycles with phase attribution
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RequestTrace:
+    """One request's recorded lifecycle. ``phases`` partitions the
+    request's whole lifetime — the four keys sum to ``finished_at -
+    submitted_at`` exactly (each transition closes the previous
+    segment at the same timestamp the next one opens)."""
+
+    trace_id: str
+    request_id: str
+    submitted_at: float
+    state: Optional[str] = "queue"     # None once finished
+    state_since: float = 0.0
+    phases: Dict[str, float] = field(default_factory=lambda: {
+        "queue_s": 0.0, "prefill_s": 0.0, "decode_s": 0.0,
+        "recompute_s": 0.0})
+    segments: List[Tuple[str, float, float]] = field(default_factory=list)
+    events: List[Dict[str, Any]] = field(default_factory=list)
+    events_dropped: int = 0
+    preemptions: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    outcome: str = ""
+    finished_at: Optional[float] = None
+
+    @property
+    def e2e_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "request_id": self.request_id,
+            "submitted_at": round(self.submitted_at, 9),
+            "phases": {k: round(v, 9) for k, v in self.phases.items()},
+            "preemptions": self.preemptions,
+            "events": len(self.events),
+            "events_dropped": self.events_dropped,
+            "outcome": self.outcome,
+        }
+        if self.spec_proposed:
+            out["spec"] = {"proposed": self.spec_proposed,
+                           "accepted": self.spec_accepted}
+        if self.finished_at is not None:
+            out["e2e_s"] = round(self.e2e_s, 9)
+        return out
+
+
+class FlightRecorder:
+    """Bounded in-memory lifecycle store for the serving engine.
+
+    The engine (single-owner) drives ``begin``/``event``/``finish``;
+    ``/stats`` handler threads read ``snapshot()`` and the exemplar
+    path reads ``lookup()`` — hence the lock. Finished lifecycles live
+    in a bounded deque (oldest evicted); per-request event lists are
+    capped too (``events_dropped`` counts the overflow) so a
+    pathological request cannot grow memory without bound. With a
+    :class:`TraceWriter` attached every event also lands as a JSON
+    line the instant it happens, which is why a dead engine loop still
+    leaves complete post-mortem traces (``flush_aborted``).
+    """
+
+    def __init__(self, limit: int = 256, events_per_request: int = 256,
+                 writer: Optional[TraceWriter] = None):
+        if limit < 1:
+            raise ValueError(f"limit must be >= 1, got {limit}")
+        self._lock = threading.Lock()
+        self._live: Dict[str, RequestTrace] = {}
+        self.finished: Deque[RequestTrace] = deque(maxlen=limit)
+        self.events_per_request = events_per_request
+        self.writer = writer
+
+    # ------------------------------------------------------------ record
+    def begin(self, request_id: str, trace_id: Optional[str],
+              at: float) -> None:
+        rec = RequestTrace(trace_id=trace_id or request_id,
+                           request_id=request_id, submitted_at=at,
+                           state="queue", state_since=at)
+        with self._lock:
+            self._live[request_id] = rec
+        self._record(rec, "serve.submitted", at, {})
+
+    def event(self, request_id: str, name: str, at: float,
+              **fields: Any) -> None:
+        with self._lock:
+            rec = self._live.get(request_id)
+        if rec is None:
+            return
+        self._record(rec, name, at, fields)
+
+    def finish(self, request_id: str, at: float,
+               outcome: str) -> Optional[RequestTrace]:
+        with self._lock:
+            rec = self._live.pop(request_id, None)
+        if rec is None:
+            return None
+        self._record(rec, "serve.finish", at, {"reason": outcome})
+        self._close(rec, at, outcome)
+        return rec
+
+    def flush_aborted(self, at: float, error: str) -> List[RequestTrace]:
+        """Engine-loop death: finalize every in-flight lifecycle as
+        ``aborted`` so its partial phase attribution survives into the
+        bounded store and (when a writer is attached) onto disk — the
+        post-mortem trace of exactly the requests the crash killed."""
+        with self._lock:
+            live, self._live = self._live, {}
+        out = []
+        for rec in live.values():
+            self._record(rec, "serve.abort", at, {"error": error})
+            self._close(rec, at, "aborted")
+            out.append(rec)
+        if self.writer is not None:
+            # Force the buffered lines out: the process may be about to
+            # be restarted by its liveness probe.
+            self.writer.flush()
+        return out
+
+    def _record(self, rec: RequestTrace, name: str, at: float,
+                fields: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(rec.events) < self.events_per_request:
+                ev = {"name": name, "at": at}
+                ev.update(fields)
+                rec.events.append(ev)
+            else:
+                rec.events_dropped += 1
+            if name == "serve.preempt":
+                rec.preemptions += 1
+            elif name == "serve.verify":
+                rec.spec_proposed += int(fields.get("proposed", 0))
+                rec.spec_accepted += int(fields.get("accepted", 0))
+            state = _EVENT_STATE.get(name)
+            if name == "serve.admitted":
+                state = "recompute" if fields.get("recompute") else "prefill"
+            if state is not None and rec.state is not None:
+                self._transition(rec, state, at)
+        if self.writer is not None:
+            self.writer.event(name, at, trace=rec.trace_id,
+                              request=rec.request_id, **fields)
+
+    def _transition(self, rec: RequestTrace, state: str,
+                    at: float) -> None:
+        # Close the open segment at exactly the timestamp the next one
+        # opens: the segments tile [submitted_at, finished_at] with no
+        # gap and no overlap, which is the summed-equals-e2e pin.
+        if rec.state is not None and at > rec.state_since:
+            rec.phases[rec.state + "_s"] += at - rec.state_since
+            if len(rec.segments) < self.events_per_request:
+                rec.segments.append((rec.state, rec.state_since, at))
+        rec.state, rec.state_since = state, at
+
+    def _close(self, rec: RequestTrace, at: float, outcome: str) -> None:
+        with self._lock:
+            self._transition(rec, "done", at)
+            rec.state = None
+            rec.outcome = outcome
+            rec.finished_at = at
+            self.finished.append(rec)
+            segments = list(rec.segments)
+        if self.writer is not None:
+            for state, t0, t1 in segments:
+                self.writer.event("serve.phase", t0, t1 - t0,
+                                  trace=rec.trace_id,
+                                  request=rec.request_id, state=state)
+
+    def step(self, at: float, dur_s: float, finished: int) -> None:
+        """One engine tick span (writer-only: ticks are process-level,
+        not per-request, so the bounded store never sees them)."""
+        if self.writer is not None:
+            self.writer.event("serve.step", at, dur_s, finished=finished)
+
+    # -------------------------------------------------------------- read
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def lookup(self, trace_id: str) -> Optional[RequestTrace]:
+        """The lifecycle behind a trace id (exemplar resolution):
+        finished first (newest wins), then in-flight."""
+        with self._lock:
+            for rec in reversed(self.finished):
+                if rec.trace_id == trace_id:
+                    return rec
+            for rec in self._live.values():
+                if rec.trace_id == trace_id:
+                    return rec
+        return None
+
+    def snapshot(self, limit: int = 32) -> Dict[str, Any]:
+        with self._lock:
+            recent = list(self.finished)[-limit:]
+            in_flight = len(self._live)
+        return {"in_flight": in_flight,
+                "finished": [r.to_dict() for r in recent]}
+
+
+# ---------------------------------------------------------------------------
+# Fleet merge: N per-process JSONL files -> ONE Perfetto timeline
+# ---------------------------------------------------------------------------
+
+class TraceMergeError(ValueError):
+    """A trace JSONL input cannot be merged (missing/malformed meta
+    anchor or an unparsable line) — named by file and line so the
+    operator fixes the right capture."""
+
+
+def read_trace_jsonl(path: str) -> Tuple[Dict[str, Any],
+                                         List[Dict[str, Any]]]:
+    """(meta, events) from one per-process trace file. Strict: the
+    first line must be the meta anchor (no anchor = no clock alignment
+    = a silently wrong timeline)."""
+    meta: Optional[Dict[str, Any]] = None
+    events: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise TraceMergeError(
+                    f"{path}:{lineno}: not valid JSON: {e}") from None
+            kind = rec.get("type")
+            if kind == "meta":
+                if meta is not None:
+                    raise TraceMergeError(
+                        f"{path}:{lineno}: duplicate meta anchor")
+                if not isinstance(rec.get("clock"), (int, float)) \
+                        or not isinstance(rec.get("wall"), (int, float)):
+                    raise TraceMergeError(
+                        f"{path}:{lineno}: meta anchor needs numeric "
+                        f"clock and wall readings")
+                meta = rec
+            elif kind == "event":
+                if meta is None:
+                    raise TraceMergeError(
+                        f"{path}:{lineno}: event before the meta anchor")
+                if not isinstance(rec.get("name"), str) \
+                        or not isinstance(rec.get("at"), (int, float)):
+                    raise TraceMergeError(
+                        f"{path}:{lineno}: event needs a name and a "
+                        f"numeric at")
+                events.append(rec)
+            else:
+                raise TraceMergeError(
+                    f"{path}:{lineno}: unknown record type {kind!r}")
+    if meta is None:
+        raise TraceMergeError(f"{path}: no meta anchor (empty trace?)")
+    return meta, events
+
+
+def merge_trace_files(paths: Sequence[str]) -> Dict[str, Any]:
+    """Align every file's span clock through its meta anchor and emit
+    one Chrome/Perfetto trace: one pid per process (named by role),
+    tid 0 for process-level spans (engine ticks, operator ticks), one
+    tid per trace id so each request's lifecycle — across every
+    process it touched — reads as parallel tracks of one timeline."""
+    trace_events: List[Dict[str, Any]] = []
+    for pid, path in enumerate(paths):
+        meta, events = read_trace_jsonl(path)
+        offset = float(meta["wall"]) - float(meta["clock"])
+        role = str(meta.get("role", f"proc-{pid}"))
+        trace_events.append({"ph": "M", "name": "process_name",
+                             "pid": pid, "tid": 0, "ts": 0.0,
+                             "args": {"name": role}})
+        tids: Dict[str, int] = {}
+        for rec in events:
+            trace = rec.get("trace")
+            if trace is None:
+                tid = 0
+            elif trace in tids:
+                tid = tids[trace]
+            else:
+                tid = tids[trace] = len(tids) + 1
+                trace_events.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "ts": 0.0,
+                    "args": {"name": f"trace {trace}"}})
+            dur_s = float(rec.get("dur_s", 0.0))
+            args: Dict[str, Any] = dict(rec.get("fields") or {})
+            if trace is not None:
+                args["trace"] = trace
+            if rec.get("request") is not None:
+                args["request"] = rec["request"]
+            ev: Dict[str, Any] = {
+                "name": rec["name"], "cat": "span",
+                "ts": round((offset + float(rec["at"])) * 1e6, 3),
+                "pid": pid, "tid": tid, "args": args,
+            }
+            if dur_s > 0.0:
+                ev["ph"] = "X"
+                ev["dur"] = round(dur_s * 1e6, 3)
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            trace_events.append(ev)
+    trace_events.sort(key=lambda e: (e["ph"] != "M", e["ts"],
+                                     e["pid"], e["tid"]))
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Structural validation of a merged timeline (the CI evidence
+    gate's schema check). Returns problems, [] when valid."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["top level is not an object"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents is missing or not a list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            problems.append(f"{where}: missing name")
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M"):
+            problems.append(f"{where}: unknown phase {ph!r}")
+            continue
+        if not isinstance(ev.get("pid"), int) \
+                or not isinstance(ev.get("tid"), int):
+            problems.append(f"{where}: pid/tid must be integers")
+        if not isinstance(ev.get("ts"), (int, float)):
+            problems.append(f"{where}: ts must be a number")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: complete event needs a "
+                                f"non-negative dur")
+        if ph == "i" and ev.get("s") not in ("t", "p", "g"):
+            problems.append(f"{where}: instant event needs scope s in "
+                            f"t/p/g")
+    return problems
