@@ -16,8 +16,20 @@ Two halves, both deterministic:
    catch -> shrink -> corpus pipeline end to end, not just the happy
    path. The shrunk spec is included in the artifact and must match the
    committed corpus entry's verdict.
+3. **The workload sweep** (ISSUE 16) — >= 150 scenarios from the
+   `workload` + `workload-train` profiles, every one running a real
+   serving/training fault arm (replica death, mid-prefill preemption,
+   torn checkpoint, rank/coordinator death, SIGTERM flush) through the
+   trace-timeline oracle. The gate: all pass (a train arm may report
+   itself skipped only when the box has no multihost backend — skips
+   are counted in the artifact, never silent).
+4. **The workload forced shrinks** — one per workload oracle:
+   `dropped-reland` -> reland-parity, `leaked-pages` ->
+   pool-convergence, `swallowed-abort` -> trace-valid, each caught and
+   shrunk to <= 2 non-default fault fields.
 
 Usage: python scripts/ci/chaos_evidence.py [tag] [--runs N]
+           [--workload-runs N]
 """
 
 import json
@@ -31,11 +43,26 @@ from triton_kubernetes_tpu.chaos import (  # noqa: E402
     generate_spec, load_entries, run_scenario, run_sweep, scenario_seed,
     shrink_spec)
 from triton_kubernetes_tpu.chaos.corpus import CORPUS_DIR  # noqa: E402
-from triton_kubernetes_tpu.chaos.shrink import spec_size  # noqa: E402
+from triton_kubernetes_tpu.chaos.shrink import (  # noqa: E402
+    spec_size, workload_fault_fields)
 from triton_kubernetes_tpu.utils import metrics  # noqa: E402
 
 SWEEP_SEED = 20260804
 MUTATION_SEED = 3  # the committed mutation-unfaulted-reference ancestor
+
+#: (mutation, fault kind, pinned fields, invariant that must catch it)
+#: — one forced shrink per workload oracle. The pinned fields are the
+#: ones each mutation needs to bite (a leak needs cache-held pages; an
+#: abort flush needs an abort).
+WORKLOAD_MUTATIONS = (
+    ("dropped-reland", "replica-death",
+     {"die_after_tokens": 3, "max_new_tokens": 8}, "reland-parity"),
+    ("leaked-pages", "engine-preempt",
+     {"prefix_cache": True, "long_windows": 5, "requests": 3},
+     "pool-convergence"),
+    ("swallowed-abort", "engine-preempt",
+     {"long_windows": 5, "abort_after_steps": 3}, "trace-valid"),
+)
 
 
 def _coverage(seed: int, runs: int, profile: str) -> dict:
@@ -51,16 +78,22 @@ def _coverage(seed: int, runs: int, profile: str) -> dict:
     return {"providers": sorted(providers), "parallelism": sorted(widths)}
 
 
+def _int_flag(args, flag, default):
+    if flag not in args:
+        return default
+    i = args.index(flag)
+    if i + 1 >= len(args):
+        print(f"error: {flag} needs a value", file=sys.stderr)
+        raise SystemExit(2)
+    value = int(args[i + 1])
+    del args[i:i + 2]
+    return value
+
+
 def main(argv):
     args = list(argv[1:])
-    runs = 200
-    if "--runs" in args:
-        i = args.index("--runs")
-        if i + 1 >= len(args):
-            print("error: --runs needs a value", file=sys.stderr)
-            return 2
-        runs = int(args[i + 1])
-        del args[i:i + 2]
+    runs = _int_flag(args, "--runs", 200)
+    workload_runs = _int_flag(args, "--workload-runs", 150)
     # Flags consumed above; whatever remains is the tag (sibling evidence
     # scripts are tag-only, so the tag must not swallow a flag).
     tag = args[0] if args else "local"
@@ -106,6 +139,52 @@ def main(argv):
         replayed = run_scenario(entry["spec"], ns="evidence-replay")
         assert replayed.violated(entry["invariant"]), entry["name"]
 
+    # --- half 3: the workload fault sweep (ISSUE 16). Train arms
+    # launch real multi-process trainers (~45s each), so they get a
+    # small fixed share; the serving arms carry the volume.
+    train_runs = min(4, workload_runs)
+    per_workload = {"workload": workload_runs - train_runs,
+                    "workload-train": train_runs}
+    wl_reports = {}
+    wl_kinds = {}
+    wl_skipped = 0
+    for profile, n in per_workload.items():
+        rep = run_sweep(seed=SWEEP_SEED, runs=n, profile=profile,
+                        shrink=False)
+        wl_reports[profile] = rep
+        for i in range(n):
+            spec = generate_spec(scenario_seed(SWEEP_SEED, i), profile)
+            kind = (spec.get("workload") or {}).get("kind")
+            wl_kinds[kind] = wl_kinds.get(kind, 0) + 1
+    wl_total = sum(r.runs for r in wl_reports.values())
+    wl_failed = sum(r.failed for r in wl_reports.values())
+    arm_counts = metrics.get_registry().snapshot().get(
+        "tk8s_chaos_workload_arms_total", {})
+    wl_skipped = int(sum(
+        s["value"] for s in arm_counts.get("series", [])
+        if s["labels"].get("status") == "skipped"))
+
+    # --- half 4: one forced shrink per workload oracle.
+    wl_shrinks = {}
+    for mutation, kind, fields, invariant in WORKLOAD_MUTATIONS:
+        bad = generate_spec(MUTATION_SEED, "workload")
+        bad["workload"] = dict({"kind": kind}, **fields)
+        bad["mutation"] = mutation
+        caught_wl = run_scenario(bad, ns="evidence-wl-mutation")
+        assert caught_wl.violated(invariant), \
+            f"workload mutation {mutation} NOT caught by {invariant}: " \
+            f"the {invariant} checker has rotted"
+        mini_wl, mini_wl_result = shrink_spec(bad, caught_wl)
+        wf = workload_fault_fields(mini_wl)
+        assert mini_wl_result.violated(invariant) and wf <= 2, \
+            f"workload shrink did not reach the minimal bar for " \
+            f"{mutation}: {wf} non-default fault fields"
+        wl_shrinks[mutation] = {
+            "invariant": invariant,
+            "shrunk_workload": mini_wl["workload"],
+            "fault_fields": wf,
+        }
+
     checks = metrics.get_registry().snapshot().get(
         "tk8s_chaos_invariant_checks_total")
 
@@ -132,6 +211,19 @@ def main(argv):
             "committed_entries_replayed": [e["name"]
                                            for e in mutation_entries],
         },
+        "workload_sweep": {
+            "seed": SWEEP_SEED,
+            "scenarios": wl_total,
+            "passed": wl_total - wl_failed,
+            "failed": wl_failed,
+            "skipped_arms": wl_skipped,
+            "kinds": {k: v for k, v in sorted(wl_kinds.items())
+                      if k is not None},
+            "profiles": {p: r.to_dict() for p, r in wl_reports.items()},
+            "simulated_seconds": round(sum(
+                r.simulated_seconds for r in wl_reports.values()), 3),
+        },
+        "workload_forced_shrinks": wl_shrinks,
         "invariant_check_counters": checks,
     }
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
@@ -139,8 +231,8 @@ def main(argv):
         json.dump(evidence, f, indent=2, sort_keys=True)
         f.write("\n")
 
-    if failed:
-        for profile, r in reports.items():
+    if failed or wl_failed:
+        for profile, r in list(reports.items()) + list(wl_reports.items()):
             for res in r.results:
                 print(f"FAIL [{profile}] seed {res.spec['seed']}: "
                       f"{res.violations}")
@@ -148,7 +240,11 @@ def main(argv):
         return 1
     print(f"wrote {out_path} ({total} scenarios passed across "
           f"providers={all_providers} parallelism={all_widths}; "
-          f"forced shrink -> {mods} modules / {rules} rules)")
+          f"forced shrink -> {mods} modules / {rules} rules; "
+          f"{wl_total} workload scenarios passed across "
+          f"kinds={sorted(k for k in wl_kinds if k)} "
+          f"[{wl_skipped} arm skips]; {len(wl_shrinks)} workload "
+          f"mutations caught+shrunk to <= 2 fault fields)")
     return 0
 
 

@@ -40,7 +40,10 @@ from triton_kubernetes_tpu.chaos.corpus import (
     save_entry,
 )
 from triton_kubernetes_tpu.chaos.runner import ScenarioResult
-from triton_kubernetes_tpu.chaos.shrink import spec_size
+from triton_kubernetes_tpu.chaos.shrink import (
+    spec_size,
+    workload_fault_fields,
+)
 from triton_kubernetes_tpu.executor import (
     DagSpecError,
     LocalExecutor,
@@ -68,7 +71,8 @@ def _no_sleep(delay):
 # -------------------------------------------------------------- generation
 
 def test_generation_is_deterministic_per_seed():
-    for profile in ("quick", "default", "tpu", "soak"):
+    for profile in ("quick", "default", "tpu", "soak",
+                    "workload", "workload-train"):
         a = generate_spec(123, profile)
         b = generate_spec(123, profile)
         assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
@@ -189,16 +193,44 @@ def test_committed_corpus_is_nonempty_and_covers_the_provider_matrix():
         assert f"provider-{prov}" in names, f"missing {prov} coverage entry"
     assert any(n.startswith("tpu-") for n in names)
     assert any(n.startswith("mutation-") for n in names)
+    # ISSUE 16: one replay pin per workload fault class, plus one
+    # mutation self-test per workload oracle (parity, pool, trace).
+    from triton_kubernetes_tpu.chaos.corpus import WORKLOAD_FAULT_KINDS
+
+    pinned_kinds = {(e["spec"].get("workload") or {}).get("kind")
+                    for _, e in _ENTRIES}
+    assert set(WORKLOAD_FAULT_KINDS) <= pinned_kinds, \
+        set(WORKLOAD_FAULT_KINDS) - pinned_kinds
+    for mut in ("mutation-dropped-reland", "mutation-leaked-pages",
+                "mutation-swallowed-abort"):
+        assert mut in names, f"missing workload mutation self-test {mut}"
 
 
-@pytest.mark.parametrize("path,entry", _ENTRIES,
-                         ids=[e["name"] for _, e in _ENTRIES])
+#: Workload arms that launch subprocesses or a whole router fleet run
+#: multiple seconds each — their replay pins ride the nightly `slow`
+#: lane; everything else (and every infra-only entry) stays tier-1.
+_SLOW_WORKLOAD_KINDS = ("replica-death", "rank-death", "coordinator-loss")
+
+
+def _replay_params():
+    params = []
+    for path, entry in _ENTRIES:
+        kind = (entry["spec"].get("workload") or {}).get("kind")
+        marks = ([pytest.mark.slow] if kind in _SLOW_WORKLOAD_KINDS
+                 else [])
+        params.append(pytest.param(path, entry, id=entry["name"],
+                                   marks=marks))
+    return params
+
+
+@pytest.mark.parametrize("path,entry", _replay_params())
 def test_corpus_entry_replays_to_its_pinned_verdict(path, entry):
     """THE regression pin: every corpus entry reproduces its verdict
     deterministically. ``pass`` entries hold the full invariant suite;
     ``violated`` entries (harness mutation self-tests) must still be
     caught on exactly the invariant they name, and must have shrunk to
-    the minimal-spec bar (<= 3 modules, <= 2 rules)."""
+    the minimal-spec bar (<= 3 modules, <= 2 rules; workload faults
+    additionally <= 2 non-default fault fields)."""
     result = replay(entry)
     if entry["expect"] == "pass":
         assert result.passed, result.violations
@@ -206,6 +238,9 @@ def test_corpus_entry_replays_to_its_pinned_verdict(path, entry):
         assert result.violated(entry["invariant"]), result.to_dict()
         mods, rules = spec_size(entry["spec"])
         assert mods <= 3 and rules <= 2, (mods, rules)
+        if entry["spec"].get("workload"):
+            assert workload_fault_fields(entry["spec"]) <= 2, \
+                entry["spec"]["workload"]
 
 
 # ---------------------------------------------------------------- kill hook

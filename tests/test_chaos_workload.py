@@ -1,0 +1,454 @@
+"""Workload-level chaos (ISSUE 16): the serving/training fault
+dimension drawn on top of the infra DAG, with the merged trace
+timeline as the generic oracle.
+
+Layers under test:
+
+* **generation** — the ``workload``/``workload-train`` profiles always
+  draw a fault from the closed kind set; pre-existing profiles draw
+  none AND consume zero extra rng — every committed corpus entry's
+  stream is byte-identical to before this dimension existed;
+* **schema** — workload faults round-trip through ``corpus.py``;
+* **shrinking** — the workload moves (drop whole, walk fields to their
+  kind defaults, halve ints) minimize to <= 2 non-default fields;
+* **the oracle** — ``validate_chaos_trace`` unit-tested on hand-built
+  trace files for each failure direction it must catch;
+* **the recorder** — chunked-prefill waits book as ``queue``, never
+  ``prefill`` (the satellite-1 phase-gap regression);
+* **the arms** — engine-preempt end to end through the real paged
+  engine, skip accounting, and the `slow` simulated-hours soak.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from triton_kubernetes_tpu.chaos.corpus import (
+    WORKLOAD_DEFAULTS,
+    WORKLOAD_FAULT_KINDS,
+    validate_workload,
+)
+from triton_kubernetes_tpu.chaos.generator import (
+    PROFILES,
+    _draw_workload,
+    generate_spec,
+)
+from triton_kubernetes_tpu.chaos.runner import (
+    ScenarioResult,
+    run_scenario,
+)
+from triton_kubernetes_tpu.chaos.shrink import (
+    _candidates,
+    shrink_spec,
+    workload_fault_fields,
+)
+from triton_kubernetes_tpu.utils.trace import (
+    FlightRecorder,
+    TraceWriter,
+    validate_chaos_trace,
+)
+
+
+# ------------------------------------------------------------ generation
+
+def test_workload_profile_always_draws_a_valid_serving_fault():
+    serving = {name for name, _ in PROFILES["workload"]["workload_kinds"]}
+    assert serving <= set(WORKLOAD_FAULT_KINDS)
+    for seed in range(30):
+        spec = generate_spec(seed, "workload")
+        wl = spec["workload"]
+        assert wl is not None and wl["kind"] in serving
+        assert validate_workload(wl) == []
+
+
+def test_workload_train_profile_draws_training_kinds():
+    train = {name for name, _
+             in PROFILES["workload-train"]["workload_kinds"]}
+    assert train <= set(WORKLOAD_FAULT_KINDS)
+    assert {"rank-death", "coordinator-loss"} <= train
+    kinds_seen = set()
+    for seed in range(30):
+        wl = generate_spec(seed, "workload-train")["workload"]
+        assert wl is not None and wl["kind"] in train
+        assert validate_workload(wl) == []
+        kinds_seen.add(wl["kind"])
+    assert len(kinds_seen) >= 2
+
+
+def test_preexisting_profiles_never_draw_a_workload_fault():
+    for profile in ("quick", "default", "tpu", "soak"):
+        for seed in range(30):
+            assert generate_spec(seed, profile)["workload"] is None
+
+
+def test_unweighted_profiles_consume_zero_rng_draws():
+    """The stream-stability pin: for a profile without
+    ``workload_weight`` the draw must not touch the rng AT ALL — one
+    consumed draw would shift every later field of every committed
+    corpus spec."""
+    probe, control = random.Random(7), random.Random(7)
+    assert _draw_workload(probe, PROFILES["default"]) is None
+    assert probe.getstate() == control.getstate()
+    # Weighted profiles DO consume draws (sanity check on the probe).
+    _draw_workload(probe, PROFILES["workload"])
+    assert probe.getstate() != control.getstate()
+
+
+# ---------------------------------------------------------------- schema
+
+def test_validate_workload_round_trips_and_rejects():
+    assert validate_workload(None) == []
+    for kind in WORKLOAD_FAULT_KINDS:
+        assert validate_workload({"kind": kind}) == []
+        assert validate_workload(
+            dict(WORKLOAD_DEFAULTS[kind], kind=kind)) == []
+    assert validate_workload("replica-death")  # not an object
+    assert any("kind" in p for p in
+               validate_workload({"kind": "meteor-strike"}))
+    assert any("unknown fields" in p for p in validate_workload(
+        {"kind": "engine-preempt", "die_after_tokens": 2}))
+
+
+# ------------------------------------------------------------- shrinking
+
+def test_workload_fault_fields_counts_distance_from_defaults():
+    base = generate_spec(0, "workload")
+    spec = dict(base, workload=None)
+    assert workload_fault_fields(spec) == 0
+    spec = dict(base, workload={"kind": "engine-preempt"})
+    assert workload_fault_fields(spec) == 0
+    spec = dict(base, workload={"kind": "engine-preempt",
+                                "prefix_cache": False,  # == default
+                                "long_windows": 5,
+                                "requests": 3})
+    assert workload_fault_fields(spec) == 2
+
+
+def test_shrink_candidates_include_workload_moves():
+    spec = generate_spec(0, "workload")
+    spec["workload"] = {"kind": "replica-death", "replicas": 3,
+                        "die_after_tokens": 4}
+    cands = list(_candidates(spec))
+    workloads = [c["workload"] for c in cands]
+    assert None in workloads  # drop-whole move
+    # Field-to-default moves, one per non-default field.
+    assert any(w and w.get("replicas") == 2 and
+               w.get("die_after_tokens") == 4 for w in workloads)
+    assert any(w and w.get("replicas") == 3 and
+               w.get("die_after_tokens") == 1 for w in workloads)
+    # Int halving toward the default (4 -> 1+(4-1)//2 == 2).
+    assert any(w and w.get("die_after_tokens") == 2 for w in workloads)
+
+
+def test_shrink_minimizes_workload_fields_with_injected_runner():
+    """Greedy shrink over the workload moves alone: a synthetic
+    invariant that fails iff the fault kind injects an abort must
+    shrink every other field back to its default — the <= 2
+    non-default-fields bar the corpus pins assert."""
+    spec = generate_spec(3, "workload")
+    spec["workload"] = {"kind": "engine-preempt", "prefix_cache": True,
+                        "long_windows": 5, "requests": 3,
+                        "spec_k": 3, "abort_after_steps": 6}
+
+    def fake_run(s):
+        res = ScenarioResult(spec=s)
+        res.checked.append("trace-valid")
+        wl = s.get("workload") or {}
+        if wl.get("kind") == "engine-preempt" \
+                and wl.get("abort_after_steps"):
+            res.violations.append({"invariant": "trace-valid",
+                                   "detail": "synthetic"})
+        return res
+
+    minimal, result = shrink_spec(spec, run=fake_run)
+    assert result.violated("trace-valid")
+    assert minimal["workload"]["kind"] == "engine-preempt"
+    assert workload_fault_fields(minimal) <= 2
+    assert minimal["workload"].get("abort_after_steps")
+    # Fields irrelevant to the repro walked back to their defaults.
+    assert minimal["workload"].get("prefix_cache", False) is False
+    assert minimal["workload"].get("requests", 2) == 2
+
+
+# ------------------------------------------------------------ the oracle
+
+def _trace_file(tmp_path, name, events, role="replica"):
+    """A hand-built trace file: ManualClock-style anchor plus the given
+    (name, at, dur_s, trace, request, fields) events."""
+    path = str(tmp_path / name)
+    w = TraceWriter(path, role=role, clock=lambda: 0.0,
+                    wall=lambda: 1_000.0)
+    for ev_name, at, dur, trace, request, fields in events:
+        w.event(ev_name, at, dur, trace=trace, request=request,
+                **fields)
+    w.close()
+    return path
+
+
+def _lifecycle(rid, trace, t0=0.0, queue=0.25, prefill=0.5, decode=1.0):
+    t1, t2, t3 = t0 + queue, t0 + queue + prefill, \
+        t0 + queue + prefill + decode
+    return [
+        ("serve.submitted", t0, 0.0, trace, rid, {}),
+        ("serve.admitted", t1, 0.0, trace, rid, {"deferred": True}),
+        ("serve.prefill", t1, 0.0, trace, rid, {"offset": 0}),
+        ("serve.first_token", t2, 0.0, trace, rid, {}),
+        ("serve.finish", t3, 0.0, trace, rid, {"reason": "eos"}),
+        ("serve.phase", t0, queue, trace, rid, {"state": "queue"}),
+        ("serve.phase", t1, prefill, trace, rid, {"state": "prefill"}),
+        ("serve.phase", t2, decode, trace, rid, {"state": "decode"}),
+    ]
+
+
+def test_oracle_accepts_a_complete_lifecycle(tmp_path):
+    path = _trace_file(tmp_path, "ok.jsonl", _lifecycle("r1", "t1"))
+    assert validate_chaos_trace([path]) == []
+
+
+def test_oracle_flags_a_request_with_no_terminal(tmp_path):
+    events = [e for e in _lifecycle("r1", "t1")
+              if e[0] not in ("serve.finish",)][:4]
+    path = _trace_file(tmp_path, "dangling.jsonl", events)
+    problems = validate_chaos_trace([path])
+    assert any("no terminal" in p for p in problems), problems
+
+
+def test_oracle_flags_phase_sum_mismatch(tmp_path):
+    events = _lifecycle("r1", "t1")
+    # Shave the decode segment: phases no longer tile submit..finish.
+    events[-1] = ("serve.phase", 0.75, 0.8, "t1", "r1",
+                  {"state": "decode"})
+    path = _trace_file(tmp_path, "short.jsonl", events)
+    problems = validate_chaos_trace([path])
+    assert any("phase" in p for p in problems), problems
+
+
+def test_oracle_flags_cross_request_prefill_overlap(tmp_path):
+    # Two requests both booked in prefill over the same instants — the
+    # engine runs ONE window per tick, so someone's inter-window wait
+    # was booked as prefill instead of queue (the satellite-1 bug).
+    events = (_lifecycle("r1", "t1") +
+              _lifecycle("r2", "t2", t0=0.1))
+    path = _trace_file(tmp_path, "overlap.jsonl", events)
+    problems = validate_chaos_trace([path])
+    assert any("prefill overlap" in p for p in problems), problems
+
+
+def test_oracle_requires_a_terminal_for_every_placement(tmp_path):
+    router = _trace_file(tmp_path, "router.jsonl", [
+        ("route.place", 0.0, 0.0, "t1", None,
+         {"replica": "r0", "status": 200}),
+        ("route.place", 0.1, 0.0, "t2", None,
+         {"replica": "r0", "status": 200}),
+        ("route.abort", 0.4, 0.0, "t2", None,
+         {"replica": "r0", "reason": "ejected"}),
+    ], role="router")
+    replica = _trace_file(tmp_path, "replica.jsonl",
+                          _lifecycle("r1", "t1"))
+    # t1 finished on the replica, t2 was aborted by the router: valid.
+    assert validate_chaos_trace([router, replica]) == []
+    # Drop the abort: t2 is a placement with no terminal anywhere.
+    router2 = _trace_file(tmp_path, "router2.jsonl", [
+        ("route.place", 0.0, 0.0, "t2", None,
+         {"replica": "r0", "status": 200}),
+    ], role="router")
+    problems = validate_chaos_trace([router2, replica])
+    assert any("route.place without terminal" in p for p in problems), \
+        problems
+
+
+def test_oracle_flags_undeclared_span_names(tmp_path):
+    path = _trace_file(tmp_path, "rogue.jsonl", [
+        ("serve.rogue", 0.0, 0.0, "t1", "r1", {}),
+    ])
+    problems = validate_chaos_trace([path])
+    assert any("undeclared span name" in p for p in problems), problems
+
+
+# --------------------------------------------- recorder phase attribution
+
+def test_recorder_books_interwindow_wait_as_queue(tmp_path):
+    """The satellite-1 regression, recorder-level: a chunked-prefill
+    admission (deferred=True) keeps the request in `queue` until its
+    first window, and a `serve.prefill_yield` between windows returns
+    it to `queue` — so the wait while ANOTHER request's window runs is
+    never booked as prefill."""
+    path = str(tmp_path / "recorder.jsonl")
+    w = TraceWriter(path, role="replica", clock=lambda: 0.0,
+                    wall=lambda: 1_000.0)
+    rec = FlightRecorder(writer=w)
+    rec.begin("r1", "t1", at=0.0)
+    rec.event("r1", "serve.admitted", at=1.0, deferred=True, pages=2)
+    rec.event("r1", "serve.prefill", at=2.0, offset=0, tokens=8)
+    rec.event("r1", "serve.prefill_yield", at=3.0, offset=8)
+    # 3.0 -> 6.0: the engine runs someone else's window.
+    rec.event("r1", "serve.prefill", at=6.0, offset=8, tokens=8)
+    rec.event("r1", "serve.first_token", at=7.0)
+    done = rec.finish("r1", at=9.0, outcome="eos")
+    w.close()
+    phases = done.phases
+    # queue: 0..2 (deferred admission grants pages, no compute) plus
+    # 3..6 (the yielded inter-window wait). prefill: ONLY the two
+    # windows actually computing, 2..3 and 6..7.
+    assert phases["queue_s"] == pytest.approx(5.0)
+    assert phases["prefill_s"] == pytest.approx(2.0)
+    assert phases["decode_s"] == pytest.approx(2.0)
+    assert validate_chaos_trace([path]) == []
+
+
+def test_recorder_books_legacy_admission_as_prefill():
+    rec = FlightRecorder()
+    rec.begin("r1", "t1", at=0.0)
+    rec.event("r1", "serve.admitted", at=1.0, pages=2)  # not deferred
+    rec.event("r1", "serve.first_token", at=3.0)
+    done = rec.finish("r1", at=4.0, outcome="eos")
+    assert done.phases["queue_s"] == pytest.approx(1.0)
+    assert done.phases["prefill_s"] == pytest.approx(2.0)
+
+
+def test_recorder_flushes_aborts_with_partial_phases(tmp_path):
+    path = str(tmp_path / "abort.jsonl")
+    w = TraceWriter(path, role="replica", clock=lambda: 0.0,
+                    wall=lambda: 1_000.0)
+    rec = FlightRecorder(writer=w)
+    rec.begin("r1", "t1", at=0.0)
+    rec.event("r1", "serve.admitted", at=0.5, deferred=True, pages=1)
+    out = rec.flush_aborted(at=2.0, error="chaos: loop death")
+    w.close()
+    assert [r.outcome for r in out] == ["aborted"]
+    assert out[0].phases["queue_s"] == pytest.approx(2.0)
+    # The flushed abort is a terminal: the oracle accepts the file.
+    assert validate_chaos_trace([path]) == []
+
+
+# ------------------------------------------------------------------ arms
+
+def _workload_spec(seed, kind, **fields):
+    """A real generated infra spec with the workload fault pinned."""
+    spec = generate_spec(seed, "workload")
+    spec["workload"] = dict({"kind": kind}, **fields)
+    return spec
+
+
+def test_engine_preempt_arm_preempts_and_holds_every_invariant():
+    """End to end through the real paged engine: pool pressure forces
+    a preemption, outputs stay bitwise-identical, pages converge, and
+    the interleaved chunked-prefill trace passes the oracle (the
+    prefill-exclusivity sweep is what catches satellite-1 regressions
+    at this level)."""
+    spec = _workload_spec(11, "engine-preempt",
+                          long_windows=5, requests=3)
+    res = run_scenario(spec, ns="wl-test")
+    assert res.passed, res.violations
+    assert res.stats["workload_kind"] == "engine-preempt"
+    assert res.stats.get("workload_preemptions", 0) >= 1
+    for inv in ("engine-parity", "pool-convergence", "trace-valid"):
+        assert inv in res.checked
+
+
+def test_engine_preempt_abort_flushes_every_lifecycle():
+    spec = _workload_spec(12, "engine-preempt",
+                          long_windows=5, abort_after_steps=3)
+    res = run_scenario(spec, ns="wl-test")
+    assert res.passed, res.violations
+
+
+def test_swallowed_abort_mutation_is_caught_by_the_trace_oracle():
+    spec = _workload_spec(13, "engine-preempt",
+                          long_windows=5, abort_after_steps=3)
+    spec["mutation"] = "swallowed-abort"
+    res = run_scenario(spec, ns="wl-test")
+    assert res.violated("trace-valid"), res.to_dict()
+    assert any("no terminal" in v["detail"]
+               for v in res.violations), res.violations
+
+
+def test_forced_shrink_leaked_pages_lands_minimal():
+    """The known-bad-mutation forced shrink (satellite 3): the
+    leaked-pages mutation (drain skipped) must be CAUGHT by
+    pool-convergence and then shrink to <= 2 non-default fault
+    fields — prefix_cache=True is the one field the leak needs."""
+    spec = _workload_spec(14, "engine-preempt", prefix_cache=True,
+                          long_windows=5, requests=3)
+    spec["mutation"] = "leaked-pages"
+    res = run_scenario(spec, ns="wl-test")
+    assert res.violated("pool-convergence"), res.to_dict()
+    minimal, mres = shrink_spec(spec, result=res)
+    assert mres.violated("pool-convergence")
+    assert minimal["workload"]["kind"] == "engine-preempt"
+    assert minimal["workload"].get("prefix_cache") is True
+    assert workload_fault_fields(minimal) <= 2, minimal["workload"]
+
+
+def test_torn_checkpoint_arm_all_corruption_modes(tmp_path):
+    for corruption in ("truncate", "bitflip", "torn-manifest"):
+        spec = _workload_spec(15, "torn-checkpoint",
+                              corruption=corruption)
+        res = run_scenario(spec, ns="wl-test")
+        assert res.passed, (corruption, res.violations)
+        assert "ckpt-fallback" in res.checked
+
+
+def test_workload_skip_is_an_outcome_not_silence(monkeypatch):
+    from triton_kubernetes_tpu.chaos import workload as wl
+
+    def skipping_arm(cfg, spec, res, check, recorder):
+        raise wl.WorkloadArmSkipped("no multihost backend")
+
+    monkeypatch.setitem(wl._ARMS, "engine-preempt", skipping_arm)
+    spec = _workload_spec(16, "engine-preempt")
+    res = run_scenario(spec, ns="wl-test")
+    assert res.passed
+    assert res.stats["workload_skipped"] == "no multihost backend"
+
+
+@pytest.mark.slow
+def test_forced_shrink_dropped_reland_lands_minimal():
+    """Router-fleet forced shrink: the dropped-reland mutation
+    (re-landed output truncated at the death point) must be caught by
+    reland-parity and shrink minimal. Slow: every shrink candidate
+    boots a router + N HTTP replicas."""
+    spec = _workload_spec(17, "replica-death", replicas=3,
+                          die_after_tokens=3, max_new_tokens=8)
+    spec["mutation"] = "dropped-reland"
+    res = run_scenario(spec, ns="wl-test")
+    assert res.violated("reland-parity"), res.to_dict()
+    minimal, mres = shrink_spec(spec, result=res)
+    assert mres.violated("reland-parity")
+    assert minimal["workload"]["kind"] == "replica-death"
+    assert workload_fault_fields(minimal) <= 2, minimal["workload"]
+
+
+@pytest.mark.slow
+def test_sigterm_flush_arm_lands_every_placement():
+    spec = _workload_spec(18, "sigterm-flush", after_requests=2)
+    res = run_scenario(spec, ns="wl-test")
+    assert res.passed, res.violations
+    assert "flush-clean" in res.checked
+
+
+@pytest.mark.slow
+def test_soak_runs_simulated_hours_of_engine_chaos():
+    """The soak arm contract: hours of simulated clock in wall-clock
+    seconds. Raising the engine's ManualClock tick makes every engine
+    step cost 30 simulated seconds, so a handful of preemption
+    scenarios covers a multi-hour timeline; the trace oracle holds at
+    soak timescales exactly as at test timescales."""
+    from triton_kubernetes_tpu.chaos import workload as wl
+
+    old = wl.ENGINE_CLOCK_TICK
+    wl.ENGINE_CLOCK_TICK = 30.0
+    simulated = 0.0
+    try:
+        for seed in (21, 22, 23, 24):
+            spec = _workload_spec(seed, "engine-preempt",
+                                  long_windows=5, requests=3)
+            res = run_scenario(spec, ns="wl-soak")
+            assert res.passed, res.violations
+            simulated += res.stats["simulated_seconds"]
+    finally:
+        wl.ENGINE_CLOCK_TICK = old
+    assert simulated >= 2 * 3600, simulated

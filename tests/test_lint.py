@@ -41,11 +41,11 @@ def hits(findings, code):
 
 # ---------------------------------------------------------------- registry
 
-def test_at_least_eleven_active_rules():
+def test_at_least_twelve_active_rules():
     codes = {r.code for r in RULES}
-    assert len(codes) >= 11
+    assert len(codes) >= 12
     assert codes == ({f"TK8S10{i}" for i in range(1, 10)}
-                     | {"TK8S110", "TK8S111"})
+                     | {"TK8S110", "TK8S111", "TK8S112"})
 
 
 # ----------------------------------------------------------- TK8S101
@@ -462,6 +462,129 @@ def test_tk8s111_writer_style_first_arg_and_scope(tmp_path):
     got = hits(findings, "TK8S111")
     assert ("triton_kubernetes_tpu/operator/x.py", 2) in got
     assert not any(p.endswith("workflows/y.py") for p, _ in got)
+
+
+# ----------------------------------------------------------- TK8S112
+
+WORKLOAD_CORPUS_MODULE = """\
+    _SPEC_KEYS = ("version", "seed", "faults", "workload")
+
+    WORKLOAD_FAULT_KINDS = ("replica-death", "engine-preempt",
+                            "torn-checkpoint")
+
+    WORKLOAD_DEFAULTS = {
+        "replica-death": {"die_after_tokens": 3},
+        "engine-preempt": {"long_windows": 5},
+        "torn-checkpoint": {"corruption": "truncate"},
+    }
+"""
+
+
+def test_tk8s112_clean_when_vocabulary_agrees(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/chaos/corpus.py": WORKLOAD_CORPUS_MODULE,
+        "triton_kubernetes_tpu/chaos/workload.py": """\
+            _ARMS = {
+                "replica-death": None,
+                "engine-preempt": None,
+                "torn-checkpoint": None,
+            }
+        """,
+        "triton_kubernetes_tpu/chaos/generator.py": """\
+            PROFILES = {
+                "workload": {
+                    "workload_kinds": (("replica-death", 3),
+                                       ("engine-preempt", 2)),
+                },
+            }
+        """,
+    })
+    findings, _ = lint_project(root)
+    assert hits(findings, "TK8S112") == []
+
+
+def test_tk8s112_three_drift_directions(tmp_path):
+    # A kind with no arm (dispatch KeyError), an arm no kind names
+    # (dead coverage), and a generator draw outside the closed set
+    # (specs that fail validation) — each is its own finding.
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/chaos/corpus.py": WORKLOAD_CORPUS_MODULE,
+        "triton_kubernetes_tpu/chaos/workload.py": """\
+            _ARMS = {
+                "replica-death": None,
+                "engine-preempt": None,
+                "rogue-arm": None,
+            }
+        """,
+        "triton_kubernetes_tpu/chaos/generator.py": """\
+            PROFILES = {
+                "workload": {
+                    "workload_kinds": (("replica-death", 3),
+                                       ("ghost-kind", 1)),
+                },
+            }
+        """,
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S112")
+    # torn-checkpoint has no arm; rogue-arm (dict key line 4) is not a
+    # kind; ghost-kind (line 4 of generator) is never a valid draw.
+    assert ("triton_kubernetes_tpu/chaos/workload.py", 1) in got
+    assert ("triton_kubernetes_tpu/chaos/workload.py", 4) in got
+    assert ("triton_kubernetes_tpu/chaos/generator.py", 4) in got
+    assert len(got) == 3
+
+
+def test_tk8s112_defaults_and_schema_drift(tmp_path):
+    root = make_tree(tmp_path, {
+        # 'workload' missing from _SPEC_KEYS, a kind with no defaults
+        # entry, and a defaults key outside the kind set.
+        "triton_kubernetes_tpu/chaos/corpus.py": """\
+            _SPEC_KEYS = ("version", "seed", "faults")
+
+            WORKLOAD_FAULT_KINDS = ("replica-death", "engine-preempt")
+
+            WORKLOAD_DEFAULTS = {
+                "replica-death": {"die_after_tokens": 3},
+                "stale-kind": {"x": 1},
+            }
+        """,
+        "triton_kubernetes_tpu/chaos/workload.py": """\
+            _ARMS = {
+                "replica-death": None,
+                "engine-preempt": None,
+            }
+        """,
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S112")
+    corpus_hits = [h for h in got
+                   if h[0].endswith("chaos/corpus.py")]
+    # engine-preempt missing from defaults, stale-kind unknown,
+    # _SPEC_KEYS missing 'workload'.
+    assert len(corpus_hits) == 3
+    assert ("triton_kubernetes_tpu/chaos/corpus.py", 7) in got
+
+
+def test_tk8s112_absent_corpus_is_clean(tmp_path):
+    # Other rules' fixture trees have no chaos/corpus.py at all — the
+    # rule must stay silent, not demand the chaos subsystem exist.
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/x.py": "x = 1\n",
+    })
+    findings, _ = lint_project(root)
+    assert hits(findings, "TK8S112") == []
+
+
+def test_tk8s112_non_literal_kinds_is_itself_a_finding(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/chaos/corpus.py": """\
+            WORKLOAD_FAULT_KINDS = tuple(sorted(["a", "b"]))
+        """,
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S112")
+    assert got == [("triton_kubernetes_tpu/chaos/corpus.py", 1)]
 
 
 # ------------------------------------------------- suppression round trip

@@ -375,3 +375,67 @@ def test_router_passes_replica_timeout_through_without_eject(model):
                 replica="r0", reason="affine") == 0
     finally:
         srv.stop()
+
+
+def _events(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "event":
+                out.append(rec)
+    return out
+
+
+def test_router_abort_inflight_flushes_blocked_requests(tmp_path):
+    """The shutdown/SIGTERM seam (ISSUE 16 satellite): a request
+    blocked inside forward() when the router dies must be flushed as a
+    route.abort terminal on the router's trace writer — otherwise the
+    merged timeline holds a placement span with no terminal child and
+    validate_chaos_trace rejects it."""
+    from triton_kubernetes_tpu.utils.trace import (TraceWriter,
+                                                   validate_chaos_trace)
+
+    path = str(tmp_path / "router.jsonl")
+    writer = TraceWriter(path, role="router")
+    router = Router(["http://127.0.0.1:1"], trace=writer)
+    flushed = []
+
+    def post_then_die(url, body, trace_id=None):
+        # The request is mid-forward (registered in-flight) when the
+        # shutdown lands — exactly the SIGTERM race the flush covers.
+        flushed.append(router.abort_inflight("router shutting down"))
+        return 200, {"type": "generate", "tokens": [1]}
+
+    router._post = post_then_die
+    status, out = router.forward({"tokens": [1, 2], "max_new_tokens": 1},
+                                 trace_id="cafe1234cafe1234")
+    assert status == 200 and flushed == [1]
+    writer.close()
+    aborts = [e for e in _events(path) if e["name"] == "route.abort"]
+    assert [a["trace"] for a in aborts] == ["cafe1234cafe1234"]
+    assert validate_chaos_trace([path]) == []
+
+
+def test_router_total_failure_terminates_the_placement(tmp_path):
+    """Every replica unreachable: the router records the failed
+    attempts AND a route.abort terminal, so even a 503'd request ends
+    span-complete in the merged timeline."""
+    from triton_kubernetes_tpu.utils.trace import (TraceWriter,
+                                                   validate_chaos_trace)
+
+    path = str(tmp_path / "router.jsonl")
+    writer = TraceWriter(path, role="router")
+    router = Router(["http://127.0.0.1:1", "http://127.0.0.1:2"],
+                    trace=writer)
+    router._post = lambda url, body, trace_id=None: (
+        -1, {"type": "error", "message": "unreachable"})
+    status, out = router.forward({"tokens": [3], "max_new_tokens": 1},
+                                 trace_id="beef5678beef5678")
+    assert status == 503
+    writer.close()
+    names = [e["name"] for e in _events(path)]
+    assert names.count("route.place") == 2  # both attempts recorded
+    assert names[-1] == "route.abort"
+    assert validate_chaos_trace([path]) == []
+    assert all(not r.healthy for r in router.replicas.values())

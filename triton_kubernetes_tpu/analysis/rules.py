@@ -839,3 +839,171 @@ class ChaosCorpusSchema(Rule):
                     rel, 1, 0,
                     f"corpus entry does not match the schema: {problem} "
                     f"(see triton_kubernetes_tpu/chaos/corpus.py)")
+
+
+# ---------------------------------------------------------------------------
+# TK8S112 — workload fault-kind drift
+# ---------------------------------------------------------------------------
+
+@register
+class WorkloadFaultDrift(Rule):
+    """The chaos workload fault vocabulary must agree everywhere it is
+    spelled: ``WORKLOAD_FAULT_KINDS`` (the closed kind set) and
+    ``WORKLOAD_DEFAULTS`` (its per-kind fields) in chaos/corpus.py, the
+    ``_ARMS`` dispatch dict in chaos/workload.py, the ``workload_kinds``
+    draws of generator profiles, and the ``workload`` key of the spec
+    schema.
+
+    History: the "silently inert rule" bug class (ISSUE 16) applied to
+    workload faults. A kind with defaults but no arm dispatches to a
+    KeyError only when first drawn; a kind an arm implements but the
+    generator never draws is dead chaos coverage; a renamed kind strands
+    committed corpus entries. All of these sit silent until a sweep
+    happens to hit them — the lint gate names the drift in seconds.
+    Each collection must stay a module-level literal: this rule reads
+    them from the AST, so a computed value is itself a finding.
+    """
+
+    code = "TK8S112"
+    name = "workload-fault-drift"
+    summary = ("chaos workload fault kinds must agree across corpus.py, "
+               "workload.py arms, and generator profile draws")
+
+    CORPUS_FILE = f"{PKG}/chaos/corpus.py"
+    ARMS_FILE = f"{PKG}/chaos/workload.py"
+    GENERATOR_FILE = f"{PKG}/chaos/generator.py"
+
+    @staticmethod
+    def _assigned(tree: ast.AST, name: str) -> Optional[ast.AST]:
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in n.targets):
+                return n.value
+            if (isinstance(n, ast.AnnAssign)
+                    and isinstance(n.target, ast.Name)
+                    and n.target.id == name and n.value is not None):
+                return n.value
+        return None
+
+    @staticmethod
+    def _str_elts(node: Optional[ast.AST]) -> Optional[List[str]]:
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return None
+        out = [e.value for e in node.elts
+               if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+        return out if len(out) == len(node.elts) else None
+
+    @staticmethod
+    def _dict_keys(node: Optional[ast.AST]) -> Optional[Dict[str, int]]:
+        if not isinstance(node, ast.Dict):
+            return None
+        out = {k.value: k.lineno for k in node.keys
+               if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+        return out if len(out) == len(node.keys) else None
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        corpus = project.file(self.CORPUS_FILE)
+        if corpus is None:
+            return
+        kinds_node = self._assigned(corpus.tree, "WORKLOAD_FAULT_KINDS")
+        kinds = self._str_elts(kinds_node)
+        if kinds is None or not kinds:
+            yield self.finding(
+                self.CORPUS_FILE, getattr(kinds_node, "lineno", 1), 0,
+                "WORKLOAD_FAULT_KINDS must be a non-empty module-level "
+                "tuple of string literals (this rule reads the AST)")
+            return
+        kind_set = set(kinds)
+        defaults = self._dict_keys(
+            self._assigned(corpus.tree, "WORKLOAD_DEFAULTS"))
+        if defaults is None:
+            yield self.finding(
+                self.CORPUS_FILE, 1, 0,
+                "WORKLOAD_DEFAULTS must be a module-level dict literal "
+                "with string-literal keys")
+        else:
+            for kind in sorted(kind_set - set(defaults)):
+                yield self.finding(
+                    self.CORPUS_FILE, getattr(kinds_node, "lineno", 1), 0,
+                    f"workload fault kind {kind!r} has no entry in "
+                    f"WORKLOAD_DEFAULTS — its fields cannot round-trip "
+                    f"through the spec schema")
+            for kind, lineno in sorted(defaults.items()):
+                if kind not in kind_set:
+                    yield self.finding(
+                        self.CORPUS_FILE, lineno, 0,
+                        f"WORKLOAD_DEFAULTS names {kind!r} which is not "
+                        f"in WORKLOAD_FAULT_KINDS — a stale or typo'd "
+                        f"kind no scenario can ever draw")
+        spec_keys = self._str_elts(self._assigned(corpus.tree,
+                                                  "_SPEC_KEYS"))
+        if spec_keys is not None and "workload" not in spec_keys:
+            yield self.finding(
+                self.CORPUS_FILE, 1, 0,
+                "_SPEC_KEYS does not list 'workload' — generated "
+                "workload faults would fail corpus validation")
+        arms_ctx = project.file(self.ARMS_FILE)
+        if arms_ctx is None:
+            yield self.finding(
+                self.CORPUS_FILE, getattr(kinds_node, "lineno", 1), 0,
+                f"WORKLOAD_FAULT_KINDS is declared but {self.ARMS_FILE} "
+                f"(the _ARMS dispatch) does not exist")
+        else:
+            arms = self._dict_keys(self._assigned(arms_ctx.tree, "_ARMS"))
+            if arms is None:
+                yield self.finding(
+                    self.ARMS_FILE, 1, 0,
+                    "_ARMS must be a module-level dict literal with "
+                    "string-literal keys (the TK8S112 lint anchor)")
+            else:
+                for kind in sorted(kind_set - set(arms)):
+                    yield self.finding(
+                        self.ARMS_FILE, 1, 0,
+                        f"workload fault kind {kind!r} has no arm in "
+                        f"_ARMS — drawing it would KeyError at dispatch")
+                for kind, lineno in sorted(arms.items()):
+                    if kind not in kind_set:
+                        yield self.finding(
+                            self.ARMS_FILE, lineno, 0,
+                            f"_ARMS implements {kind!r} which is not in "
+                            f"WORKLOAD_FAULT_KINDS — dead chaos coverage "
+                            f"no generator or corpus entry can reach")
+        gen_ctx = project.file(self.GENERATOR_FILE)
+        if gen_ctx is None:
+            return
+        profiles = self._assigned(gen_ctx.tree, "PROFILES")
+        if not isinstance(profiles, ast.Dict):
+            return
+        for pval in profiles.values:
+            if not isinstance(pval, ast.Dict):
+                continue
+            for k, v in zip(pval.keys, pval.values):
+                if not (isinstance(k, ast.Constant)
+                        and k.value == "workload_kinds"):
+                    continue
+                if not isinstance(v, (ast.Tuple, ast.List)):
+                    yield self.finding(
+                        self.GENERATOR_FILE, k.lineno, 0,
+                        "workload_kinds must be a literal sequence of "
+                        "(kind, weight) pairs")
+                    continue
+                for pair in v.elts:
+                    name: Optional[ast.expr] = None
+                    if isinstance(pair, (ast.Tuple, ast.List)) \
+                            and pair.elts:
+                        name = pair.elts[0]
+                    if isinstance(name, ast.Constant) \
+                            and isinstance(name.value, str):
+                        if name.value not in kind_set:
+                            yield self.finding(
+                                self.GENERATOR_FILE, name.lineno, 0,
+                                f"profile draws workload kind "
+                                f"{name.value!r} which is not in "
+                                f"WORKLOAD_FAULT_KINDS — generated "
+                                f"specs would fail corpus validation")
+                    else:
+                        yield self.finding(
+                            self.GENERATOR_FILE, pair.lineno, 0,
+                            "workload_kinds entries must lead with a "
+                            "string-literal kind name")

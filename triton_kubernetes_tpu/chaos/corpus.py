@@ -35,7 +35,40 @@ _REQUIRED_KEYS = ("version", "kind", "name", "expect", "spec")
 _ALLOWED_KEYS = _REQUIRED_KEYS + ("invariant", "notes", "shrunk_from")
 _SPEC_KEYS = ("version", "seed", "profile", "parallelism", "op_latency",
               "topology", "faults", "kill_fraction", "mutation",
-              "operator_preempt")
+              "operator_preempt", "workload")
+
+#: The CLOSED set of workload fault kinds a scenario may draw — the
+#: serving/training fault dimension on top of the infra DAG faults.
+#: Every name here must have an arm in chaos/workload.py, defaults
+#: below, and a generator that can draw it; lint rule TK8S112 keeps
+#: the three agreeing (the "silently inert rule" bug class, applied
+#: to workload faults).
+WORKLOAD_FAULT_KINDS = (
+    "replica-death",      # kill a replica mid-decode; router re-lands
+    "engine-preempt",     # page pressure preempts mid-chunked-prefill
+    "torn-checkpoint",    # corrupt a step's files; resume falls back
+    "rank-death",         # one worker dies at a step offset
+    "coordinator-loss",   # rank 0 dies at a step offset
+    "sigterm-flush",      # SIGTERM the route process; flush must land
+)
+
+#: Per-kind fault-field defaults. A spec's workload dict may override
+#: any subset; shrinking walks fields back toward these, and
+#: ``workload_fault_fields`` (shrink.py) counts the distance — the
+#: "shrunk to <= 2 fault fields" minimality pin. Dict literal by
+#: design: TK8S112 reads the keys from the AST.
+WORKLOAD_DEFAULTS = {
+    "replica-death": {"replicas": 2, "die_after_tokens": 1,
+                      "prompt_len": 4, "max_new_tokens": 6},
+    "engine-preempt": {"prefix_cache": False, "spec_k": 0,
+                       "long_windows": 4, "requests": 2,
+                       "abort_after_steps": None},
+    "torn-checkpoint": {"corruption": "truncate", "torn_step": 1,
+                        "keep_steps": 2},
+    "rank-death": {"crash_step": 1, "steps": 4},
+    "coordinator-loss": {"crash_step": 1, "steps": 4},
+    "sigterm-flush": {"process": "route", "after_requests": 1},
+}
 
 
 class CorpusError(ValueError):
@@ -86,7 +119,28 @@ def validate_entry(entry: Any) -> List[str]:
         problems.append("a 'violated' entry's spec must carry the mutation "
                         "that breaks it (otherwise the failure was real — "
                         "fix it and flip the entry to expect: pass)")
+    problems.extend(validate_workload(spec.get("workload")))
     return problems
+
+
+def validate_workload(workload: Any) -> List[str]:
+    """Schema problems of a spec's workload fault (empty list = valid;
+    ``None`` means the scenario drew no workload fault). The fields
+    must round-trip: kind from the closed set, field names from that
+    kind's defaults — an unknown field would silently never inject."""
+    if workload is None:
+        return []
+    if not isinstance(workload, dict):
+        return ["spec.workload must be an object or null"]
+    kind = workload.get("kind")
+    if kind not in WORKLOAD_FAULT_KINDS:
+        return [f"spec.workload.kind must be one of "
+                f"{list(WORKLOAD_FAULT_KINDS)}, got {kind!r}"]
+    unknown = set(workload) - {"kind"} - set(WORKLOAD_DEFAULTS[kind])
+    if unknown:
+        return [f"spec.workload has unknown fields {sorted(unknown)} "
+                f"for kind {kind!r}"]
+    return []
 
 
 def entry_for_failure(spec: Dict[str, Any], result) -> Dict[str, Any]:

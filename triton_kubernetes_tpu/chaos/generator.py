@@ -15,7 +15,9 @@ Spec shape::
      "topology": {...},                 # executor/dagspec.py shape
      "faults": [...],                   # cloudsim FaultPlan rules
      "kill_fraction": None | 0.4,       # arms the kill-resume invariant
-     "mutation": None | "unfaulted-reference"}   # harness self-test
+     "mutation": None | "unfaulted-reference",   # harness self-test
+     "workload": None | {"kind": "engine-preempt", ...}}  # ISSUE 16:
+                                        # serving/training fault arm
 
 Generation discipline worth naming: every generated fault rule is
 **module-anchored** (``module`` / ``at_module_op``) — the
@@ -68,6 +70,28 @@ PROFILES: Dict[str, Dict[str, Any]] = {
              "fault_rules": (1, 2), "latency_weight": 1.0,
              "latency_scale": 60.0, "kill_weight": 0.2,
              "operator_weight": 0.3},
+    # Serving-plane workload faults on a deliberately small infra DAG:
+    # the faults under test live in the engine/router/process arms, so
+    # the topology stays cheap. workload_weight 1.0 — every scenario
+    # draws one.
+    "workload": {"clusters": (0, 1), "nodes": (0, 1), "tpu_weight": 0.0,
+                 "hosted_weight": 0.2, "parallelism": (1, 2),
+                 "fault_rules": (0, 1), "latency_weight": 0.1,
+                 "kill_weight": 0.1, "operator_weight": 0.0,
+                 "workload_weight": 1.0,
+                 "workload_kinds": (("engine-preempt", 0.45),
+                                    ("torn-checkpoint", 0.2),
+                                    ("sigterm-flush", 0.2),
+                                    ("replica-death", 0.15))},
+    # Training-plane workload faults (multi-host subprocess launches —
+    # seconds per arm, so sweeps keep the run counts small).
+    "workload-train": {"clusters": (0, 1), "nodes": (0, 1),
+                       "tpu_weight": 0.0, "hosted_weight": 0.2,
+                       "parallelism": (1, 2), "fault_rules": (0, 1),
+                       "latency_weight": 0.1, "kill_weight": 0.1,
+                       "operator_weight": 0.0, "workload_weight": 1.0,
+                       "workload_kinds": (("rank-death", 0.6),
+                                          ("coordinator-loss", 0.4))},
 }
 
 # Ops each module family is known to issue — rules target these so a
@@ -226,6 +250,53 @@ def _draw_operator(rng: random.Random, prof: Dict[str, Any],
     return {"slice_id": row["slice_id"], "at_tick": rng.randint(1, 2)}
 
 
+def _draw_workload(rng: random.Random, prof: Dict[str, Any]
+                   ) -> Optional[Dict[str, Any]]:
+    """The workload fault dimension (ISSUE 16): serving/training faults
+    on top of the infra DAG. Drawn LAST, and — stricter than the
+    operator draw — consumes ZERO rng draws for profiles without a
+    ``workload_weight``, so every pre-existing profile's stream (and
+    thus every committed corpus entry) is byte-identical."""
+    w = prof.get("workload_weight", 0.0)
+    if w <= 0.0:
+        return None
+    if rng.random() >= w:
+        return None
+    kinds = prof["workload_kinds"]
+    roll = rng.random() * sum(weight for _, weight in kinds)
+    kind = kinds[-1][0]
+    for name, weight in kinds:
+        if roll < weight:
+            kind = name
+            break
+        roll -= weight
+    fault: Dict[str, Any] = {"kind": kind}
+    if kind == "replica-death":
+        fault["replicas"] = rng.randint(2, 3)
+        fault["die_after_tokens"] = rng.randint(1, 4)
+        fault["prompt_len"] = rng.randint(4, 8)
+        fault["max_new_tokens"] = rng.randint(6, 10)
+    elif kind == "engine-preempt":
+        fault["prefix_cache"] = rng.random() < 0.5
+        fault["spec_k"] = rng.choice((0, 3))
+        fault["long_windows"] = rng.randint(4, 5)
+        fault["requests"] = rng.randint(2, 3)
+        fault["abort_after_steps"] = (rng.randint(2, 6)
+                                      if rng.random() < 0.3 else None)
+    elif kind == "torn-checkpoint":
+        fault["corruption"] = rng.choice(
+            ("truncate", "bitflip", "torn-manifest"))
+        fault["torn_step"] = rng.randint(1, 2)
+        fault["keep_steps"] = rng.randint(2, 3)
+    elif kind in ("rank-death", "coordinator-loss"):
+        fault["crash_step"] = rng.randint(1, 3)
+        fault["steps"] = 4
+    elif kind == "sigterm-flush":
+        fault["process"] = "route"
+        fault["after_requests"] = rng.randint(1, 3)
+    return fault
+
+
 def scenario_seed(base: int, i: int) -> int:
     """Per-scenario seed of sweep step ``i``. One shared formula: the
     sweep runner and the CI evidence coverage report must derive the
@@ -255,4 +326,5 @@ def generate_spec(seed: int, profile: str = "default") -> Dict[str, Any]:
         "mutation": None,
     }
     spec["operator_preempt"] = _draw_operator(rng, prof, topo)
+    spec["workload"] = _draw_workload(rng, prof)
     return spec

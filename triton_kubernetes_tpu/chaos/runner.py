@@ -30,6 +30,15 @@ Invariants (each reported independently; ids are the corpus vocabulary):
 * ``destroy-clean`` — a targeted destroy of every module leaves zero
   simulator resources/managers/clusters/manifests, and a whole-graph
   destroy deletes the executor state outright.
+
+Specs carrying a ``workload`` fault additionally run one workload arm
+(chaos/workload.py): serving/training faults — replica death, engine
+preemption mid-chunked-prefill, torn checkpoints, rank/coordinator
+death, SIGTERM against the route process — each checked by bitwise
+parity, page-pool convergence, and the generic
+:func:`~..utils.trace.validate_chaos_trace` oracle (``engine-parity``,
+``reland-parity``, ``pool-convergence``, ``trace-valid``,
+``ckpt-fallback``, ``train-resume``, ``flush-clean``).
 """
 
 from __future__ import annotations
@@ -55,14 +64,24 @@ from ..utils.logging import Logger
 from ..utils.trace import TraceCollector
 
 INVARIANTS = ("parity", "kill-resume", "trace-journal", "metrics-journal",
-              "repair", "destroy-clean", "operator-converge")
+              "repair", "destroy-clean", "operator-converge",
+              # Workload fault arms (ISSUE 16, chaos/workload.py):
+              "engine-parity", "reland-parity", "pool-convergence",
+              "trace-valid", "ckpt-fallback", "train-resume",
+              "flush-clean")
 
 #: Deliberate invariant breakages (mutation testing of the harness
 #: itself): each key names a way run_scenario corrupts its own checking
 #: so the catch -> shrink -> corpus pipeline can be exercised end to end.
 #: ``unfaulted-reference`` builds the ref arm WITHOUT the fault plan —
 #: the pre-PR1 world where fault handling changed final state invisibly.
-MUTATIONS = ("unfaulted-reference",)
+#: The workload mutations (chaos/workload.py) break one workload
+#: invariant each: ``dropped-reland`` truncates the re-landed response
+#: before the parity compare, ``leaked-pages`` skips the page-pool
+#: release before the convergence check, ``swallowed-abort`` drops the
+#: abort flush so lifecycles end terminal-less.
+MUTATIONS = ("unfaulted-reference", "dropped-reland", "leaked-pages",
+             "swallowed-abort")
 
 _MAX_APPLY_ATTEMPTS = 6
 
@@ -370,6 +389,14 @@ def _run_arms(spec: Dict[str, Any], res: ScenarioResult,
     _destroy_to_success(ref_ex, ref_doc)
     _check(res, "destroy-clean", _MEMORY_STATES.get(names["ref"]) is None,
            "whole-graph destroy did not delete the executor state")
+
+    # --- workload fault arm (ISSUE 16): serving/training faults with
+    # the trace timeline as the generic oracle. Lazy import: the infra
+    # arms stay importable on jax-free boxes.
+    if spec.get("workload"):
+        from .workload import run_workload_arm
+
+        run_workload_arm(spec, res, _check, recorder)
 
 
 def _check_operator(spec: Dict[str, Any], res: ScenarioResult,

@@ -10,6 +10,8 @@ using deterministic, structure-aware moves:
 * drop one fault rule;
 * lower the parallelism (8 -> 2 -> 1);
 * drop the latency model, drop the kill;
+* reduce the workload fault — drop it whole, walk each field back to
+  its kind default, halve ints toward the default;
 * rebisect anchors — halve ``at_op`` / ``at_module_op`` / the kill
   fraction toward the origin, so the repro fires as early as possible.
 
@@ -27,6 +29,7 @@ import json
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..utils import metrics
+from .corpus import WORKLOAD_DEFAULTS
 
 _MAX_ACCEPTED = 200  # hard stop; generated specs are far smaller
 
@@ -81,6 +84,28 @@ def _candidates(spec: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
         s = copy.deepcopy(spec)
         s["operator_preempt"] = None
         yield s
+    # 5b. reduce the workload fault: drop it whole, then walk each
+    # field back to its kind default (coarse to fine — a field at its
+    # default is not part of the repro), then halve ints toward the
+    # default so e.g. die_after_tokens lands as early as possible.
+    workload = spec.get("workload")
+    if workload is not None:
+        s = copy.deepcopy(spec)
+        s["workload"] = None
+        yield s
+        defaults = WORKLOAD_DEFAULTS.get(workload.get("kind"), {})
+        for name in sorted(defaults):
+            v, dv = workload.get(name), defaults[name]
+            if name not in workload or v == dv:
+                continue
+            s = copy.deepcopy(spec)
+            s["workload"][name] = dv
+            yield s
+            if isinstance(v, int) and not isinstance(v, bool) \
+                    and isinstance(dv, int) and v > dv + 1:
+                s = copy.deepcopy(spec)
+                s["workload"][name] = dv + (v - dv) // 2
+                yield s
     # 6. rebisect anchors toward the origin
     for i, rule in enumerate(spec.get("faults", [])):
         for anchor in ("at_op", "at_module_op"):
@@ -104,6 +129,18 @@ def spec_size(spec: Dict[str, Any]) -> Tuple[int, int]:
         n += 1 + len(cl.get("nodes", [])) + len(cl.get("pools", [])) \
             + len(cl.get("jobsets", []))
     return n, len(spec.get("faults", []))
+
+
+def workload_fault_fields(spec: Dict[str, Any]) -> int:
+    """How many workload fault fields differ from their kind defaults —
+    the ISSUE 16 minimality bar ("shrunk to <= 2 fault fields"). A spec
+    without a workload fault counts 0."""
+    workload = spec.get("workload")
+    if not workload:
+        return 0
+    defaults = WORKLOAD_DEFAULTS.get(workload.get("kind"), {})
+    return sum(1 for name, dv in defaults.items()
+               if name in workload and workload[name] != dv)
 
 
 def shrink_spec(spec: Dict[str, Any], result=None,

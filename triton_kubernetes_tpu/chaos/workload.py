@@ -1,0 +1,694 @@
+"""Workload fault arms: chaos for the serving data plane and training.
+
+ISSUE 16: on top of the infra DAG faults, a scenario may carry one
+``workload`` fault drawn from the closed kind set in
+:mod:`~triton_kubernetes_tpu.chaos.corpus`. Each kind has one *arm*
+here that injects the fault against the real subsystem — live engines
+behind real HTTP servers, real checkpoint directories, the actual
+multi-process launcher, a real ``tk8s route`` subprocess — and checks
+the workload invariants:
+
+* ``engine-parity`` / ``reland-parity`` — outputs are bitwise identical
+  to an unfaulted solo reference, whatever the fault did to scheduling;
+* ``pool-convergence`` — after drain + prefix release, zero KV pages
+  remain allocated (the leak oracle);
+* ``trace-valid`` — every arm attaches trace writers, and
+  :func:`~triton_kubernetes_tpu.utils.trace.validate_chaos_trace` then
+  checks generically that every request the chaos touched ends
+  span-complete with exact phase sums (aborted lifecycles flushed);
+* ``ckpt-fallback`` — a torn checkpoint is detected and restore falls
+  back to the newest intact step;
+* ``train-resume`` — after a rank death / coordinator loss, the
+  resumed run converges to the uninterrupted reference's final loss;
+* ``flush-clean`` — a SIGTERMed router exits 143 with every placement
+  flushed to its trace file.
+
+Engines run on a :class:`~triton_kubernetes_tpu.serve.engine.ManualClock`
+(``ENGINE_CLOCK_TICK`` per read): scenario time is simulated, so the
+soak arm runs hours of clock in wall-seconds by raising the tick.
+
+Module-level imports stay jax-free (the infra chaos arms must work on
+jax-free boxes; every arm lazily imports what it needs). The ``_ARMS``
+dict literal is the TK8S112 lint anchor: its keys must equal
+``WORKLOAD_FAULT_KINDS``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import metrics
+from ..utils.trace import (TRACE_HEADER, FlightRecorder, TraceWriter,
+                           validate_chaos_trace)
+from .corpus import WORKLOAD_DEFAULTS
+
+#: Simulated seconds every engine ``clock()`` read advances. The soak
+#: test raises this (module attribute, read per arm) to push hours of
+#: simulated clock through the same scenarios in wall-seconds.
+ENGINE_CLOCK_TICK = 0.002
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+class WorkloadArmSkipped(RuntimeError):
+    """This environment cannot run the arm (e.g. no multi-process CPU
+    collectives). Typed so sweeps skip LOUDLY, never vacuously pass."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+# --------------------------------------------------------------- caches
+# jit closures are per engine instance, so arms reuse engines across
+# scenarios (the sweep would otherwise recompile per scenario). Guarded
+# for the odd concurrent caller; chaos sweeps themselves are serial.
+_CACHE_LOCK = threading.Lock()
+_MODEL: List[Any] = []                       # [(config, params)]
+_ENGINES: Dict[Any, Tuple[Any, Any]] = {}    # key -> (engine, clock)
+_REFERENCE: Dict[Any, List[int]] = {}        # solo-run output tokens
+_TRAIN_REFERENCE: Dict[int, Optional[float]] = {}  # steps -> final loss
+
+#: Engine shapes. The preempt pool is deliberately tight (12 pages,
+#: 3 slots) so a long chunked prefill plus a growing decode forces
+#: preemption; replicas get the router-test shape.
+_PREEMPT_KW = dict(block_size=4, num_blocks=12, max_batch=3,
+                   max_model_len=48, prefill_chunk=8)
+_REPLICA_KW = dict(block_size=4, num_blocks=32, max_batch=4,
+                   max_model_len=64, prefill_chunk=8, prefix_cache=True)
+
+
+def _model():
+    from ..models import get_config, init_params
+    import jax
+
+    with _CACHE_LOCK:
+        if not _MODEL:
+            cfg = get_config("llama-test")
+            _MODEL.append((cfg, init_params(cfg, jax.random.PRNGKey(0))))
+        return _MODEL[0]
+
+
+def _engine(key: Tuple[Any, ...]):
+    """Cached (engine, ManualClock) for a shape key:
+    ``("preempt", prefix_cache, spec_k)``, ``("replica", i)`` or
+    ``("solo",)`` (the re-land reference twin of the replica shape)."""
+    from ..serve.engine import ManualClock, ServeEngine
+
+    with _CACHE_LOCK:
+        got = _ENGINES.get(key)
+    if got is not None:
+        return got
+    cfg, params = _model()
+    if key[0] == "preempt":
+        kw = dict(_PREEMPT_KW, prefix_cache=bool(key[1]),
+                  spec_k=int(key[2]))
+    else:
+        kw = dict(_REPLICA_KW)
+    clock = ManualClock(tick=ENGINE_CLOCK_TICK)
+    engine = ServeEngine(params, cfg, clock=clock, **kw)
+    with _CACHE_LOCK:
+        _ENGINES.setdefault(key, (engine, clock))
+        return _ENGINES[key]
+
+
+def _reference_tokens(engine_key: Tuple[Any, ...], tokens: List[int],
+                      max_new: int, seed: int) -> List[int]:
+    """Solo unfaulted run on the same engine shape — the bitwise-parity
+    oracle every faulted output is compared against. Cached: one solo
+    run per distinct request across a whole sweep."""
+    from ..serve.engine import Request
+
+    key = (engine_key, tuple(tokens), max_new, seed)
+    with _CACHE_LOCK:
+        if key in _REFERENCE:
+            return _REFERENCE[key]
+    engine, _ = _engine(engine_key)
+    assert engine.flight is None and not engine.has_work
+    engine.submit(Request(f"wl-ref-{seed}-{len(tokens)}", list(tokens),
+                          max_new, seed=seed))
+    out = engine.run_until_idle()[0].tokens
+    with _CACHE_LOCK:
+        _REFERENCE[key] = out
+    return out
+
+
+def _drain(engine) -> int:
+    """Quiesce a cached engine after a fault: finish leftovers silently
+    (no recorder attached), drop cache-held pages, return the pages
+    still allocated — 0 unless something leaked."""
+    engine.flight = None
+    if engine.has_work:
+        engine.run_until_idle()
+    engine.release_prefix_cache()
+    return engine.allocator.in_use
+
+
+def _post(url: str, payload: Dict[str, Any], timeout: float = 60.0,
+          ) -> Dict[str, Any]:
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _read_jsonl(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# ------------------------------------------------------- engine-preempt
+def _arm_engine_preempt(cfg, spec, res, check, recorder) -> None:
+    """Page pressure preempts a request mid-chunked-prefill (or
+    mid-decode): a short prompt with a long decode grows into the pool
+    a long prefill holds, the engine evicts the latest admission, and
+    the victim recomputes. Outputs must not change; pages must
+    converge; the trace must attribute every wait to ``queue`` (the
+    flight-recorder gap bug this arm was designed to surface)."""
+    from ..serve.engine import Request
+
+    mutation = spec.get("mutation")
+    ekey = ("preempt", bool(cfg["prefix_cache"]), int(cfg["spec_k"]))
+    engine, clock = _engine(ekey)
+    clock.tick = ENGINE_CLOCK_TICK
+    long_prompt = [(7 * i + 3) % 29 for i
+                   in range(int(cfg["long_windows"]) * 8)]
+    reqs = [("wl-grow", [3, 1, 4, 7], 12, 11),
+            ("wl-long", long_prompt, 4, 12)]
+    if int(cfg["requests"]) >= 3:
+        reqs.append(("wl-peer", list(long_prompt), 4, 13))
+    want = {rid: _reference_tokens(ekey, toks, mx, seed)
+            for rid, toks, mx, seed in reqs}
+    t0 = clock.now
+    tmp = tempfile.mkdtemp(prefix="tk8s-chaos-wl-")
+    path = os.path.join(tmp, "engine.jsonl")
+    writer = TraceWriter(path, role="replica", clock=clock)
+    engine.flight = FlightRecorder(writer=writer)
+    finished: Dict[str, Any] = {}
+    aborted: set = set()
+    try:
+        try:
+            for rid, toks, mx, seed in reqs:
+                engine.submit(Request(rid, list(toks), mx, seed=seed))
+            abort_after = cfg.get("abort_after_steps")
+            if abort_after:
+                for _ in range(int(abort_after)):
+                    for done in engine.step():
+                        finished[done.request_id] = done
+                # The injected mid-flight abort: the engine loop dies
+                # and every live lifecycle must flush as aborted. The
+                # swallowed-abort mutation skips the flush — the trace
+                # oracle must then report submitted-without-terminal.
+                if mutation != "swallowed-abort":
+                    engine.abort_inflight("chaos: injected abort")
+                aborted = ({rid for rid, _, _, _ in reqs}
+                           - set(finished))
+            else:
+                for done in engine.run_until_idle():
+                    finished[done.request_id] = done
+        finally:
+            writer.close()
+            leaked = _drain(engine) if mutation != "leaked-pages" \
+                else engine.allocator.in_use
+        res.stats["workload_preemptions"] = sum(
+            d.preemptions for d in finished.values())
+        bad = sorted(rid for rid, done in finished.items()
+                     if done.tokens != want[rid])
+        check(res, "engine-parity", not bad,
+              f"outputs diverged from the solo reference under "
+              f"preemption chaos: {bad}")
+        if mutation == "leaked-pages":
+            # Deliberately measure BEFORE the drain dropped cache pages
+            # (then clean up so the cached engine stays reusable).
+            check(res, "pool-convergence", leaked == 0,
+                  f"{leaked} KV pages still allocated after the "
+                  f"faulted run drained")
+            _drain(engine)
+        else:
+            check(res, "pool-convergence", leaked == 0,
+                  f"{leaked} KV pages still allocated after drain + "
+                  f"prefix release")
+        problems = validate_chaos_trace([path])
+        check(res, "trace-valid", not problems,
+              "; ".join(problems[:4]))
+        recorder(max(0.0, clock.now - t0))
+    finally:
+        engine.flight = None
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# -------------------------------------------------------- replica-death
+def _arm_replica_death(cfg, spec, res, check, recorder) -> None:
+    """Kill a replica mid-decode behind the live router: the session's
+    in-flight request must re-land on a living replica with bitwise
+    identical output, and BOTH trace files must be complete — the
+    victim flushes the partial lifecycle as aborted, the router's
+    placement spans all reach a terminal."""
+    from ..serve.router import RouterHTTPServer
+    from ..serve.server import ServeHTTPServer
+
+    mutation = spec.get("mutation")
+    n = int(cfg["replicas"])
+    die_after = int(cfg["die_after_tokens"])
+    prompt = [(5 * i + 7) % 29 for i in range(int(cfg["prompt_len"]))]
+    max_new = int(cfg["max_new_tokens"])
+    want = _reference_tokens(("solo",), prompt, max_new, 21)
+    tmp = tempfile.mkdtemp(prefix="tk8s-chaos-wl-")
+    router_path = os.path.join(tmp, "router.jsonl")
+    paths = [router_path]
+    engines: List[Tuple[Any, Any, float]] = []
+    servers: List[Any] = []
+    router = None
+    route_writer = TraceWriter(router_path, role="router")
+    try:
+        for i in range(n):
+            engine, clock = _engine(("replica", i))
+            clock.tick = ENGINE_CLOCK_TICK
+            p = os.path.join(tmp, f"replica-{i}.jsonl")
+            engine.flight = FlightRecorder(
+                writer=TraceWriter(p, role="replica", clock=clock))
+            paths.append(p)
+            engines.append((engine, clock, clock.now))
+            servers.append(ServeHTTPServer(engine).start())
+        router = RouterHTTPServer([s.url for s in servers],
+                                  health_interval_s=10.0,
+                                  trace=route_writer).start()
+        probe = {"tokens": [7, 3, 9, 1], "max_new_tokens": 2,
+                 "session_id": "chaos-victim"}
+        first = _post(router.url, probe)
+        victim_name = first["replica"]
+        victim_url = router.router.replicas[victim_name].url
+        victim = next(e for (e, _, _), s in zip(engines, servers)
+                      if s.url == victim_url)
+        orig_step = victim.step
+        calls = {"n": 0}
+
+        def dying_step():
+            calls["n"] += 1
+            if calls["n"] > die_after:
+                raise RuntimeError("chaos: injected replica death")
+            return orig_step()
+
+        victim.step = dying_step
+        slow = {"tokens": list(prompt), "max_new_tokens": max_new,
+                "session_id": "chaos-victim"}
+        got: Dict[str, Any] = {}
+
+        def fire():
+            try:
+                got["out"] = _post(router.url, slow, timeout=90)
+            except Exception as e:  # surfaced via the invariant detail
+                got["error"] = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=fire)
+        t.start()
+        t.join(timeout=120)
+        victim.__dict__.pop("step", None)
+        out = got.get("out") or {}
+        tokens = out.get("tokens")
+        if mutation == "dropped-reland" and tokens is not None:
+            # The seeded harness self-test: pretend the router returned
+            # the victim's partial generation instead of re-landing.
+            tokens = tokens[:die_after]
+        ok = (not t.is_alive() and tokens == want
+              and out.get("replica") not in (None, victim_name))
+        check(res, "reland-parity", ok,
+              f"re-land after replica death diverged: got={tokens} "
+              f"want={want} replica={out.get('replica')} "
+              f"victim={victim_name} error={got.get('error')}")
+    finally:
+        if router is not None:
+            router.stop()
+        for s in servers:
+            s.stop()
+        route_writer.close()
+        leaked = 0
+        for engine, clock, t0 in engines:
+            engine.__dict__.pop("step", None)
+            flight, engine.flight = engine.flight, None
+            if flight is not None:
+                # The victim's server loop already flushed its dead
+                # lifecycles; this is a no-op there and a guard
+                # everywhere else (a hung request must not leave an
+                # unterminated span behind).
+                flight.flush_aborted(clock(), "chaos: arm teardown")
+                if flight.writer is not None:
+                    flight.writer.close()
+            leaked += _drain(engine)
+            recorder(max(0.0, clock.now - t0))
+    check(res, "pool-convergence", leaked == 0,
+          f"{leaked} KV pages still allocated across replicas after "
+          f"drain + prefix release")
+    problems = validate_chaos_trace(paths)
+    check(res, "trace-valid", not problems, "; ".join(problems[:4]))
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ------------------------------------------------------ torn-checkpoint
+def _arm_torn_checkpoint(cfg, spec, res, check, recorder) -> None:
+    """Corrupt one committed step (truncated file, flipped bit, torn
+    manifest) and resume: verification must reject exactly the torn
+    step and restore must fall back to the newest intact one."""
+    import numpy as np
+    from ..train.checkpoint import (CheckpointIntegrityError,
+                                    CheckpointManager, MANIFEST_NAME)
+
+    keep = int(cfg["keep_steps"])
+    torn = int(cfg["torn_step"])
+    mode = cfg["corruption"]
+    tmp = tempfile.mkdtemp(prefix="tk8s-chaos-wl-")
+    try:
+        mgr = CheckpointManager(os.path.join(tmp, "ckpt"),
+                                max_to_keep=keep + 1)
+
+        def state(s):
+            return {"step": np.asarray(s, np.int32),
+                    "w": np.asarray(s * 10.0, np.float32)}
+
+        for s in range(1, keep + 1):
+            mgr.save(s, state(s), wait=True)
+        step_dir = os.path.join(tmp, "ckpt", str(torn))
+        if mode == "torn-manifest":
+            manifest = os.path.join(step_dir, MANIFEST_NAME)
+            with open(manifest, "r+b") as f:
+                f.truncate(max(os.path.getsize(manifest) // 2, 1))
+        else:
+            files = [os.path.join(root, fn)
+                     for root, _, fns in os.walk(step_dir)
+                     for fn in fns if fn != MANIFEST_NAME]
+            target = max(files, key=os.path.getsize)
+            with open(target, "r+b") as f:
+                size = os.path.getsize(target)
+                if mode == "truncate":
+                    f.truncate(max(size // 2, 1))
+                else:  # bitflip
+                    f.seek(size // 2)
+                    byte = f.read(1)
+                    f.seek(size // 2)
+                    f.write(bytes([byte[0] ^ 0xFF]))
+        detected = False
+        try:
+            mgr.verify_step(torn)
+        except CheckpointIntegrityError:
+            detected = True
+        expect = max(s for s in range(1, keep + 1) if s != torn)
+        restored = mgr.restore(state(0))
+        landed = mgr.last_restored_step
+        intact = float(restored["w"]) == expect * 10.0
+        check(res, "ckpt-fallback",
+              detected and landed == expect and intact,
+              f"torn step {torn} ({mode}): detected={detected}, "
+              f"restore landed on {landed} (want {expect}), "
+              f"w={float(restored['w'])}")
+        mgr.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ------------------------------------------- rank-death/coordinator-loss
+def _train_args(steps: int, ckpt_dir: str) -> List[str]:
+    return ["--model", "llama-test", "--batch-size", "8",
+            "--seq-len", "32", "--steps", str(steps),
+            "--sync-every", "1", "--checkpoint-dir", ckpt_dir,
+            "--checkpoint-every", "1", "--resume"]
+
+
+def _train_reference(steps: int) -> Optional[float]:
+    """Final loss of one uninterrupted 2-process run — the convergence
+    target every crash+resume run must reproduce exactly (training is
+    deterministic: same seeds, same batch order)."""
+    from ..parallel import multihost
+
+    with _CACHE_LOCK:
+        if steps in _TRAIN_REFERENCE:
+            return _TRAIN_REFERENCE[steps]
+    tmp = tempfile.mkdtemp(prefix="tk8s-chaos-wl-")
+    try:
+        rep = multihost.launch_trainers(
+            _train_args(steps, os.path.join(tmp, "ckpt")),
+            run_dir=os.path.join(tmp, "run"), tag="chaos-ref",
+            timeout=240)
+        losses = (rep.report or {}).get("losses") or []
+        final = float(losses[-1]) if rep.ok and losses else None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    with _CACHE_LOCK:
+        _TRAIN_REFERENCE[steps] = final
+    return final
+
+
+def _train_crash_arm(cfg, spec, res, check, recorder,
+                     victim_rank: int) -> None:
+    """Kill one trainer process at a generated step offset (rank 0 =
+    the orbax/report coordinator), then relaunch with ``--resume``:
+    phase 1 must actually die with the injected exit code (fail-fast
+    reaps the peer), phase 2 must complete and land on the
+    uninterrupted reference's final loss."""
+    from ..parallel import multihost
+
+    try:
+        multihost.require_multihost()
+    except multihost.MultiHostUnavailable as e:
+        raise WorkloadArmSkipped(e.reason)
+    steps = int(cfg["steps"])
+    crash = int(cfg["crash_step"])
+    ref = _train_reference(steps)
+    tmp = tempfile.mkdtemp(prefix="tk8s-chaos-wl-")
+    try:
+        ckpt = os.path.join(tmp, "ckpt")
+        rep1 = multihost.launch_trainers(
+            _train_args(steps, ckpt),
+            run_dir=os.path.join(tmp, "phase1"), tag="chaos-crash",
+            timeout=240,
+            env_extra={"TK8S_TEST_CRASH_STEP": str(crash),
+                       "TK8S_TEST_CRASH_STEP_RANK": str(victim_rank)})
+        died = (not rep1.ok
+                and len(rep1.returncodes) > victim_rank
+                and rep1.returncodes[victim_rank] == 3)
+        rep2 = multihost.launch_trainers(
+            _train_args(steps, ckpt),
+            run_dir=os.path.join(tmp, "phase2"), tag="chaos-resume",
+            timeout=240)
+        losses = (rep2.report or {}).get("losses") or []
+        final = float(losses[-1]) if rep2.ok and losses else None
+        ok = (died and ref is not None and final is not None
+              and abs(final - ref) < 1e-6)
+        check(res, "train-resume", ok,
+              f"rank {victim_rank} death at step +{crash}: "
+              f"died={died} (rcs={rep1.returncodes}), resume "
+              f"ok={rep2.ok}, final={final} vs reference={ref}")
+        recorder(rep1.wall_seconds + rep2.wall_seconds)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _arm_rank_death(cfg, spec, res, check, recorder) -> None:
+    _train_crash_arm(cfg, spec, res, check, recorder, victim_rank=1)
+
+
+def _arm_coordinator_loss(cfg, spec, res, check, recorder) -> None:
+    _train_crash_arm(cfg, spec, res, check, recorder, victim_rank=0)
+
+
+# -------------------------------------------------------- sigterm-flush
+class _StubReplica:
+    """A jax-free stand-in replica for the SIGTERM arm: answers
+    /healthz and /generate like a serving pod and writes the full
+    request lifecycle (keyed to the router's ``X-TK8S-Trace`` header)
+    to its own trace file, so the cross-file completeness rule has a
+    real ``serve.finish`` to find for every placement."""
+
+    def __init__(self, path: str):
+        self.writer = TraceWriter(path, role="replica")
+        self.flight = FlightRecorder(writer=self.writer)
+        self._n = 0
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, status, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True})
+                else:
+                    self._reply(404, {"type": "error",
+                                      "message": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    payload = json.loads(
+                        self.rfile.read(length) or b"{}")
+                except ValueError:
+                    self._reply(400, {"type": "error",
+                                      "message": "bad json"})
+                    return
+                self._reply(200, outer.generate(
+                    payload, self.headers.get(TRACE_HEADER)))
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def generate(self, payload: Dict[str, Any],
+                 trace_id: Optional[str]) -> Dict[str, Any]:
+        with self._lock:
+            self._n += 1
+            rid = f"stub-{self._n}"
+        clock = time.monotonic
+        self.flight.begin(rid, trace_id, clock())
+        self.flight.event(rid, "serve.admitted", clock(),
+                          deferred=False)
+        self.flight.event(rid, "serve.first_token", clock())
+        self.flight.finish(rid, clock(), "length")
+        return {"request_id": rid, "prompt_len":
+                len(payload.get("tokens") or []),
+                "tokens": [1, 2, 3], "finish_reason": "length"}
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=10)
+        self.flight.flush_aborted(time.monotonic(), "stub shutdown")
+        self.writer.close()
+
+
+def _arm_sigterm_flush(cfg, spec, res, check, recorder) -> None:
+    """SIGTERM a real ``tk8s route`` subprocess after N proxied
+    requests: the handler must exit 143 through the finally chain with
+    every placement span flushed to the trace file (and the merged
+    router+replica timeline span-complete)."""
+    n = int(cfg["after_requests"])
+    tmp = tempfile.mkdtemp(prefix="tk8s-chaos-wl-")
+    stub_path = os.path.join(tmp, "stub.jsonl")
+    route_path = os.path.join(tmp, "route.jsonl")
+    stub = _StubReplica(stub_path)
+    proc = None
+    detail = ""
+    ok = False
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "triton_kubernetes_tpu.cli",
+             "route", "--replica", stub.url,
+             "--route-host", "127.0.0.1", "--port", "0",
+             "--trace-jsonl", route_path],
+            cwd=_REPO_ROOT, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        banner: Dict[str, str] = {}
+
+        def read_banner():
+            banner["line"] = proc.stdout.readline()
+
+        t = threading.Thread(target=read_banner, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        m = re.search(r"on (http://[\d.]+:\d+)", banner.get("line") or "")
+        if not m:
+            detail = f"router never started: {banner.get('line')!r}"
+        else:
+            url = m.group(1)
+            served = 0
+            for i in range(n):
+                out = _post(url, {"tokens": [1, 2, 3, 4],
+                                  "max_new_tokens": 3,
+                                  "session_id": "chaos-sigterm"},
+                            timeout=30)
+                served += 1 if out.get("finish_reason") else 0
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+            places = [ev for ev in _read_jsonl(route_path)
+                      if ev.get("name") == "route.place"
+                      and (ev.get("fields") or {}).get("status") == 200]
+            ok = rc == 143 and served == n and len(places) >= n
+            detail = (f"SIGTERM mid-serve: rc={rc} (want 143), "
+                      f"served={served}/{n}, {len(places)} flushed "
+                      f"route.place spans (want >= {n})")
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if proc is not None:
+            proc.stdout.close()
+            proc.stderr.close()
+        stub.close()
+    check(res, "flush-clean", ok, detail)
+    problems = validate_chaos_trace([route_path, stub_path])
+    check(res, "trace-valid", not problems, "; ".join(problems[:4]))
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+#: kind -> arm. Dict literal by design: lint rule TK8S112 reads the
+#: keys from the AST and pins them against WORKLOAD_FAULT_KINDS — an
+#: arm-less kind (or a kind-less arm) is the "silently inert fault"
+#: bug class.
+_ARMS = {
+    "replica-death": _arm_replica_death,
+    "engine-preempt": _arm_engine_preempt,
+    "torn-checkpoint": _arm_torn_checkpoint,
+    "rank-death": _arm_rank_death,
+    "coordinator-loss": _arm_coordinator_loss,
+    "sigterm-flush": _arm_sigterm_flush,
+}
+
+
+def run_workload_arm(spec: Dict[str, Any], res, check: Callable,
+                     recorder: Callable[[float], None]) -> None:
+    """Dispatch a scenario's workload fault to its arm. Field defaults
+    come from :data:`~.corpus.WORKLOAD_DEFAULTS` (the spec overrides a
+    subset — that distance is what shrinking minimizes). Every run is
+    counted by kind and outcome; a skip is an outcome, never silence."""
+    workload = spec["workload"]
+    kind = workload["kind"]
+    cfg = dict(WORKLOAD_DEFAULTS[kind])
+    cfg.update({k: v for k, v in workload.items() if k != "kind"})
+    res.stats["workload_kind"] = kind
+    before = len(res.violations)
+    status = "ok"
+    try:
+        _ARMS[kind](cfg, spec, res, check, recorder)
+        if len(res.violations) > before:
+            status = "violated"
+    except WorkloadArmSkipped as e:
+        status = "skipped"
+        res.stats["workload_skipped"] = e.reason
+    metrics.counter("tk8s_chaos_workload_arms_total").inc(
+        kind=kind, status=status)

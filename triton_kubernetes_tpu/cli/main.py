@@ -62,7 +62,8 @@ GIT_SHA = "dev"  # stamped by packaging (Makefile -ldflags analog, Makefile:2)
 # Pinned copy of chaos.generator.PROFILES' keys (equality test-enforced,
 # tests/test_chaos.py): argparse choices must not cost an eager import of
 # the chaos/runner stack on every CLI start.
-CHAOS_PROFILES = ("default", "quick", "soak", "tpu")
+CHAOS_PROFILES = ("default", "quick", "soak", "tpu", "workload",
+                  "workload-train")
 
 
 def choose_backend(resolver: InputResolver) -> Backend:

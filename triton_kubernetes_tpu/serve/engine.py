@@ -477,11 +477,15 @@ class ServeEngine:
             if self.flight is not None:
                 # recompute=True re-prefills the sequence's own history
                 # after a preemption — the recorder books the window as
-                # recompute_s, not prefill_s.
+                # recompute_s, not prefill_s. deferred=True (chunked
+                # mode) only grants the slot: compute starts at the
+                # first serve.prefill window, and the waits between
+                # windows stay queue time.
                 self.flight.event(
                     seq.request.request_id, "serve.admitted",
                     self.clock(), slot=slot, reused_pages=len(reuse),
-                    recompute=seq.preemptions > 0)
+                    recompute=seq.preemptions > 0,
+                    deferred=self.prefill_chunk is not None)
             if seq.prefilled:
                 # Tokens whose prefill compute the radix cache absorbed —
                 # the O(users) -> O(1) system-prompt win, measured.
@@ -544,6 +548,14 @@ class ServeEngine:
         metrics.counter("tk8s_serve_tokens_total").inc(
             clen, kind="prefill")
         if seq.prefilled < seq.target:
+            if self.flight is not None:
+                # Window over, more to run: whatever the sequence now
+                # waits (other sequences' windows, decode ticks) is
+                # queue time — the oracle's exclusive-prefill check
+                # pins this.
+                self.flight.event(seq.request.request_id,
+                                  "serve.prefill_yield", self.clock(),
+                                  offset=seq.prefilled)
             return
         if k_err is not None:
             # Gauge update only on the FINAL window: float() forces a

@@ -145,6 +145,12 @@ class Router:
         self.trace = trace
         self.clock = clock
         self._lock = threading.Lock()
+        # Requests currently inside forward(), keyed by a monotonic
+        # ticket so concurrent requests sharing a trace id stay
+        # distinct. abort_inflight() flushes these as route.abort
+        # terminals when the router dies with requests mid-flight.
+        self._inflight: Dict[int, str] = {}
+        self._inflight_seq = 0
         self.replicas: Dict[str, ReplicaState] = {}
         for i, url in enumerate(replica_urls):
             name = f"r{i}"
@@ -214,6 +220,18 @@ class Router:
         if trace_id is None:
             with self._lock:
                 trace_id = mint_trace_id(self._trace_rng)
+        with self._lock:
+            self._inflight_seq += 1
+            ticket = self._inflight_seq
+            self._inflight[ticket] = trace_id
+        try:
+            return self._forward(payload, trace_id)
+        finally:
+            with self._lock:
+                self._inflight.pop(ticket, None)
+
+    def _forward(self, payload: Dict[str, Any], trace_id: str,
+                 ) -> Tuple[int, Dict[str, Any]]:
         key = self.route_key(payload)
         body = json.dumps(payload).encode()
         tried: set = set()
@@ -223,6 +241,7 @@ class Router:
             try:
                 replica, reason = self.pick(key, frozenset(tried))
             except LookupError as e:
+                self._abort(trace_id, 503, str(e))
                 return 503, {"type": "error", "message": str(e)}
             tried.add(replica.name)
             with self._lock:
@@ -256,13 +275,50 @@ class Router:
                 # same long generation fleet-wide.
                 with self._lock:
                     replica.timeouts += 1
+                self._abort(trace_id, 504, "attempt timed out")
                 return 504, out
+            if not 200 <= status < 300:
+                # 4xx pass-through: the replica rejected the request
+                # before the engine ever saw it, so no serve.finish
+                # will exist anywhere — terminate the placement here.
+                self._abort(trace_id, status,
+                            str(out.get("message", "client error"))
+                            if isinstance(out, dict) else "client error")
+                return status, out
             metrics.counter("tk8s_route_requests_total").inc(
                 replica=replica.name, reason=reason)
             if isinstance(out, dict):
                 out = dict(out, replica=replica.name, trace_id=trace_id)
             return status, out
+        self._abort(trace_id, last[0], "every replica failed")
         return last
+
+    def _abort(self, trace_id: str, status: int, error: str) -> None:
+        """Record the router giving up on a request. route.place spans
+        get a terminal child even when no replica produced one — the
+        merged-timeline completeness rule ``validate_chaos_trace``
+        enforces. Never called under the lock (TK8S103)."""
+        if self.trace is not None:
+            self.trace.event("route.abort", self.clock(), trace=trace_id,
+                             status=status, error=error)
+
+    def abort_inflight(self, error: str) -> int:
+        """Flush every request still inside :meth:`forward` as a
+        ``route.abort`` terminal on the router's trace writer — the
+        shutdown/SIGTERM seam. A request blocked on a replica when the
+        router dies would otherwise leave a placement span with no
+        terminal child in the merged timeline. Returns the number of
+        lifecycles flushed."""
+        with self._lock:
+            pending = sorted(self._inflight.items())
+            self._inflight.clear()
+        if self.trace is not None:
+            at = self.clock()
+            for _, tid in pending:
+                self.trace.event("route.abort", at, trace=tid, status=0,
+                                 error=error)
+            self.trace.flush()
+        return len(pending)
 
     def _post(self, url: str, body: bytes, trace_id: Optional[str] = None,
               ) -> Tuple[int, Dict[str, Any]]:
@@ -427,6 +483,10 @@ class RouterHTTPServer:
         self._stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
+        # Daemon handler threads may still sit inside forward() blocked
+        # on a replica: flush their lifecycles as route.abort terminals
+        # before the trace writer goes away with the process.
+        self.router.abort_inflight("router shutdown")
         for t in (self._probe_thread, self._http_thread):
             if t is not None:
                 t.join(timeout=5)
@@ -440,7 +500,11 @@ class RouterHTTPServer:
         try:
             self.httpd.serve_forever()
         finally:
+            # SIGTERM lands here as SystemExit (the CLI's
+            # _sigterm_runs_finally seam): flush in-flight lifecycles
+            # while the trace writer is still open.
             self._stop.set()
+            self.router.abort_inflight("router shutdown")
 
     def __enter__(self) -> "RouterHTTPServer":
         return self.start()

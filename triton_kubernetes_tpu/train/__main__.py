@@ -345,6 +345,13 @@ def main(argv=None) -> int:
         log.log("error", "TK8S_TEST_CRASH_RANK: injected startup crash",
                 rank=crash_rank)
         return 3
+    # Mid-run death injection (chaos workload arms): the named rank
+    # hard-exits at the first sync window >= start_step + N — rank 0
+    # models coordinator loss, any other rank a plain worker death.
+    # os._exit on purpose: a real crash runs no finally blocks.
+    crash_step_env = os.environ.get("TK8S_TEST_CRASH_STEP")
+    crash_step = int(crash_step_env) if crash_step_env else None
+    crash_step_rank = os.environ.get("TK8S_TEST_CRASH_STEP_RANK", "0")
     try:
         _maybe_init_distributed(args.distributed, log)
     except DistributedEnvError as e:
@@ -685,6 +692,13 @@ def main(argv=None) -> int:
 
     def on_sync(gstep, cur_state, window_losses, window_dt):
         nonlocal last_loss
+        if crash_step is not None \
+                and gstep >= start_step + crash_step \
+                and str(jax.process_index()) == crash_step_rank:
+            log.log("error",
+                    "TK8S_TEST_CRASH_STEP: injected mid-run death",
+                    step=gstep, rank=crash_step_rank)
+            os._exit(3)
         sync_windows.append((len(window_losses), window_dt))
         last_loss = window_losses[-1]
         tps = tokens_per_step * len(window_losses) / max(window_dt, 1e-9)

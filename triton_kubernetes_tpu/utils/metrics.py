@@ -121,6 +121,10 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "counter", "Candidate reductions tried while shrinking failing "
         "chaos specs, by outcome (accepted / rejected)",
         ("outcome",), None),
+    "tk8s_chaos_workload_arms_total": (
+        "counter", "Workload fault arms run by the chaos harness, by "
+        "fault kind and outcome (ok / violated / skipped)",
+        ("kind", "status"), None),
     # ------------------------------------- train/pipeline.py (step loop)
     "tk8s_train_step_duration_seconds": (
         "histogram", "Per-step wall-clock duration, amortized over each "

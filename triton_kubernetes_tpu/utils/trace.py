@@ -76,6 +76,9 @@ SPAN_CATALOG: Dict[str, str] = {
                       "(recompute=True after a preemption)",
     "serve.prefill": "one prefill window ran (offset/tokens fields; the "
                      "whole prompt in legacy non-chunked mode)",
+    "serve.prefill_yield": "a chunked-prefill window ended with windows "
+                           "still to run — the wait for the next one is "
+                           "queue time, not prefill",
     "serve.first_token": "the first token sampled — TTFT stops here",
     "serve.resume": "a preempted request finished re-prefilling its own "
                     "history and rejoined decode",
@@ -93,6 +96,9 @@ SPAN_CATALOG: Dict[str, str] = {
     "serve.step": "one engine scheduler tick (finished-count field)",
     "route.place": "router placed a request on a replica (replica, "
                    "reason=affine/spill/eject, status fields)",
+    "route.abort": "the router gave up on a request (timeout, every "
+                   "replica down, or router shutdown) — the terminal "
+                   "child of its route.place spans",
     "operator.tick": "one reconcile observe->diff->act cycle (outcome "
                      "field)",
     "operator.scale": "autoscaler actuation (direction/reason/pools "
@@ -415,7 +421,23 @@ class FlightRecorder:
                 rec.spec_accepted += int(fields.get("accepted", 0))
             state = _EVENT_STATE.get(name)
             if name == "serve.admitted":
-                state = "recompute" if fields.get("recompute") else "prefill"
+                # A chunked-mode admission (deferred=True) only grants
+                # the slot and pages — compute happens per window, so
+                # the request stays in `queue` until its first
+                # serve.prefill. Legacy admissions prefill inline.
+                if fields.get("deferred"):
+                    state = None
+                else:
+                    state = ("recompute" if fields.get("recompute")
+                             else "prefill")
+            elif name == "serve.prefill":
+                state = "recompute" if rec.preemptions else "prefill"
+            elif name == "serve.prefill_yield":
+                # Window over, more to come: the wait until the engine
+                # schedules the next window is queue time. Booking it
+                # as prefill would silently inflate prefill_s whenever
+                # two prefilling requests interleave.
+                state = "queue"
             if state is not None and rec.state is not None:
                 self._transition(rec, state, at)
         if self.writer is not None:
@@ -618,4 +640,151 @@ def validate_chrome_trace(doc: Any) -> List[str]:
         if ph == "i" and ev.get("s") not in ("t", "p", "g"):
             problems.append(f"{where}: instant event needs scope s in "
                             f"t/p/g")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Chaos trace-validity oracle
+# ---------------------------------------------------------------------------
+
+#: Timestamps land on disk rounded to 9 decimals and phase sums
+#: accumulate float error per segment; anything past this is a real
+#: attribution bug, not rounding.
+_CHAOS_EPS = 1e-6
+
+
+def validate_chaos_trace(paths: Sequence[str]) -> List[str]:
+    """The chaos harness's *generic* trace-validity oracle: one check
+    that any faulted arm's per-process trace files describe complete,
+    exactly-attributed request lifecycles. Returns problems, [] when
+    the timeline is valid.
+
+    Per file:
+
+    * every event name is declared in :data:`SPAN_CATALOG`;
+    * every request that appears reaches a terminal
+      (``serve.finish`` or ``serve.abort`` — aborted lifecycles must
+      be *flushed*, not dropped);
+    * the request's ``serve.phase`` spans carry only
+      :data:`PHASE_STATES`, tile ``[submitted, terminal]``
+      contiguously, and their durations sum to e2e exactly;
+    * *exclusive prefill*: the engine runs one prefill window per
+      tick, so no two requests' prefill/recompute spans may overlap
+      within one file — overlap means a wait between windows was
+      booked as prefill instead of queue.
+
+    Across files:
+
+    * every trace id the router placed (``route.place``) reaches
+      ``serve.finish`` in some file or ``route.abort`` in the
+      router's own — no placement span without a terminal child;
+    * the files merge (:func:`merge_trace_files`) into a timeline
+      that passes :func:`validate_chrome_trace`.
+    """
+    problems: List[str] = []
+    placed, route_aborted, finished = set(), set(), set()
+    readable = True
+    for path in paths:
+        try:
+            meta, events = read_trace_jsonl(path)
+        except TraceMergeError as e:
+            problems.append(str(e))
+            readable = False
+            continue
+        label = f"{os.path.basename(path)}[{meta.get('role', '?')}]"
+        reqs: Dict[str, Dict[str, Any]] = {}
+        for ev in events:
+            name = ev["name"]
+            if name not in SPAN_CATALOG:
+                problems.append(f"{label}: undeclared span name {name!r}")
+            trace = ev.get("trace")
+            if trace is not None:
+                if name == "route.place":
+                    placed.add(trace)
+                elif name == "route.abort":
+                    route_aborted.add(trace)
+                elif name == "serve.finish":
+                    finished.add(trace)
+            rid = ev.get("request")
+            if rid is None or not name.startswith("serve."):
+                continue
+            st = reqs.setdefault(rid, {"submitted": None, "terminal": None,
+                                       "phase": []})
+            if name == "serve.submitted":
+                st["submitted"] = float(ev["at"])
+            elif name in ("serve.finish", "serve.abort"):
+                st["terminal"] = float(ev["at"])
+            elif name == "serve.phase":
+                f = ev.get("fields") or {}
+                t0 = float(ev["at"])
+                st["phase"].append((str(f.get("state")), t0,
+                                    t0 + float(ev.get("dur_s", 0.0))))
+        compute_spans: List[Tuple[float, float, str]] = []
+        for rid, st in sorted(reqs.items()):
+            sub, term = st["submitted"], st["terminal"]
+            if sub is None:
+                problems.append(f"{label}: request {rid}: events without "
+                                f"serve.submitted")
+                continue
+            if term is None:
+                problems.append(f"{label}: request {rid}: no terminal — "
+                                f"never finished, never flushed as "
+                                f"aborted")
+                continue
+            spans = sorted(st["phase"], key=lambda s: s[1])
+            bad_state = [s for s, _, _ in spans if s not in PHASE_STATES]
+            if bad_state:
+                problems.append(f"{label}: request {rid}: unknown phase "
+                                f"state(s) {sorted(set(bad_state))}")
+                continue
+            if not spans:
+                if term - sub > _CHAOS_EPS:
+                    problems.append(f"{label}: request {rid}: lifetime "
+                                    f"{term - sub:.9f}s but no serve.phase "
+                                    f"spans")
+                continue
+            cursor = sub
+            for state, t0, t1 in spans:
+                if abs(t0 - cursor) > _CHAOS_EPS:
+                    problems.append(
+                        f"{label}: request {rid}: phase gap/overlap — "
+                        f"{state} opens at {t0:.9f}, previous segment "
+                        f"closed at {cursor:.9f}")
+                    break
+                cursor = t1
+            else:
+                if abs(cursor - term) > _CHAOS_EPS:
+                    problems.append(
+                        f"{label}: request {rid}: phase spans end at "
+                        f"{cursor:.9f} but terminal is at {term:.9f}")
+            total = sum(t1 - t0 for _, t0, t1 in spans)
+            if abs(total - (term - sub)) > _CHAOS_EPS:
+                problems.append(
+                    f"{label}: request {rid}: phase sum {total:.9f} != "
+                    f"e2e {term - sub:.9f}")
+            compute_spans.extend(
+                (t0, t1, rid) for state, t0, t1 in spans
+                if state in ("prefill", "recompute"))
+        compute_spans.sort()
+        max_end, max_rid = _NINF, None
+        for t0, t1, rid in compute_spans:
+            if rid != max_rid and t0 < max_end - _CHAOS_EPS:
+                problems.append(
+                    f"{label}: prefill overlap — requests {max_rid} and "
+                    f"{rid} both in prefill/recompute at {t0:.9f} (a "
+                    f"wait between windows was booked as prefill)")
+            if t1 > max_end:
+                max_end, max_rid = t1, rid
+    for t in sorted(placed - finished - route_aborted):
+        problems.append(f"route.place without terminal: trace {t} was "
+                        f"placed but never reached serve.finish or "
+                        f"route.abort")
+    if readable:
+        try:
+            doc = merge_trace_files(paths)
+        except TraceMergeError as e:
+            problems.append(str(e))
+        else:
+            problems.extend(f"merged timeline: {p}"
+                            for p in validate_chrome_trace(doc))
     return problems
