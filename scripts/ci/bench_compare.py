@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Bench ratchet: fail CI when the newest BENCH_rNN round regresses
+tokens/s against the best *comparable* prior round.
+
+The BENCH_rNN.json series at the repo root is append-only history: one
+file per nightly bench invocation, schema ``{n, cmd, rc, tail, parsed}``
+where ``parsed`` is the bench harness's summary line (or null when the
+harness itself crashed, as in r01). Rounds are only comparable when
+their configuration axes match — the series spans model swaps
+(llama3-bench -> llama-test), precision/attention/remat additions, and
+spec-decode rounds, and comparing across any of those axes would turn
+every intentional config change into a fake regression. Axes absent in
+an old round (the schema grew over time) are treated as a distinct
+configuration, not a wildcard.
+
+CPU-fallback rounds (``parsed.error == "tpu_unreachable_cpu_fallback"``
+or ``platform == "cpu"``) are compared only against other CPU-fallback
+rounds, and with a much wider margin: a shared CI box's CPU throughput
+swings with co-tenancy, so only a gross collapse is signal there. TPU
+rounds get the tight margin.
+
+A latest round with no comparable prior passes and becomes the ratchet
+baseline for its configuration. Skipped rounds (rc != 0, parsed null)
+never count as baselines.
+
+Usage: python scripts/ci/bench_compare.py [tag]   (default: local)
+Writes docs/ci-evidence/bench-compare-<tag>.json; exits 1 on regression.
+"""
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.environ.get(
+    "TK8S_BENCH_ROOT",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 os.pardir, os.pardir))
+
+# Configuration axes that must match for two rounds to be comparable.
+# .get() so rounds predating an axis carry None — a distinct config.
+AXES = ("metric", "platform", "device", "attention", "precision",
+        "remat", "kv_dtype", "weight_dtype", "spec_k")
+
+# latest/best ratios below these fail. TPU numbers are stable enough
+# for a tight ratchet; CPU-fallback numbers on a shared runner are not.
+TPU_MARGIN = 0.85
+CPU_MARGIN = 0.50
+
+CPU_FALLBACK_ERROR = "tpu_unreachable_cpu_fallback"
+
+
+def load_rounds(root):
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        data["_path"] = os.path.basename(path)
+        rounds.append(data)
+    rounds.sort(key=lambda r: int(r.get("n", 0)))
+    return rounds
+
+
+def usable(r):
+    parsed = r.get("parsed")
+    return (r.get("rc") == 0 and isinstance(parsed, dict)
+            and isinstance(parsed.get("value"), (int, float)))
+
+
+def is_cpu_fallback(parsed):
+    return (parsed.get("error") == CPU_FALLBACK_ERROR
+            or parsed.get("platform") == "cpu")
+
+
+def axes_key(parsed):
+    return tuple((a, parsed.get(a)) for a in AXES)
+
+
+def main(argv):
+    tag = argv[1] if len(argv) > 1 else "local"
+    out_path = os.path.join(REPO, "docs", "ci-evidence",
+                            f"bench-compare-{tag}.json")
+    rounds = load_rounds(REPO)
+    evidence = {
+        "tag": tag,
+        "rounds_total": len(rounds),
+        "rounds_usable": sum(1 for r in rounds if usable(r)),
+    }
+
+    good = [r for r in rounds if usable(r)]
+    if not good:
+        evidence["verdict"] = "skip:no-usable-rounds"
+        return finish(evidence, out_path, 0)
+
+    latest = good[-1]
+    lp = latest["parsed"]
+    cpu = is_cpu_fallback(lp)
+    key = axes_key(lp)
+    evidence["latest"] = {
+        "round": latest.get("n"), "path": latest["_path"],
+        "value": lp["value"], "metric": lp.get("metric"),
+        "cpu_fallback": cpu,
+    }
+
+    # Best prior round in the same arena (cpu-vs-cpu, tpu-vs-tpu) with
+    # identical axes — the ratchet's high-water mark.
+    best = None
+    for r in good[:-1]:
+        p = r["parsed"]
+        if is_cpu_fallback(p) != cpu or axes_key(p) != key:
+            continue
+        if best is None or p["value"] > best["parsed"]["value"]:
+            best = r
+    if best is None:
+        evidence["verdict"] = "pass:new-configuration-baseline"
+        return finish(evidence, out_path, 0)
+
+    bp = best["parsed"]
+    margin = CPU_MARGIN if cpu else TPU_MARGIN
+    ratio = lp["value"] / bp["value"] if bp["value"] > 0 else 0.0
+    evidence["best_prior"] = {
+        "round": best.get("n"), "path": best["_path"],
+        "value": bp["value"],
+    }
+    evidence["ratio"] = round(ratio, 4)
+    evidence["margin"] = margin
+    if ratio < margin:
+        evidence["verdict"] = "fail:regression"
+        return finish(evidence, out_path, 1)
+    evidence["verdict"] = "pass"
+    return finish(evidence, out_path, 0)
+
+
+def finish(evidence, out_path, rc):
+    with open(out_path, "w") as f:
+        json.dump(evidence, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"bench-compare evidence written: {out_path}")
+    print(json.dumps(evidence, sort_keys=True))
+    if rc:
+        latest = evidence.get("latest", {})
+        best = evidence.get("best_prior", {})
+        print(
+            "FAIL: bench round {} at {} is {:.1%} of best comparable "
+            "round {} ({}); margin {}".format(
+                latest.get("round"), latest.get("value"),
+                evidence.get("ratio", 0.0), best.get("round"),
+                best.get("value"), evidence.get("margin")),
+            file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

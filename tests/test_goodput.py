@@ -1,0 +1,235 @@
+"""utils/trace.GoodputRecorder + the goodput partition oracle.
+
+The recorder's claim is structural: segments close at exactly the
+timestamp the next opens, so per-category seconds partition the
+recorded window BY CONSTRUCTION on the injectable clock. These tests
+pin the arithmetic on a manual clock, the two-sink contract (span +
+counter from one measurement), the threaded enter/exit edges — and
+then prove the oracle is a real check by hand-building trace files
+with a gap and with an overlap and watching each get rejected with
+the right diagnosis (an oracle that can't fail can't gate CI).
+"""
+
+import json
+
+import pytest
+
+from triton_kubernetes_tpu.utils import metrics
+from triton_kubernetes_tpu.utils.trace import (
+    GOODPUT_CATEGORIES,
+    GOODPUT_FAMILY,
+    GoodputRecorder,
+    TraceWriter,
+    summarize_goodput,
+    validate_goodput_events,
+    validate_goodput_trace,
+)
+
+
+@pytest.fixture()
+def fresh_registry():
+    """Swap in an empty process-default registry; restore the old one."""
+    old = metrics.get_registry()
+    reg = metrics.configure()
+    yield reg
+    metrics.configure(old)
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+        self.reads = 0
+
+    def __call__(self):
+        self.reads += 1
+        return self.t
+
+
+def test_partition_on_manual_clock():
+    clock = ManualClock()
+    rec = GoodputRecorder("train", clock=clock, metrics_enabled=False)
+    clock.t = 1.0
+    rec.transition("data_wait")
+    clock.t = 1.5
+    rec.transition("step")
+    clock.t = 4.0
+    rec.transition("host_sync")
+    clock.t = 4.25
+    rec.transition("idle")
+    clock.t = 5.0
+    rec.close()
+    assert rec.seconds == {
+        "step": 2.5, "compile": 0.0, "data_wait": 0.5,
+        "host_sync": 0.25, "checkpoint": 0.0, "rollback_replay": 0.0,
+        "preempted_lost": 0.0, "idle": 1.0 + 0.75}
+    assert rec.wall_seconds() == pytest.approx(5.0)
+    assert rec.accounted_seconds() == pytest.approx(5.0)
+    # Closed means closed: a late transition cannot reopen the ledger.
+    clock.t = 9.0
+    rec.transition("step")
+    assert rec.accounted_seconds() == pytest.approx(5.0)
+
+
+def test_same_category_transition_is_free():
+    """Re-entering the current category must not read the clock — the
+    engine calls transition() on every prefill tick and a per-tick
+    clock read would perturb ManualClock-driven serving tests."""
+    clock = ManualClock()
+    rec = GoodputRecorder("serve", clock=clock, metrics_enabled=False)
+    reads = clock.reads
+    rec.transition("idle")  # already idle
+    rec.transition("idle")
+    assert clock.reads == reads
+
+
+def test_unknown_source_and_category_raise():
+    with pytest.raises(ValueError, match="unknown goodput source"):
+        GoodputRecorder("gpu", metrics_enabled=False)
+    rec = GoodputRecorder("route", metrics_enabled=False)
+    with pytest.raises(ValueError, match="not in the 'route'"):
+        rec.transition("step")  # a train category, not a route one
+
+
+def test_enter_exit_depth_edges():
+    """Only the 0->1 enter and 1->0 exit transition: two overlapping
+    requests in a threaded router book ONE forward segment."""
+    clock = ManualClock()
+    rec = GoodputRecorder("route", clock=clock, metrics_enabled=False)
+    clock.t = 1.0
+    rec.enter("forward")
+    clock.t = 2.0
+    rec.enter("forward")   # depth 2: no transition
+    clock.t = 3.0
+    rec.exit_idle()        # depth 1: still forward
+    clock.t = 4.0
+    rec.exit_idle()        # depth 0: back to idle
+    clock.t = 5.0
+    rec.close()
+    assert rec.seconds["forward"] == pytest.approx(3.0)
+    assert rec.seconds["idle"] == pytest.approx(2.0)
+    assert rec.accounted_seconds() == pytest.approx(rec.wall_seconds())
+
+
+def test_one_measurement_two_sinks(tmp_path, fresh_registry):
+    """Each closed segment lands as a <source>.goodput span AND ticks
+    the counter family — trace and metrics can never disagree because
+    they are the same booking."""
+    path = str(tmp_path / "t.jsonl")
+    clock = ManualClock()
+    writer = TraceWriter(path, "trainer:rank0", clock=clock,
+                         wall=lambda: 100.0)
+    rec = GoodputRecorder("train", clock=clock, writer=writer)
+    clock.t = 2.0
+    rec.transition("step")
+    clock.t = 5.0
+    rec.close()
+    writer.close()
+
+    assert validate_goodput_trace([path]) == []
+    events = [json.loads(l) for l in open(path)][1:]
+    booked = {e["fields"]["category"]: e["dur_s"] for e in events
+              if e["name"] == "train.goodput"}
+    assert booked == {"idle": 2.0, "step": 3.0}
+    counter = metrics.counter(GOODPUT_FAMILY)
+    assert counter.value(source="train", category="step") \
+        == pytest.approx(3.0)
+    assert counter.value(source="train", category="idle") \
+        == pytest.approx(2.0)
+
+
+def _write_trace(path, segments):
+    """A hand-built per-process trace file: meta anchor + one
+    train.goodput span per (category, at, dur) segment."""
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "meta", "version": 1,
+                            "role": "trainer:rank0", "pid": 1,
+                            "clock": 0.0, "wall": 100.0}) + "\n")
+        for cat, at, dur in segments:
+            f.write(json.dumps({
+                "type": "event", "name": "train.goodput", "at": at,
+                "dur_s": dur, "fields": {"category": cat}}) + "\n")
+
+
+def test_oracle_accepts_a_true_partition(tmp_path):
+    path = str(tmp_path / "ok.jsonl")
+    _write_trace(path, [("idle", 0.0, 1.0), ("compile", 1.0, 2.0),
+                        ("step", 3.0, 4.0), ("idle", 7.0, 0.5)])
+    assert validate_goodput_trace([path]) == []
+
+
+def test_oracle_rejects_a_gap(tmp_path):
+    """0.5s of chip time escapes attribution between compile and step:
+    the oracle must say 'gap', name the unattributed seconds, and fail
+    the file — this is the direction CI gates on."""
+    path = str(tmp_path / "gap.jsonl")
+    _write_trace(path, [("idle", 0.0, 1.0), ("compile", 1.0, 2.0),
+                        ("step", 3.5, 4.0)])
+    problems = validate_goodput_trace([path])
+    assert len(problems) == 1
+    assert "gap" in problems[0]
+    assert "0.500000000s unattributed" in problems[0]
+
+
+def test_oracle_rejects_an_overlap(tmp_path):
+    """step opens 0.5s before compile closes: chip time booked twice is
+    a different lie than a gap and must be diagnosed as one."""
+    path = str(tmp_path / "overlap.jsonl")
+    _write_trace(path, [("idle", 0.0, 1.0), ("compile", 1.0, 2.0),
+                        ("step", 2.5, 4.0)])
+    problems = validate_goodput_trace([path])
+    assert len(problems) == 1
+    assert "overlap" in problems[0]
+    assert "booked twice" in problems[0]
+
+
+def test_oracle_rejects_foreign_vocabulary(tmp_path):
+    path = str(tmp_path / "vocab.jsonl")
+    _write_trace(path, [("prefill", 0.0, 1.0)])  # a serve category
+    problems = validate_goodput_trace([path])
+    assert len(problems) == 1
+    assert "closed vocabulary" in problems[0]
+
+
+def test_oracle_events_entry_matches_trace_entry():
+    segs = [{"name": "serve.goodput", "at": 0.0, "dur_s": 1.0,
+             "fields": {"category": "prefill"}},
+            {"name": "serve.goodput", "at": 2.0, "dur_s": 1.0,
+             "fields": {"category": "decode"}}]
+    problems = validate_goodput_events("x", segs)
+    assert problems and "gap" in problems[0]
+
+
+def test_summarize_goodput_fleet_rollup(tmp_path):
+    p0 = str(tmp_path / "r0.jsonl")
+    p1 = str(tmp_path / "r1.jsonl")
+    _write_trace(p0, [("step", 0.0, 6.0), ("rollback_replay", 6.0, 2.0),
+                      ("idle", 8.0, 2.0)])
+    _write_trace(p1, [("step", 0.0, 8.0), ("checkpoint", 8.0, 2.0)])
+    report = summarize_goodput([p0, p1])
+    assert len(report["processes"]) == 2
+    proc0 = report["processes"][0]
+    assert proc0["wall_s"] == pytest.approx(10.0)
+    assert proc0["accounted_s"] == pytest.approx(10.0)
+    assert proc0["useful_fraction"] == pytest.approx(0.6)
+    assert proc0["waste_fraction"] == pytest.approx(0.2)
+    fleet = report["fleet"]
+    assert fleet["accounted_s"] == pytest.approx(20.0)
+    assert fleet["useful_fraction"] == pytest.approx(14.0 / 20.0)
+    assert fleet["waste_by_category"] == {"rollback_replay": 2.0}
+
+
+def test_vocabulary_is_closed_and_disjointly_classified():
+    """Every category classifies as exactly one of useful/waste/neutral
+    — the operator's fractions assume the split is a partition of the
+    vocabulary itself."""
+    from triton_kubernetes_tpu.utils.trace import (
+        GOODPUT_USEFUL,
+        GOODPUT_WASTE,
+    )
+
+    for source, cats in GOODPUT_CATEGORIES.items():
+        useful = set(GOODPUT_USEFUL[source])
+        waste = set(GOODPUT_WASTE[source])
+        assert useful <= set(cats)
+        assert waste <= set(cats)
+        assert not useful & waste

@@ -45,7 +45,7 @@ def test_at_least_twelve_active_rules():
     codes = {r.code for r in RULES}
     assert len(codes) >= 12
     assert codes == ({f"TK8S10{i}" for i in range(1, 10)}
-                     | {"TK8S110", "TK8S111", "TK8S112"})
+                     | {"TK8S110", "TK8S111", "TK8S112", "TK8S113"})
 
 
 # ----------------------------------------------------------- TK8S101
@@ -585,6 +585,149 @@ def test_tk8s112_non_literal_kinds_is_itself_a_finding(tmp_path):
     findings, _ = lint_project(root)
     got = hits(findings, "TK8S112")
     assert got == [("triton_kubernetes_tpu/chaos/corpus.py", 1)]
+
+
+# ----------------------------------------------------------- TK8S113
+
+GOODPUT_TRACE_MODULE = """\
+    GOODPUT_FAMILY = "tk8s_goodput_seconds_total"
+
+    GOODPUT_CATEGORIES = {
+        "serve": ("prefill", "decode", "idle"),
+        "train": ("step", "compile", "idle"),
+    }
+"""
+
+GOODPUT_METRICS_MODULE = """\
+    CATALOG = {
+        "tk8s_goodput_seconds_total": ("counter", "chip-seconds",
+                                       ("source", "category"), None),
+    }
+"""
+
+GOODPUT_DOCS = """\
+    # Observability
+
+    ### Goodput categories
+
+    | source | category | class | meaning |
+    |---|---|---|---|
+    | `serve` | `prefill` | useful | prompt compute |
+    | `serve` | `decode` | useful | token compute |
+    | `serve` | `idle` | neutral | no work |
+    | `train` | `step` | useful | optimizer step |
+    | `train` | `compile` | neutral | jit |
+    | `train` | `idle` | neutral | no work |
+
+    ## Next section
+"""
+
+
+def test_tk8s113_clean_when_vocabulary_agrees(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/trace.py": GOODPUT_TRACE_MODULE,
+        "triton_kubernetes_tpu/utils/metrics.py": GOODPUT_METRICS_MODULE,
+        "docs/guide/observability.md": GOODPUT_DOCS,
+        "triton_kubernetes_tpu/serve/engine.py": """\
+            def tick(self):
+                self.goodput.transition("decode")
+        """,
+    })
+    findings, _ = lint_project(root)
+    assert hits(findings, "TK8S113") == []
+
+
+def test_tk8s113_typod_call_site_category(tmp_path):
+    """The motivating bug: transition("dekode") parses, imports, and
+    raises only on the first tick that takes that path — the linter
+    must catch it at the call site before any tick does."""
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/trace.py": GOODPUT_TRACE_MODULE,
+        "triton_kubernetes_tpu/utils/metrics.py": GOODPUT_METRICS_MODULE,
+        "docs/guide/observability.md": GOODPUT_DOCS,
+        "triton_kubernetes_tpu/serve/engine.py": """\
+            def tick(self):
+                self.goodput.transition("dekode")
+        """,
+        "triton_kubernetes_tpu/train/loop.py": """\
+            def run(goodput):
+                goodput.enter("stepp")
+        """,
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S113")
+    assert ("triton_kubernetes_tpu/serve/engine.py", 2) in got
+    assert ("triton_kubernetes_tpu/train/loop.py", 2) in got
+    assert len(got) == 2
+
+
+def test_tk8s113_docs_drift_both_directions(tmp_path):
+    """A category the docs table never mentions AND a stale docs row
+    naming a category the vocabulary dropped — each direction is its
+    own finding at its own home."""
+    stale_docs = GOODPUT_DOCS.replace(
+        "| `train` | `compile` | neutral | jit |",
+        "| `train` | `warmup` | neutral | gone from the vocabulary |")
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/trace.py": GOODPUT_TRACE_MODULE,
+        "triton_kubernetes_tpu/utils/metrics.py": GOODPUT_METRICS_MODULE,
+        "docs/guide/observability.md": stale_docs,
+    })
+    findings, _ = lint_project(root)
+    msgs = [f for f in findings if f.code == "TK8S113"]
+    assert len(msgs) == 2
+    missing = [f for f in msgs if "missing from" in f.message]
+    stale = [f for f in msgs if "stale docs" in f.message]
+    assert missing and missing[0].path.endswith("utils/trace.py")
+    assert stale and stale[0].path.endswith("observability.md")
+    assert "'warmup'" in stale[0].message
+    # The stale finding points at the row itself, not the heading.
+    assert stale[0].line == 11
+
+
+def test_tk8s113_missing_docs_section(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/trace.py": GOODPUT_TRACE_MODULE,
+        "triton_kubernetes_tpu/utils/metrics.py": GOODPUT_METRICS_MODULE,
+        "docs/guide/observability.md": "# Observability\n",
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S113")
+    assert got == [("docs/guide/observability.md", 1)]
+
+
+def test_tk8s113_family_missing_from_metrics_catalog(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/trace.py": GOODPUT_TRACE_MODULE,
+        "triton_kubernetes_tpu/utils/metrics.py": """\
+            CATALOG = {
+                "tk8s_other_family": ("counter", "x", (), None),
+            }
+        """,
+        "docs/guide/observability.md": GOODPUT_DOCS,
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S113")
+    assert got == [("triton_kubernetes_tpu/utils/trace.py", 1)]
+
+
+def test_tk8s113_non_literal_vocabulary_is_itself_a_finding(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/trace.py": """\
+            GOODPUT_CATEGORIES = dict(serve=("prefill",))
+        """,
+    })
+    findings, _ = lint_project(root)
+    got = hits(findings, "TK8S113")
+    assert got == [("triton_kubernetes_tpu/utils/trace.py", 1)]
+
+
+def test_tk8s113_absent_vocabulary_module_is_clean(tmp_path):
+    root = make_tree(tmp_path, {
+        "triton_kubernetes_tpu/utils/x.py": "x = 1\n",
+    })
+    findings, _ = lint_project(root)
+    assert hits(findings, "TK8S113") == []
 
 
 # ------------------------------------------------- suppression round trip

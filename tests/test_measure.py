@@ -57,6 +57,57 @@ def test_measure_requires_long_window_to_exceed_short(monkeypatch):
                                n_short=3, n_long=3)
 
 
+def test_measure_zero_steady_window_rejected_before_any_step(monkeypatch):
+    """n_long < n_short is a negative-width window, not just an equal
+    one — and the ValueError must fire before any window runs (a
+    half-measured state would poison a retry with warm caches)."""
+    ran = {"steps": 0}
+
+    def step(state, batch):
+        ran["steps"] += 1
+        return state, {"loss": 0.0}
+
+    with pytest.raises(ValueError, match="must exceed"):
+        measure.measure_throughput(step, 0, [{}], 1, warmup=2,
+                                   n_short=3, n_long=1)
+    assert ran["steps"] == 0
+
+
+def test_measure_single_window(monkeypatch):
+    """n_short=0: the short window is skipped entirely (run() guards on
+    ``if n:``) and the report degrades to one-point timing — the whole
+    long window is the measurement, dispatch overhead uncancelled."""
+    step = _fake_clock_step(monkeypatch, seconds_per_step=0.5)
+    report, state = measure.measure_throughput(
+        step, 0, [{"tokens": None}], tokens_per_step=100,
+        warmup=0, n_short=0, n_long=4)
+    assert state == 4  # only the long window ran
+    assert report.steps_timed == 4
+    assert report.window_seconds == pytest.approx(4 * 0.5)
+    assert report.steps_per_sec == pytest.approx(2.0)
+    assert report.tokens_per_sec == pytest.approx(100 * 4 / 2.0)
+    assert report.loss == 2.5
+
+
+def test_measure_report_aggregate_tokens_across_processes(monkeypatch):
+    """tokens_per_step counts the GLOBAL batch, so the reported
+    tokens/s is already the aggregate over every jax.distributed
+    process — n_processes is recorded as context, never multiplied in
+    (a harness that multiplied again would double-count)."""
+    import jax
+
+    step = _fake_clock_step(monkeypatch, seconds_per_step=0.25)
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    report, _ = measure.measure_throughput(
+        step, 0, [{"tokens": None}], tokens_per_step=1024,
+        warmup=1, n_short=2, n_long=6)
+    assert report.n_processes == 4
+    # Identical arithmetic to the single-process case: global tokens
+    # over the same two-point window.
+    assert report.tokens_per_sec == pytest.approx(4 * 1024 / 1.0)
+    assert report.steps_per_sec == pytest.approx(4.0)
+
+
 def test_measure_on_tiny_cpu_mesh_step(cpu_mesh_devices):
     """End to end on a real sharded step: tokens/sec is positive and the
     measured loss is the device-synced training loss."""

@@ -282,5 +282,5 @@ def test_writer_escapes_hostile_trace_and_request_ids(tmp_path):
 def test_span_catalog_is_namespaced_and_described():
     for name, help_text in SPAN_CATALOG.items():
         head = name.split(".", 1)[0]
-        assert head in ("serve", "route", "operator"), name
+        assert head in ("serve", "route", "operator", "train"), name
         assert help_text.strip()

@@ -652,7 +652,7 @@ class SpanCatalogDrift(Rule):
 
     CATALOG_FILE = f"{PKG}/utils/trace.py"
     DOCS_FILE = "docs/guide/observability.md"
-    SCOPES = (f"{PKG}/serve/", f"{PKG}/operator/")
+    SCOPES = (f"{PKG}/serve/", f"{PKG}/operator/", f"{PKG}/train/")
     FILES = (CATALOG_FILE,)
     # A span name: dotted lowercase (`serve.prefill`, `route.place`).
     NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+$")
@@ -1007,3 +1007,157 @@ class WorkloadFaultDrift(Rule):
                             self.GENERATOR_FILE, pair.lineno, 0,
                             "workload_kinds entries must lead with a "
                             "string-literal kind name")
+
+
+# ---------------------------------------------------------------------------
+# TK8S113 — goodput vocabulary drift
+# ---------------------------------------------------------------------------
+
+@register
+class GoodputVocabularyDrift(Rule):
+    """The goodput category vocabulary must agree everywhere it is
+    spelled: ``GOODPUT_CATEGORIES`` in utils/trace.py (the closed
+    per-source vocabulary), every ``.transition("...")`` /
+    ``.enter("...")`` category literal at the emitting sites
+    (serve/train/operator/cli), the ``tk8s_goodput_seconds_total``
+    family in the metrics CATALOG, and the Goodput-categories table of
+    docs/guide/observability.md.
+
+    History: the TK8S112 pattern applied to chip-time attribution. The
+    whole point of the ledger is that categories PARTITION wall time
+    against a closed vocabulary — a typo'd ``transition("dekode")``
+    would raise only on the first tick that takes that path (or worse,
+    a category added at a call site but not to the vocabulary would
+    throw in production while every test passed), and a category
+    missing from the docs table strands every dashboard keyed on it.
+    Each collection must stay a module-level literal: this rule reads
+    them from the AST, so a computed value is itself a finding.
+    """
+
+    code = "TK8S113"
+    name = "goodput-vocabulary-drift"
+    summary = ("goodput categories must agree across GOODPUT_CATEGORIES, "
+               "transition() call sites, the metrics CATALOG, and the "
+               "docs goodput table")
+
+    VOCAB_FILE = f"{PKG}/utils/trace.py"
+    METRICS_FILE = f"{PKG}/utils/metrics.py"
+    DOCS_FILE = "docs/guide/observability.md"
+    DOCS_HEADING = "### Goodput categories"
+    SCOPES = (f"{PKG}/serve/", f"{PKG}/train/", f"{PKG}/operator/",
+              f"{PKG}/cli/")
+    # A docs goodput-table row: `source` then `category`, backticked.
+    ROW_RE = re.compile(
+        r"^\|\s*`([a-z]+)`\s*\|\s*`([a-z_]+)`\s*\|", re.MULTILINE)
+
+    def _vocabulary(self, ctx: FileContext,
+                    ) -> Optional[Dict[str, List[str]]]:
+        """GOODPUT_CATEGORIES as {source: [category, ...]}, or None
+        when it is not a pure module-level literal."""
+        node = WorkloadFaultDrift._assigned(ctx.tree, "GOODPUT_CATEGORIES")
+        if not isinstance(node, ast.Dict):
+            return None
+        out: Dict[str, List[str]] = {}
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                return None
+            cats = WorkloadFaultDrift._str_elts(v)
+            if cats is None:
+                return None
+            out[k.value] = cats
+        return out
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        vocab_ctx = project.file(self.VOCAB_FILE)
+        if vocab_ctx is None:
+            return
+        vocab = self._vocabulary(vocab_ctx)
+        if vocab is None:
+            yield self.finding(
+                self.VOCAB_FILE, 1, 0,
+                "GOODPUT_CATEGORIES must be a module-level dict literal "
+                "of string keys to string-literal tuples (this rule "
+                "reads the AST)")
+            return
+        all_cats = {c for cats in vocab.values() for c in cats}
+        # emitting sites -> vocabulary
+        for rel, ctx in list(project.files.items()):
+            if not rel.startswith(self.SCOPES):
+                continue
+            for n in ast.walk(ctx.tree):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("transition", "enter")
+                        and n.args):
+                    continue
+                lit = n.args[0]
+                if not (isinstance(lit, ast.Constant)
+                        and isinstance(lit.value, str)):
+                    continue
+                if lit.value not in all_cats:
+                    yield self.finding(
+                        rel, n.lineno, n.col_offset,
+                        f"goodput category {lit.value!r} is not in "
+                        f"GOODPUT_CATEGORIES (utils/trace.py) — the "
+                        f"recorder would raise the first time this "
+                        f"path runs")
+        # vocabulary -> metrics CATALOG (the counter family must exist
+        # so the ledger's second sink cannot silently vanish)
+        metrics_ctx = project.file(self.METRICS_FILE)
+        if metrics_ctx is not None:
+            families = WorkloadFaultDrift._dict_keys(
+                WorkloadFaultDrift._assigned(metrics_ctx.tree, "CATALOG"))
+            family_node = WorkloadFaultDrift._assigned(
+                vocab_ctx.tree, "GOODPUT_FAMILY")
+            family = (family_node.value
+                      if isinstance(family_node, ast.Constant)
+                      and isinstance(family_node.value, str) else None)
+            if family is None:
+                yield self.finding(
+                    self.VOCAB_FILE, 1, 0,
+                    "GOODPUT_FAMILY must be a module-level string "
+                    "literal naming the chip-second counter family")
+            elif families is not None and family not in families:
+                yield self.finding(
+                    self.VOCAB_FILE,
+                    getattr(family_node, "lineno", 1), 0,
+                    f"GOODPUT_FAMILY {family!r} is not declared in the "
+                    f"metrics CATALOG (utils/metrics.py) — the ledger's "
+                    f"metrics sink would emit an uncataloged family")
+        # vocabulary <-> docs table
+        docs = project.read_text(self.DOCS_FILE)
+        if docs is None:
+            return
+        start = docs.find(self.DOCS_HEADING)
+        if start < 0:
+            yield self.finding(
+                self.DOCS_FILE, 1, 0,
+                f"no {self.DOCS_HEADING!r} section — the goodput "
+                f"vocabulary must be documented as a table of "
+                f"(source, category) rows")
+            return
+        end = docs.find("\n#", start + len(self.DOCS_HEADING))
+        section = docs[start: end if end > 0 else len(docs)]
+        base_line = docs.count("\n", 0, start)
+        table = {(m.group(1), m.group(2)):
+                 base_line + section.count("\n", 0, m.start()) + 1
+                 for m in self.ROW_RE.finditer(section)}
+        vocab_node = WorkloadFaultDrift._assigned(
+            vocab_ctx.tree, "GOODPUT_CATEGORIES")
+        for source, cats in sorted(vocab.items()):
+            for cat in cats:
+                if (source, cat) not in table:
+                    yield self.finding(
+                        self.VOCAB_FILE,
+                        getattr(vocab_node, "lineno", 1), 0,
+                        f"goodput category ({source!r}, {cat!r}) is "
+                        f"missing from the Goodput-categories table in "
+                        f"{self.DOCS_FILE}")
+        for (source, cat), lineno in sorted(table.items()):
+            if cat not in vocab.get(source, ()):
+                yield self.finding(
+                    self.DOCS_FILE, lineno, 0,
+                    f"docs goodput table names ({source!r}, {cat!r}) "
+                    f"which is not in GOODPUT_CATEGORIES — stale docs "
+                    f"or a typo'd category")

@@ -35,6 +35,7 @@ dict literal is the TK8S112 lint anchor: its keys must equal
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import re
@@ -51,7 +52,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils import metrics
 from ..utils.trace import (TRACE_HEADER, FlightRecorder, TraceWriter,
-                           validate_chaos_trace)
+                           validate_chaos_trace, validate_goodput_trace)
 from .corpus import WORKLOAD_DEFAULTS
 
 #: Simulated seconds every engine ``clock()`` read advances. The soak
@@ -425,11 +426,17 @@ def _arm_torn_checkpoint(cfg, spec, res, check, recorder) -> None:
 
 
 # ------------------------------------------- rank-death/coordinator-loss
-def _train_args(steps: int, ckpt_dir: str) -> List[str]:
-    return ["--model", "llama-test", "--batch-size", "8",
+def _train_args(steps: int, ckpt_dir: str,
+                trace_jsonl: Optional[str] = None) -> List[str]:
+    args = ["--model", "llama-test", "--batch-size", "8",
             "--seq-len", "32", "--steps", str(steps),
             "--sync-every", "1", "--checkpoint-dir", ckpt_dir,
             "--checkpoint-every", "1", "--resume"]
+    if trace_jsonl:
+        # Every rank derives its own {root}.rankN.jsonl from this one
+        # path (launch_trainers passes identical args to all ranks).
+        args += ["--trace-jsonl", trace_jsonl]
+    return args
 
 
 def _train_reference(steps: int) -> Optional[float]:
@@ -476,7 +483,8 @@ def _train_crash_arm(cfg, spec, res, check, recorder,
     try:
         ckpt = os.path.join(tmp, "ckpt")
         rep1 = multihost.launch_trainers(
-            _train_args(steps, ckpt),
+            _train_args(steps, ckpt,
+                        trace_jsonl=os.path.join(tmp, "p1-trace.jsonl")),
             run_dir=os.path.join(tmp, "phase1"), tag="chaos-crash",
             timeout=240,
             env_extra={"TK8S_TEST_CRASH_STEP": str(crash),
@@ -485,7 +493,8 @@ def _train_crash_arm(cfg, spec, res, check, recorder,
                 and len(rep1.returncodes) > victim_rank
                 and rep1.returncodes[victim_rank] == 3)
         rep2 = multihost.launch_trainers(
-            _train_args(steps, ckpt),
+            _train_args(steps, ckpt,
+                        trace_jsonl=os.path.join(tmp, "p2-trace.jsonl")),
             run_dir=os.path.join(tmp, "phase2"), tag="chaos-resume",
             timeout=240)
         losses = (rep2.report or {}).get("losses") or []
@@ -496,6 +505,16 @@ def _train_crash_arm(cfg, spec, res, check, recorder,
               f"rank {victim_rank} death at step +{crash}: "
               f"died={died} (rcs={rep1.returncodes}), resume "
               f"ok={rep2.ok}, final={final} vs reference={ref}")
+        # Every rank's goodput ledger — including the one the crash
+        # killed mid-run — must pass the partition oracle: the recorder
+        # flushes each closed segment, so even an os._exit(3) rank
+        # leaves a prefix of segments that tiles its recorded window
+        # exactly (a gap or overlap here is booking fiction).
+        traces = sorted(glob.glob(os.path.join(tmp, "p?-trace*.jsonl")))
+        problems = validate_goodput_trace(traces)
+        check(res, "trace-valid", bool(traces) and not problems,
+              f"{len(traces)} trainer trace files: "
+              + ("; ".join(problems[:4]) or "goodput partition OK"))
         recorder(rep1.wall_seconds + rep2.wall_seconds)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

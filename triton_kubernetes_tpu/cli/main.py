@@ -471,6 +471,26 @@ def build_parser() -> argparse.ArgumentParser:
                               "actions on the same timeline as router "
                               "placements and replica engine ticks")
 
+    goodput = sub.add_parser(
+        "goodput",
+        help="goodput-ledger tooling: `goodput report` reads per-process "
+             "trace JSONL files (serve/route/operate/train --trace-jsonl) "
+             "and rolls their <source>.goodput segments into per-process "
+             "and fleet chip-second attribution — useful vs waste, by "
+             "category (docs/guide/observability.md §Goodput ledger)")
+    goodput.add_argument("action", choices=["report"])
+    goodput.add_argument("inputs", nargs="+", metavar="JSONL",
+                         help="per-process trace JSONL files to report "
+                              "over (the same files `tk8s trace merge` "
+                              "takes)")
+    goodput.add_argument("--metrics", action="append", default=[],
+                         metavar="FILE", dest="metrics_files",
+                         help="Prometheus text scrape (a saved /metrics "
+                              "body) to fold in (repeatable): its "
+                              "tk8s_goodput_seconds_total samples are "
+                              "reported alongside the trace-derived "
+                              "ledger for cross-checking the two sinks")
+
     tracecmd = sub.add_parser(
         "trace",
         help="fleet-trace tooling: `trace merge` aligns the per-process "
@@ -598,6 +618,72 @@ def main(argv: Optional[List[str]] = None,
             trace.write(args.trace_out)
         return 0
 
+    if args.command == "goodput":
+        # Pure JSON ledger work: no backend, no config, no jax — the
+        # report runs where the trace files landed, accelerator or not.
+        from ..utils.trace import (
+            TraceMergeError,
+            summarize_goodput,
+            validate_goodput_trace,
+        )
+
+        try:
+            problems = validate_goodput_trace(args.inputs)
+            report = summarize_goodput(args.inputs)
+        except (TraceMergeError, OSError) as e:
+            logger.error(str(e), kind=type(e).__name__)
+            return 1
+        if problems:
+            # A ledger that fails the partition oracle is lying about
+            # chip time: report it loudly, not as a rollup.
+            for problem in problems:
+                logger.error(problem, kind="GoodputValidation")
+            return 1
+        if args.metrics_files:
+            from ..utils.metrics import PrometheusParseError, parse_prometheus
+
+            scraped: dict = {}
+            try:
+                for path in args.metrics_files:
+                    with open(path, encoding="utf-8") as f:
+                        fams = parse_prometheus(f.read())
+                    fam = fams.get("tk8s_goodput_seconds_total")
+                    for s in (fam or {}).get("series", []):
+                        labels = s.get("labels", {})
+                        key = (labels.get("source", "?"),
+                               labels.get("category", "?"))
+                        scraped[key] = (scraped.get(key, 0.0)
+                                        + float(s.get("value", 0.0)))
+            except (PrometheusParseError, OSError) as e:
+                logger.error(str(e), kind=type(e).__name__)
+                return 1
+            report["scraped_seconds"] = {
+                s: {c: round(v, 9)
+                    for (src, c), v in sorted(scraped.items()) if src == s}
+                for s in sorted({src for src, _ in scraped})}
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            fleet = report["fleet"]
+            for proc in report["processes"]:
+                cats = " ".join(f"{c}={v:.3f}s"
+                                for c, v in proc["seconds"].items())
+                print(f"{proc['path']} [{proc['role']}] "
+                      f"source={proc['source']} wall={proc['wall_s']:.3f}s "
+                      f"useful={proc['useful_fraction']:.1%} "
+                      f"waste={proc['waste_fraction']:.1%}  {cats}")
+            waste = " ".join(f"{c}={v:.3f}s" for c, v in
+                             fleet["waste_by_category"].items()) or "none"
+            print(f"fleet: accounted={fleet['accounted_s']:.3f} chip-s, "
+                  f"useful={fleet['useful_fraction']:.1%}, "
+                  f"waste={fleet['waste_fraction']:.1%} ({waste})")
+            for src, cats in report.get("scraped_seconds", {}).items():
+                pairs = " ".join(f"{c}={v:.3f}s" for c, v in cats.items())
+                print(f"scraped[{src}]: {pairs}")
+        if trace is not None:
+            trace.write(args.trace_out)
+        return 0
+
     if args.command == "chaos":
         # Pure cloudsim work: needs no backend choice, no config, no jax.
         from ..chaos import CORPUS_DIR, run_sweep
@@ -690,13 +776,19 @@ def main(argv: Optional[List[str]] = None,
                                  port=args.port)
         host, port = server.address
         if args.trace_jsonl:
-            from ..utils.trace import TraceWriter
+            from ..utils.trace import GoodputRecorder, TraceWriter
 
             # The served engine always has a bounded flight recorder
             # (ServeHTTPServer attaches one); the writer upgrades it to
             # spill every lifecycle event to disk for `trace merge`.
             engine.flight.writer = TraceWriter(
                 args.trace_jsonl, role=f"replica:{host}:{port}")
+            # The goodput ledger rides the same writer: every engine
+            # tick books its compute into serve.goodput segments that
+            # tile this replica's wall window (and tick the
+            # tk8s_goodput_seconds_total counter the operator scrapes).
+            engine.goodput = GoodputRecorder(
+                "serve", clock=engine.clock, writer=engine.flight.writer)
         logger.info("serving", url=f"http://{host}:{port}",
                     model=args.model, block_size=args.block_size,
                     num_blocks=args.num_blocks, max_batch=args.max_batch,
@@ -711,6 +803,10 @@ def main(argv: Optional[List[str]] = None,
         except KeyboardInterrupt:
             print("\nstopped", file=sys.stderr)
         finally:
+            if engine.goodput is not None:
+                # Close the ledger BEFORE the writer: the final segment
+                # is what makes the categories tile the wall window.
+                engine.goodput.close()
             if engine.flight is not None and engine.flight.writer is not None:
                 engine.flight.writer.close()
             if trace is not None:
@@ -725,10 +821,12 @@ def main(argv: Optional[List[str]] = None,
 
         _metrics.get_registry().register_catalog()
         route_writer = None
+        route_goodput = None
         if args.trace_jsonl:
-            from ..utils.trace import TraceWriter
+            from ..utils.trace import GoodputRecorder, TraceWriter
 
             route_writer = TraceWriter(args.trace_jsonl, role="router")
+            route_goodput = GoodputRecorder("route", writer=route_writer)
         try:
             router = RouterHTTPServer(
                 args.replicas, host=args.route_host, port=args.port,
@@ -741,6 +839,10 @@ def main(argv: Optional[List[str]] = None,
         except ValueError as e:
             logger.error(str(e), kind="ValueError")
             return 2
+        if route_goodput is not None:
+            # Handler threads overlap: the router books forward time
+            # through the recorder's depth-counted enter/exit edges.
+            router.router.goodput = route_goodput
         host, port = router.address
         logger.info("routing", url=f"http://{host}:{port}",
                     replicas=len(args.replicas),
@@ -754,6 +856,8 @@ def main(argv: Optional[List[str]] = None,
         except KeyboardInterrupt:
             print("\nstopped", file=sys.stderr)
         finally:
+            if route_goodput is not None:
+                route_goodput.close()
             if route_writer is not None:
                 route_writer.close()
             if trace is not None:

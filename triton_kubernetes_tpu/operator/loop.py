@@ -180,6 +180,35 @@ class Reconciler:
             "sources_ok": observed.serving.sources_ok,
             "last_apply_status": observed.last_apply_status,
         }
+        serving = observed.serving
+        useful = serving.goodput_useful_fraction
+        if useful is not None:
+            # Fleet chip-time attribution over THIS window (the per-
+            # source counter deltas): the journal's goodput record and
+            # the gauge the goodput-aware policy reads. None (no
+            # counters moved) leaves the gauge standing — a blind tick
+            # is "no signal", not "0% useful".
+            record.observed["goodput"] = {
+                "accounted_s": round(serving.goodput_accounted_s, 6),
+                "useful_fraction": round(useful, 6),
+                "waste_fraction": round(
+                    serving.goodput_waste_fraction or 0.0, 6),
+                "window": {src: {c: round(v, 6)
+                                 for c, v in sorted(cats.items())}
+                           for src, cats in
+                           sorted(serving.goodput_window.items())},
+            }
+            metrics.gauge("tk8s_operator_fleet_goodput").set(useful)
+        if serving.kv_bytes:
+            # Per-replica KV pressure rides the same journal record:
+            # the capacity signal next to the efficiency signal.
+            record.observed["kv_bytes"] = {
+                str(i): round(v, 1)
+                for i, v in sorted(serving.kv_bytes.items())}
+        if serving.kv_utilization:
+            record.observed["kv_utilization"] = {
+                str(i): round(v, 6)
+                for i, v in sorted(serving.kv_utilization.items())}
         self._track_slo(observed)
 
         decision: Optional[ScaleDecision] = None

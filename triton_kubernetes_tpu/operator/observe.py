@@ -21,13 +21,14 @@ Everything here is read-only and jax-free; acting on the snapshot is
 from __future__ import annotations
 
 import urllib.request
-from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Union
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from ..executor.engine import load_executor_state
 from ..executor.plan import Plan, PlanAction
 from ..state import StateDocument
 from ..utils import metrics
+from ..utils.trace import GOODPUT_FAMILY, GOODPUT_USEFUL, GOODPUT_WASTE
 
 #: A metrics source: a replica/fleet ``/metrics`` URL, or any callable
 #: returning Prometheus text (the test/evidence seam — an in-process
@@ -37,6 +38,8 @@ MetricsSource = Union[str, Callable[[], str]]
 TTFT_FAMILY = "tk8s_serve_ttft_seconds"
 QUEUE_FAMILY = "tk8s_serve_queue_depth"
 REQUESTS_FAMILY = "tk8s_serve_requests_total"
+KV_BYTES_FAMILY = "tk8s_serve_kv_bytes"
+KV_UTIL_FAMILY = "tk8s_serve_kv_block_utilization"
 
 
 def scrape_source(source: MetricsSource, timeout_s: float = 5.0) -> str:
@@ -66,6 +69,17 @@ class ServingSample:
     queue_depth: float = 0.0
     ttft_p99_s: float = 0.0
     window_requests: int = 0
+    # Per-tick chip-second deltas of tk8s_goodput_seconds_total, summed
+    # across sources: source kind -> category -> seconds this window
+    # (windowed per source exactly like the TTFT buckets — first sample
+    # is baseline, a counter regression re-baselines).
+    goodput_window: Dict[str, Dict[str, float]] = field(
+        default_factory=dict)
+    # Current per-replica KV snapshot (source index -> value): pool
+    # bytes (pages + scales components summed) and block-pool occupancy
+    # in [0, 1] — gauges, so no windowing.
+    kv_bytes: Dict[int, float] = field(default_factory=dict)
+    kv_utilization: Dict[int, float] = field(default_factory=dict)
 
     @property
     def blind(self) -> bool:
@@ -74,6 +88,34 @@ class ServingSample:
     @property
     def has_signal(self) -> bool:
         return self.sources_ok > 0
+
+    @property
+    def goodput_accounted_s(self) -> float:
+        return sum(v for cats in self.goodput_window.values()
+                   for v in cats.values())
+
+    @property
+    def goodput_useful_fraction(self) -> Optional[float]:
+        """Fleet useful-chip-time fraction over this window, None when
+        no goodput counters moved (a blind or idle window must read as
+        "no signal", never as 0% useful)."""
+        total = self.goodput_accounted_s
+        if total <= 0.0:
+            return None
+        useful = sum(cats.get(c, 0.0)
+                     for src, cats in self.goodput_window.items()
+                     for c in GOODPUT_USEFUL.get(src, ()))
+        return useful / total
+
+    @property
+    def goodput_waste_fraction(self) -> Optional[float]:
+        total = self.goodput_accounted_s
+        if total <= 0.0:
+            return None
+        waste = sum(cats.get(c, 0.0)
+                    for src, cats in self.goodput_window.items()
+                    for c in GOODPUT_WASTE.get(src, ()))
+        return waste / total
 
 
 class MetricsWatcher:
@@ -97,6 +139,10 @@ class MetricsWatcher:
         # source index -> that source's previous cumulative TTFT
         # buckets (incl. the "+Inf" count).
         self._prev_ttft: Dict[int, Dict[str, float]] = {}
+        # source index -> previous cumulative goodput chip-seconds,
+        # keyed (source kind, category) — windowed with the same
+        # baseline / re-baseline discipline as the TTFT buckets.
+        self._prev_goodput: Dict[int, Dict[Tuple[str, str], float]] = {}
 
     @staticmethod
     def _sum_values(fam: Optional[Dict[str, Any]]) -> float:
@@ -120,6 +166,28 @@ class MetricsWatcher:
         if prev is None:
             return {}
         delta = {le: c - prev.get(le, 0.0) for le, c in buckets.items()}
+        if any(d < 0 for d in delta.values()):
+            return {}
+        return delta
+
+    def _goodput_delta(self, idx: int, fam: Optional[Dict[str, Any]],
+                       ) -> Dict[Tuple[str, str], float]:
+        """One source's per-tick goodput chip-second delta by (source
+        kind, category). First sample establishes the baseline; a
+        regressed counter (process restart) re-baselines — lifetime
+        chip-seconds must never be re-counted as one fresh window."""
+        if not fam:
+            return {}
+        cum: Dict[Tuple[str, str], float] = {}
+        for s in fam["series"]:
+            labels = s.get("labels", {})
+            key = (labels.get("source", "?"), labels.get("category", "?"))
+            cum[key] = cum.get(key, 0.0) + float(s.get("value", 0.0))
+        prev = self._prev_goodput.get(idx)
+        self._prev_goodput[idx] = cum
+        if prev is None:
+            return {}
+        delta = {k: v - prev.get(k, 0.0) for k, v in cum.items()}
         if any(d < 0 for d in delta.values()):
             return {}
         return delta
@@ -148,6 +216,17 @@ class MetricsWatcher:
                 cum = metrics.merge_histogram_series(ttft["series"])
                 for le, d in self._ttft_delta(idx, cum).items():
                     window[le] = window.get(le, 0.0) + d
+            for (src, cat), d in self._goodput_delta(
+                    idx, parsed.get(GOODPUT_FAMILY)).items():
+                cats = sample.goodput_window.setdefault(src, {})
+                cats[cat] = cats.get(cat, 0.0) + d
+            kv = parsed.get(KV_BYTES_FAMILY)
+            if kv and kv["series"]:
+                sample.kv_bytes[idx] = self._sum_values(kv)
+            util = parsed.get(KV_UTIL_FAMILY)
+            if util and util["series"]:
+                sample.kv_utilization[idx] = max(
+                    float(s.get("value", 0.0)) for s in util["series"])
         sample.window_requests = max(0, int(window.get("+Inf", 0.0)))
         if sample.window_requests > 0:
             sample.ttft_p99_s = metrics.histogram_quantile(window, 0.99)

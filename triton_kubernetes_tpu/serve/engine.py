@@ -260,6 +260,14 @@ class ServeEngine:
         # clock() reads, so untraced engines behave bit-for-bit as
         # before — the tracing-off arm of the overhead A/B.
         self.flight = flight
+        # Optional process-level goodput ledger (GoodputRecorder,
+        # source="serve"): attach one AFTER construction (serve CLI /
+        # evidence scripts) and every tick books its compute into the
+        # closed serve vocabulary (prefill/decode/verify/recompute) with
+        # the rest of the wall window falling to idle. Opt-in for the
+        # same reason flight is: the extra clock() reads must cost the
+        # default engine nothing (and ManualClock tests tick per read).
+        self.goodput: Optional[Any] = None
         # One table width serves prefill and decode: enough pages for a
         # full-length sequence, prompt width padded up to whole pages —
         # and, under chunked prefill, up to whole chunk windows, so
@@ -417,6 +425,10 @@ class ServeEngine:
                 self._decode_once(finished)
         self._steps += 1
         self._update_gauges()
+        if self.goodput is not None:
+            # Close the tick's last compute segment: whatever follows
+            # (queue waits, the server's poll loop) is idle chip time.
+            self.goodput.transition("idle")
         if tick_span:
             self.flight.step(t0, self.clock() - t0, len(finished))
         return finished
@@ -525,6 +537,11 @@ class ServeEngine:
         c = self.prefill_chunk
         off = seq.prefilled
         clen = min(c, seq.target - off)
+        if self.goodput is not None:
+            # A preempted sequence's re-prefill is chip time the engine
+            # already spent once — waste, booked as recompute.
+            self.goodput.transition(
+                "recompute" if seq.preemptions > 0 else "prefill")
         if self.flight is not None:
             self.flight.event(seq.request.request_id, "serve.prefill",
                               self.clock(), offset=off, tokens=clen)
@@ -599,6 +616,9 @@ class ServeEngine:
         return (c.k, c.v)
 
     def _prefill_sequence(self, seq: _Sequence, prompt: List[int]) -> None:
+        if self.goodput is not None:
+            self.goodput.transition(
+                "recompute" if seq.preemptions > 0 else "prefill")
         if self.flight is not None:
             self.flight.event(seq.request.request_id, "serve.prefill",
                               self.clock(), offset=0, tokens=len(prompt))
@@ -731,6 +751,8 @@ class ServeEngine:
 
     # ------------------------------------------------------------ decode
     def _decode_once(self, finished: List[FinishedRequest]) -> None:
+        if self.goodput is not None:
+            self.goodput.transition("decode")
         tokens = [0] * self.max_batch
         lengths = [0] * self.max_batch
         tables = [[TRASH_PAGE] * self.blocks_per_seq
@@ -778,6 +800,8 @@ class ServeEngine:
             # one weight pass less.
             self._decode_once(finished)
             return
+        if self.goodput is not None:
+            self.goodput.transition("verify")
         s_width = self.spec_k + 1
         tokens = [[0] * s_width for _ in range(self.max_batch)]
         lengths = [0] * self.max_batch

@@ -144,6 +144,13 @@ class Router:
         self._trace_rng = random.Random(trace_seed)
         self.trace = trace
         self.clock = clock
+        # Optional goodput ledger (GoodputRecorder, source="route"):
+        # attach after construction, as on ServeEngine. Handler threads
+        # overlap, so forward() uses the recorder's depth-counted
+        # enter/exit edges — only the 0->1 and 1->0 crossings transition
+        # between forward and idle, keeping the partition exact under
+        # concurrency (the recorder carries its own lock).
+        self.goodput: Optional[Any] = None
         self._lock = threading.Lock()
         # Requests currently inside forward(), keyed by a monotonic
         # ticket so concurrent requests sharing a trace id stay
@@ -224,11 +231,15 @@ class Router:
             self._inflight_seq += 1
             ticket = self._inflight_seq
             self._inflight[ticket] = trace_id
+        if self.goodput is not None:
+            self.goodput.enter("forward")
         try:
             return self._forward(payload, trace_id)
         finally:
             with self._lock:
                 self._inflight.pop(ticket, None)
+            if self.goodput is not None:
+                self.goodput.exit_idle()
 
     def _forward(self, payload: Dict[str, Any], trace_id: str,
                  ) -> Tuple[int, Dict[str, Any]]:

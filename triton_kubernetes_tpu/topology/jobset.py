@@ -48,8 +48,17 @@ def render_jobset(
     namespace: str = "default",
     env: Optional[Dict[str, str]] = None,
     completions: Optional[int] = None,
+    trace_dir: Optional[str] = None,
 ) -> Dict[str, Any]:
-    """An indexed-Job manifest: one pod per TPU host of the slice."""
+    """An indexed-Job manifest: one pod per TPU host of the slice.
+
+    ``trace_dir`` (e.g. ``/var/log/tk8s``) turns on the trainer's
+    flight recorder: the command gains ``--trace-jsonl`` pointing into
+    a hostPath volume mounted there, so every rank's ``train.*`` spans
+    and goodput ledger survive the pod — a preempted or crashed
+    worker's trace is exactly the one worth collecting for
+    ``tk8s trace merge`` / ``tk8s goodput report``.
+    """
     n = completions if completions is not None else spec.num_hosts
     hostnames = ",".join(
         f"{name}-{i}.{name}.{namespace}.svc" for i in range(n))
@@ -62,6 +71,11 @@ def render_jobset(
         "NUM_TPU_WORKERS": str(n),
     }
     base_env.update(env or {})
+    command = list(command)
+    if trace_dir is not None:
+        # One file per rank: the trainer suffixes .rank{N} itself from
+        # jax.process_index(), so every pod can share the same path.
+        command += ["--trace-jsonl", f"{trace_dir}/trace.jsonl"]
     container = {
         "name": "worker",
         "image": image,
@@ -78,6 +92,16 @@ def render_jobset(
         "ports": [{"containerPort": COORDINATOR_PORT}],
         "resources": {"limits": {"google.com/tpu": str(spec.chips_per_host)}},
     }
+    pod_extra: Dict[str, Any] = {}
+    if trace_dir is not None:
+        # hostPath, not emptyDir: an emptyDir dies with the pod, and the
+        # pod that died is the one whose ledger the postmortem needs.
+        container["volumeMounts"] = [
+            {"name": "tk8s-trace", "mountPath": trace_dir}]
+        pod_extra["volumes"] = [
+            {"name": "tk8s-trace",
+             "hostPath": {"path": trace_dir,
+                          "type": "DirectoryOrCreate"}}]
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
@@ -120,6 +144,7 @@ def render_jobset(
                     "restartPolicy": "Never",
                     "nodeSelector": selector_for_slice(spec, slice_id),
                     "containers": [container],
+                    **pod_extra,
                 },
             },
         },

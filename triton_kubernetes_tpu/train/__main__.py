@@ -218,6 +218,15 @@ def _build_parser() -> argparse.ArgumentParser:
                         "count, preemption outcome) to this path; "
                         "process 0 writes, other ranks skip — the "
                         "scale-out harness and CI evidence read it")
+    p.add_argument("--trace-jsonl", default="",
+                   help="append train.* span events and the goodput "
+                        "ledger (chip-time categories partitioning the "
+                        "run's wall window) as JSON lines to this path; "
+                        "under multi-process runs every rank derives its "
+                        "own file (PATH gains .rankN before the "
+                        "extension) so one flag serves the whole "
+                        "launch_trainers fleet. Merge onto the fleet "
+                        "timeline with `tk8s trace merge`")
     p.add_argument("--distributed", choices=["auto", "on", "off"],
                    default="auto")
     p.add_argument("--dcn-sync", choices=["auto", "fused", "xla"],
@@ -445,6 +454,28 @@ def main(argv=None) -> int:
             processes=n_processes, batch=batch_size,
             seq_len=seq_len, steps=args.steps)
 
+    # Training flight recorder: every rank writes its own clock-anchored
+    # trace file (launch_trainers passes identical args to all ranks, so
+    # the per-rank name is derived HERE from the process index) and
+    # attributes its wall time into the closed train goodput vocabulary.
+    # flush_each: train segments are window-scale, and a rank killed
+    # mid-run (chaos arms) must leave its booked ledger on disk.
+    tracer = None
+    goodput = None
+    if args.trace_jsonl:
+        from ..utils.trace import GoodputRecorder, TraceWriter
+
+        rank = jax.process_index()
+        trace_path = args.trace_jsonl
+        if n_processes > 1:
+            root, ext = os.path.splitext(trace_path)
+            trace_path = f"{root}.rank{rank}{ext or '.jsonl'}"
+        tracer = TraceWriter(trace_path, f"trainer:rank{rank}",
+                             clock=time.perf_counter)
+        goodput = GoodputRecorder("train", clock=time.perf_counter,
+                                  writer=tracer, flush_each=True)
+        log.log("info", "trace jsonl", path=trace_path)
+
     if batch_size % batch_shards:
         log.log("error", "global batch must divide the data*fsdp axes",
                 batch=batch_size, shards=batch_shards)
@@ -555,6 +586,11 @@ def main(argv=None) -> int:
         # loud CheckpointIntegrityError, not a silent retrain.
         from .checkpoint import restore_newest_verified
 
+        # A resume restore is recovery work re-establishing state a
+        # fault interrupted — the ledger books it rollback_replay, so
+        # the kill->resume storyline never shows recovery as `step`.
+        if goodput is not None:
+            goodput.transition("rollback_replay")
         try:
             state, best, best_step = restore_newest_verified(
                 state, ckpt, em_ckpt)
@@ -565,9 +601,14 @@ def main(argv=None) -> int:
             # lives in the scheduled dir, the guard's baseline check can
             # skip re-hashing it.
             start_is_checkpointed = best is ckpt
+            if tracer is not None:
+                tracer.event("train.restore", goodput.clock(),
+                             step=int(state.step), rollback=False)
             log.log("info", "resumed", step=int(state.step),
                     source=best.directory,
                     emergency=best is em_ckpt)
+        if goodput is not None:
+            goodput.transition("idle")
 
     fpt = flops_per_token(config, seq_len)
     from ..topology.slices import peak_bf16_tflops_for_kind
@@ -658,8 +699,19 @@ def main(argv=None) -> int:
             max_steps = 0
             target_step = start_step
         else:
+            if goodput is not None:
+                goodput.transition("compile")
             step_fn, timings = aot_compile_step(
                 step_fn, state, first, config_name=config.name)
+            if goodput is not None:
+                t1 = goodput.clock()
+                if tracer is not None:
+                    tracer.event(
+                        "train.compile", goodput.state_since,
+                        t1 - goodput.state_since,
+                        lower_s=round(timings.lower_seconds, 6),
+                        compile_s=round(timings.compile_seconds, 6))
+                goodput.transition("idle", t1)
             from .trainer import memory_stats
 
             mem = memory_stats(step_fn)
@@ -777,6 +829,8 @@ def main(argv=None) -> int:
             data["steady_steps_per_sec"] = round(s_steps / s_secs, 4)
             data["steady_tokens_per_sec"] = round(
                 s_steps * tokens_per_step / s_secs, 1)
+        if goodput is not None:
+            data["goodput"] = goodput.snapshot()
         parent = os.path.dirname(os.path.abspath(args.report_json))
         os.makedirs(parent, exist_ok=True)
         tmp = args.report_json + ".tmp"
@@ -810,7 +864,7 @@ def main(argv=None) -> int:
                     tokens_per_step=local_tokens_per_step,
                     config_name=config.name,
                     on_sync=on_sync, on_checkpoint=on_checkpoint,
-                    step_floor_seconds=step_floor)
+                    step_floor_seconds=step_floor, goodput=goodput)
             except AnomalyAbortedError as e:
                 aborted = e
                 log.log("error", "anomaly guard aborted the run",
@@ -844,6 +898,14 @@ def main(argv=None) -> int:
             log.log("info", "profiler trace written", dir=args.profile_dir)
         if preempt is not None:
             preempt.uninstall()
+        if goodput is not None:
+            # Close the ledger in the finally for the same reason as the
+            # profiler trace: the chip-second attribution matters MOST on
+            # the runs that die, and close() is what makes the categories
+            # tile the recorded window exactly (partition oracle).
+            goodput.close()
+        if tracer is not None:
+            tracer.close()
 
     final_loss = round(last_loss, 4) if last_loss is not None else "n/a"
     if aborted is not None:

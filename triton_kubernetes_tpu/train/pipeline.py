@@ -247,6 +247,8 @@ def run_pipelined(
     prefetch: Any = None,
     clock: Callable[[], float] = time.perf_counter,
     step_floor_seconds: float = 0.0,
+    goodput: Any = None,
+    goodput_step_category: Optional[Callable[[int], str]] = None,
 ) -> Tuple[Any, LoopReport]:
     """Bounded-async training loop: dispatch every step, sync every K.
 
@@ -279,6 +281,18 @@ def run_pipelined(
     ``max(floor, compute)``; losses are untouched and every real
     overhead (staging, collectives, host syncs) still lands on top.
     0 (the default) disables it.
+
+    ``goodput`` is an optional
+    :class:`..utils.trace.GoodputRecorder` (``train`` vocabulary,
+    sharing this loop's ``clock``): the loop attributes its own wall
+    time — ``data_wait`` while pulling the next batch, ``step`` across
+    the floor sleep and dispatch, ``host_sync`` across the window
+    drain, ``preempted_lost`` from the moment ``should_stop`` trips —
+    with segments closing exactly when the next opens, so the ledger
+    partitions the loop's wall window. ``goodput_step_category(n)``
+    (``n`` = 1-based step index within this call) lets a resilient
+    caller book replayed steps as ``rollback_replay`` instead of
+    ``step``.
 
     Returns ``(final_state, LoopReport)``; ``report.losses`` is bitwise
     identical to what a per-step-synced loop over the same step_fn and
@@ -313,6 +327,11 @@ def run_pipelined(
         nonlocal t_window
         if not window:
             return
+        if goodput is not None and not report.interrupted:
+            # An interrupted partial window drains under the category
+            # should_stop opened (preempted_lost): that drain is
+            # recovery work, not a routine host sync.
+            goodput.transition("host_sync")
         inflight_gauge.set(len(window))
         # THE host sync: one transfer of the window's metric scalars
         # (losses + the newest step's full metrics dict, combined so the
@@ -339,16 +358,34 @@ def run_pipelined(
             report.prefetch_wait_seconds = float(wait)
             wait_gauge.set(float(wait))
         inflight_gauge.set(0)
+        n_window = len(window)
         window.clear()
+        if goodput is not None and goodput.writer is not None:
+            goodput.writer.event("train.window", t_window, dt,
+                                 steps=n_window,
+                                 loss=window_losses[-1])
         if on_sync is not None:
             on_sync(report.steps, state, window_losses, dt)
         t_window = clock()
 
     t_dispatch = clock()
-    for batch in batches_it:
+    it = iter(batches_it)
+    _end = object()
+    while True:
+        if goodput is not None:
+            goodput.transition("data_wait")
+        batch = next(it, _end)
+        if batch is _end:
+            break
         if should_stop is not None and should_stop():
+            if goodput is not None:
+                goodput.transition("preempted_lost")
             report.interrupted = True
             break
+        if goodput is not None:
+            goodput.transition(
+                goodput_step_category(report.steps + 1)
+                if goodput_step_category is not None else "step")
         if step_floor_seconds > 0.0:
             # Device-time model: pace dispatch to the floor. Sleeping
             # (not spinning) frees the core for the async steps already
@@ -364,6 +401,8 @@ def run_pipelined(
                 force_sync is not None and force_sync(report.steps)):
             sync()
     sync()
+    if goodput is not None and not report.interrupted:
+        goodput.transition("idle")
     report.wall_seconds = max(clock() - t_start, 1e-9)
     report.steps_per_sec = report.steps / report.wall_seconds
     report.tokens_per_sec = (

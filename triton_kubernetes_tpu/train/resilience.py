@@ -209,6 +209,7 @@ def run_resilient(
     on_sync: Optional[Callable[[int, Any, List[float], float], None]] = None,
     on_checkpoint: Optional[Callable[[int, str], None]] = None,
     step_floor_seconds: float = 0.0,
+    goodput: Any = None,
 ) -> Tuple[Any, ResilienceReport]:
     """Drive ``run_pipelined`` to ``target_step`` under the guards.
 
@@ -225,6 +226,17 @@ def run_resilient(
     the very first window is already protected. ``on_sync(gstep, state,
     window_losses, dt)`` fires per clean window with *absolute* steps;
     ``on_checkpoint(gstep, kind)`` after each save.
+
+    ``goodput`` is an optional
+    :class:`..utils.trace.GoodputRecorder` (``train`` vocabulary): the
+    inner loop books ``step``/``data_wait``/``host_sync``; this driver
+    adds the recovery categories — ``checkpoint`` across every save
+    (scheduled, baseline, emergency), ``rollback_replay`` from an
+    anomaly trip through the restore AND across the replayed steps up
+    to the tripped window (so redone work never masquerades as fresh
+    ``step`` time), ``preempted_lost`` from the preemption flag to
+    exit. Segments close exactly when the next opens: the ledger
+    partitions wall time whatever the fault schedule does.
     """
     from ..utils import metrics as _metrics
 
@@ -252,7 +264,11 @@ def run_resilient(
         # step/loss alignment. ``start_is_checkpointed`` (the caller just
         # restored this exact step from ``ckpt``) skips the re-hash.
         if ckpt.latest_verified_step() != start_step:
+            if goodput is not None:
+                goodput.transition("checkpoint")
             ckpt.save(start_step, state, wait=True)
+            if goodput is not None:
+                goodput.transition("idle")
             if on_checkpoint is not None:
                 on_checkpoint(start_step, "scheduled")
 
@@ -292,8 +308,21 @@ def run_resilient(
                 mark = gstep // checkpoint_every
                 if mark > last_mark:
                     last_mark = mark
+                    prev = None
+                    if goodput is not None:
+                        t0 = goodput.clock()
+                        prev = goodput.state
+                        goodput.transition("checkpoint", t0)
                     ckpt.save(gstep, cur_state)
                     data_at[gstep] = seg_data + (gstep - seg_base)
+                    if goodput is not None:
+                        t1 = goodput.clock()
+                        if goodput.writer is not None:
+                            goodput.writer.event(
+                                "train.checkpoint", t0, t1 - t0,
+                                step=gstep, kind="scheduled")
+                        if prev is not None:
+                            goodput.transition(prev, t1)
                     if on_checkpoint is not None:
                         on_checkpoint(gstep, "scheduled")
             if on_sync is not None:
@@ -305,6 +334,14 @@ def run_resilient(
                 lambda n, base=seg_base: (base + n) % checkpoint_every == 0)
         should_stop = (
             (lambda: preemption.requested) if preemption is not None else None)
+        # After a rollback, steps at or below the tripped window are a
+        # re-execution of work a fault already paid for: the ledger
+        # books them rollback_replay, never step.
+        step_category = None
+        if goodput is not None and trip_high > seg_base:
+            step_category = (
+                lambda n, base=seg_base, high=trip_high:
+                "rollback_replay" if base + n <= high else "step")
         try:
             state, seg = run_pipelined(
                 step_fn, state, batches,
@@ -312,7 +349,8 @@ def run_resilient(
                 tokens_per_step=tokens_per_step, config_name=config_name,
                 on_sync=_on_sync, force_sync=force_sync,
                 should_stop=should_stop, prefetch=prefetch,
-                step_floor_seconds=step_floor_seconds)
+                step_floor_seconds=step_floor_seconds,
+                goodput=goodput, goodput_step_category=step_category)
         except _AnomalyTrip:
             anomaly: Anomaly = trip["anomaly"]
             report.anomalies.append(anomaly)
@@ -341,8 +379,18 @@ def run_resilient(
             # below start_step; restore still falls back further if the
             # anchor itself fails verification.
             target = max(s for s in data_at if s <= trip["window_end"])
+            if goodput is not None:
+                t0 = goodput.clock()
+                goodput.transition("rollback_replay", t0)
+                if goodput.writer is not None:
+                    goodput.writer.event(
+                        "train.rollback", t0,
+                        window_end=trip["window_end"], target=target)
             state = ckpt.restore(template, step=target)
             good = ckpt.last_restored_step
+            if goodput is not None and goodput.writer is not None:
+                goodput.writer.event("train.restore", goodput.clock(),
+                                     step=good, rollback=True)
             report.restored_steps.append(good)
             del report.losses[max(good - start_step, 0):]
             guard.reset_history(report.losses)  # replays must not re-enter
@@ -369,13 +417,29 @@ def run_resilient(
 
     report.steps = done - start_step
     if report.interrupted:
+        if goodput is not None:
+            # No-op when the inner loop already opened it; covers the
+            # flag tripping between segments (loop top break).
+            goodput.transition("preempted_lost")
+            if goodput.writer is not None:
+                goodput.writer.event("train.preempt", goodput.clock(),
+                                     step=done)
         # Nothing new trained (warning landed before the first step, or
         # right after a resume) => the state at ``done`` is already
         # durable (or a deterministic re-init): saving again would only
         # quarantine-and-rewrite a good on-disk step inside the kill
         # window. Skip; exit-for-resume is still correct.
         if emergency_ckpt is not None and done > start_step:
+            t0 = goodput.clock() if goodput is not None else 0.0
+            if goodput is not None:
+                goodput.transition("checkpoint", t0)
             emergency_ckpt.save(done, state, kind="emergency")
+            if goodput is not None:
+                t1 = goodput.clock()
+                if goodput.writer is not None:
+                    goodput.writer.event("train.checkpoint", t0, t1 - t0,
+                                         step=done, kind="emergency")
+                goodput.transition("preempted_lost", t1)
             report.emergency_step = done
             if on_checkpoint is not None:
                 on_checkpoint(done, "emergency")

@@ -282,6 +282,20 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "gauge", "TPU slice node pools currently desired for the "
         "autoscaled cluster (the autoscaler's scaling unit)",
         ("cluster",), None),
+    # ----------------------------------------- goodput ledger (fleet-wide)
+    "tk8s_goodput_seconds_total": (
+        "counter", "Chip-seconds attributed by the goodput ledger, by "
+        "source (serve/train/route) and category — ticked from the same "
+        "closed segments that land as <source>.goodput trace spans, so "
+        "the categories partition each process's recorded wall window "
+        "exactly (GOODPUT_CATEGORIES in utils/trace.py is the closed "
+        "vocabulary; lint rule TK8S113 pins it)",
+        ("source", "category", "process_id"), None),
+    "tk8s_operator_fleet_goodput": (
+        "gauge", "Fleet useful-chip-time fraction over the most recent "
+        "reconcile window (useful categories / all accounted "
+        "chip-seconds across scraped sources) — the signal the "
+        "goodput-aware arbitration policy reads", (), None),
 }
 
 _VALID_KINDS = ("counter", "gauge", "histogram")

@@ -103,6 +103,26 @@ SPAN_CATALOG: Dict[str, str] = {
                      "field)",
     "operator.scale": "autoscaler actuation (direction/reason/pools "
                       "fields)",
+    "serve.goodput": "one process-level chip-time segment (category "
+                     "field) — segments tile the engine's recorded "
+                     "window exactly",
+    "route.goodput": "one process-level chip-time segment (category "
+                     "field) — segments tile the router's recorded "
+                     "window exactly",
+    "train.goodput": "one process-level chip-time segment (category "
+                     "field) — segments tile the trainer's recorded "
+                     "window exactly",
+    "train.window": "one sync window drained to host (steps/loss "
+                    "fields)",
+    "train.compile": "AOT lower+compile of the step function "
+                     "(lower_s/compile_s fields)",
+    "train.checkpoint": "one checkpoint save (step/kind fields)",
+    "train.restore": "checkpoint restore (step field; rollback=True "
+                     "after an anomaly trip)",
+    "train.rollback": "anomaly rollback decision (window_end/target "
+                      "fields)",
+    "train.preempt": "preemption honored — partial window synced, "
+                     "emergency save next",
 }
 
 #: Scheduling states a request moves through; phase keys are what the
@@ -117,6 +137,41 @@ _EVENT_STATE = {
     "serve.preempt": "queue",
     "serve.first_token": "decode",
     "serve.resume": "decode",
+}
+
+#: The goodput counter family every accelerator-owning process ticks —
+#: same segments that land as `<source>.goodput` spans (one
+#: measurement, two sinks).
+GOODPUT_FAMILY = "tk8s_goodput_seconds_total"
+
+#: The closed goodput category vocabulary, per source. Every
+#: process-level chip-time segment a :class:`GoodputRecorder` books
+#: carries exactly one of its source's categories; lint rule TK8S113
+#: keeps the emitting sites, the metrics CATALOG entry, and the
+#: category table in docs/guide/observability.md agreeing (the TK8S111
+#: pattern applied to the goodput ledger).
+GOODPUT_CATEGORIES: Dict[str, Tuple[str, ...]] = {
+    "serve": ("prefill", "decode", "verify", "recompute", "idle"),
+    "train": ("step", "compile", "data_wait", "host_sync", "checkpoint",
+              "rollback_replay", "preempted_lost", "idle"),
+    "route": ("forward", "idle"),
+}
+
+#: Categories that count as *useful* chip time in the fleet rollup (the
+#: operator's goodput signal). Everything not useful and not waste is
+#: overhead/idle — accounted, but neither numerator.
+GOODPUT_USEFUL: Dict[str, Tuple[str, ...]] = {
+    "serve": ("prefill", "decode", "verify"),
+    "train": ("step",),
+    "route": ("forward",),
+}
+
+#: Categories that count as *waste*: chip time spent redoing or losing
+#: work a fault already paid for once.
+GOODPUT_WASTE: Dict[str, Tuple[str, ...]] = {
+    "serve": ("recompute",),
+    "train": ("rollback_replay", "preempted_lost"),
+    "route": (),
 }
 
 
@@ -502,6 +557,145 @@ class FlightRecorder:
 
 
 # ---------------------------------------------------------------------------
+# Goodput recorder: process-level chip-second attribution
+# ---------------------------------------------------------------------------
+
+class GoodputRecorder:
+    """Attributes ONE process's wall time into its source's closed
+    goodput vocabulary (:data:`GOODPUT_CATEGORIES`), with the flight
+    recorder's construction guarantee: :meth:`transition` closes the
+    open segment at exactly the timestamp the next one opens, so the
+    per-category seconds *partition* ``[started_at, closed_at]`` on the
+    process's injectable clock — no gap, no overlap, sum == wall.
+
+    Each closed segment lands in two sinks from the one measurement:
+    a ``<source>.goodput`` span on the attached :class:`TraceWriter`
+    (when present) and the :data:`GOODPUT_FAMILY` counter family —
+    the journal/trace agreement rule, applied to chip-seconds.
+
+    The recorder opens in ``idle``. Re-transitioning into the current
+    category is a no-op (no zero-length segment churn). ``enter``/
+    ``exit_idle`` wrap the nesting pattern threaded servers need: the
+    first concurrent enter opens the category, the last exit returns to
+    idle — segments still partition by construction because only the
+    depth edges transition.
+    """
+
+    def __init__(self, source: str, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 writer: Optional[TraceWriter] = None,
+                 flush_each: bool = False,
+                 metrics_enabled: bool = True,
+                 start_at: Optional[float] = None):
+        if source not in GOODPUT_CATEGORIES:
+            raise ValueError(
+                f"unknown goodput source {source!r} "
+                f"(valid: {sorted(GOODPUT_CATEGORIES)})")
+        self.source = source
+        self.categories = GOODPUT_CATEGORIES[source]
+        self.clock = clock
+        self.writer = writer
+        self.flush_each = flush_each
+        self.metrics_enabled = metrics_enabled
+        self.seconds: Dict[str, float] = {c: 0.0 for c in self.categories}
+        self.segments = 0
+        self._span = source + ".goodput"
+        self._lock = threading.Lock()
+        self._depth = 0
+        self.started_at = (start_at if start_at is not None else clock())
+        self.state: Optional[str] = "idle"
+        self.state_since = self.started_at
+        self.closed_at: Optional[float] = None
+
+    # ----------------------------------------------------------- record
+    def _book(self, t1: float) -> None:
+        """Close the open segment at ``t1`` (caller holds the lock)."""
+        state, t0 = self.state, self.state_since
+        if state is None or t1 <= t0:
+            return
+        self.seconds[state] += t1 - t0
+        self.segments += 1
+        if self.writer is not None:
+            self.writer.event(self._span, t0, t1 - t0, category=state)
+            if self.flush_each:
+                self.writer.flush()
+        if self.metrics_enabled:
+            from . import metrics as _metrics
+            _metrics.counter(GOODPUT_FAMILY).inc(
+                t1 - t0, source=self.source, category=state)
+
+    def transition(self, category: str, at: Optional[float] = None) -> None:
+        """Open ``category`` at ``at`` (default: now on the injectable
+        clock), closing the current segment at the same instant."""
+        if category not in self.seconds:
+            raise ValueError(
+                f"category {category!r} not in the {self.source!r} "
+                f"goodput vocabulary {list(self.categories)}")
+        with self._lock:
+            if self.state is None:
+                return  # closed: a late transition cannot reopen
+            if category == self.state:
+                return
+            t = self.clock() if at is None else at
+            self._book(t)
+            self.state, self.state_since = category, max(t, self.state_since)
+
+    def enter(self, category: str, at: Optional[float] = None) -> None:
+        """Depth-counted :meth:`transition` for concurrent call sites:
+        only the 0→1 edge opens ``category``."""
+        with self._lock:
+            self._depth += 1
+            first = self._depth == 1
+        if first:
+            self.transition(category, at)
+
+    def exit_idle(self, at: Optional[float] = None) -> None:
+        """The matching 1→0 edge returns the process to ``idle``."""
+        with self._lock:
+            self._depth = max(0, self._depth - 1)
+            last = self._depth == 0
+        if last:
+            self.transition("idle", at)
+
+    def close(self, at: Optional[float] = None) -> None:
+        """Book the final segment and freeze the ledger; the recorded
+        window is ``[started_at, closed_at]``."""
+        with self._lock:
+            if self.state is None:
+                return
+            t = self.clock() if at is None else at
+            t = max(t, self.state_since)
+            self._book(t)
+            self.state = None
+            self.closed_at = t
+        if self.writer is not None:
+            self.writer.flush()
+
+    # ------------------------------------------------------------- read
+    def wall_seconds(self, at: Optional[float] = None) -> float:
+        """The recorded window so far (closed: exactly the span the
+        booked categories partition)."""
+        if self.closed_at is not None:
+            return self.closed_at - self.started_at
+        return (self.clock() if at is None else at) - self.started_at
+
+    def accounted_seconds(self) -> float:
+        return sum(self.seconds.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "source": self.source,
+                "seconds": {c: round(v, 9)
+                            for c, v in self.seconds.items() if v > 0.0},
+                "segments": self.segments,
+                "wall_s": round(self.wall_seconds(
+                    at=self.state_since if self.closed_at is None
+                    else None), 9),
+            }
+
+
+# ---------------------------------------------------------------------------
 # Fleet merge: N per-process JSONL files -> ONE Perfetto timeline
 # ---------------------------------------------------------------------------
 
@@ -653,6 +847,158 @@ def validate_chrome_trace(doc: Any) -> List[str]:
 _CHAOS_EPS = 1e-6
 
 
+def validate_goodput_events(label: str,
+                            events: Sequence[Dict[str, Any]]) -> List[str]:
+    """The goodput partition oracle over ONE process's parsed events:
+    its ``<source>.goodput`` segments must carry only that source's
+    vocabulary and tile the recorded window contiguously — a gap means
+    chip time escaped attribution, an overlap means it was booked
+    twice. Either way the categories no longer partition wall time and
+    the ledger is lying. Returns problems, [] when valid."""
+    problems: List[str] = []
+    by_source: Dict[str, List[Tuple[str, float, float]]] = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if not name.endswith(".goodput"):
+            continue
+        source = name[: -len(".goodput")]
+        f = ev.get("fields") or {}
+        t0 = float(ev["at"])
+        by_source.setdefault(source, []).append(
+            (str(f.get("category")), t0, t0 + float(ev.get("dur_s", 0.0))))
+    for source, segs in sorted(by_source.items()):
+        vocab = GOODPUT_CATEGORIES.get(source)
+        if vocab is None:
+            problems.append(f"{label}: goodput segments for unknown "
+                            f"source {source!r}")
+            continue
+        bad = sorted({c for c, _, _ in segs if c not in vocab})
+        if bad:
+            problems.append(f"{label}: {source} goodput categories {bad} "
+                            f"not in the closed vocabulary {list(vocab)}")
+            continue
+        segs.sort(key=lambda s: s[1])
+        cursor = segs[0][1]
+        ok = True
+        for cat, t0, t1 in segs:
+            if t0 - cursor > _CHAOS_EPS:
+                problems.append(
+                    f"{label}: {source} goodput gap — {cat} opens at "
+                    f"{t0:.9f} but the previous segment closed at "
+                    f"{cursor:.9f} ({t0 - cursor:.9f}s unattributed)")
+                ok = False
+                break
+            if cursor - t0 > _CHAOS_EPS:
+                problems.append(
+                    f"{label}: {source} goodput overlap — {cat} opens at "
+                    f"{t0:.9f} before the previous segment closed at "
+                    f"{cursor:.9f} (chip time booked twice)")
+                ok = False
+                break
+            cursor = max(cursor, t1)
+        if not ok:
+            continue
+        window = segs[-1][2] - segs[0][1]
+        total = sum(t1 - t0 for _, t0, t1 in segs)
+        if abs(total - window) > _CHAOS_EPS:
+            problems.append(
+                f"{label}: {source} goodput sum {total:.9f}s != recorded "
+                f"window {window:.9f}s — categories do not partition "
+                f"wall time")
+    return problems
+
+
+def validate_goodput_trace(paths: Sequence[str]) -> List[str]:
+    """Run the goodput partition oracle over per-process trace files
+    (the standalone entry CI evidence and the chaos arms use)."""
+    problems: List[str] = []
+    for path in paths:
+        try:
+            meta, events = read_trace_jsonl(path)
+        except TraceMergeError as e:
+            problems.append(str(e))
+            continue
+        label = f"{os.path.basename(path)}[{meta.get('role', '?')}]"
+        problems.extend(validate_goodput_events(label, events))
+    return problems
+
+
+def summarize_goodput(paths: Sequence[str]) -> Dict[str, Any]:
+    """Fold per-process trace files into the goodput report shape:
+    one ledger per process (role, source, per-category seconds, wall
+    window, useful/waste split) plus a fleet rollup with the waste
+    decomposed by category — the ``tk8s goodput report`` payload."""
+    processes: List[Dict[str, Any]] = []
+    fleet_seconds: Dict[str, Dict[str, float]] = {}
+    for path in paths:
+        meta, events = read_trace_jsonl(path)
+        role = str(meta.get("role", "?"))
+        per: Dict[str, Dict[str, float]] = {}
+        window: Dict[str, List[float]] = {}
+        for ev in events:
+            name = ev.get("name", "")
+            if not name.endswith(".goodput"):
+                continue
+            source = name[: -len(".goodput")]
+            f = ev.get("fields") or {}
+            cat = str(f.get("category"))
+            t0 = float(ev["at"])
+            dur = float(ev.get("dur_s", 0.0))
+            per.setdefault(source, {})
+            per[source][cat] = per[source].get(cat, 0.0) + dur
+            lo_hi = window.setdefault(source, [t0, t0 + dur])
+            lo_hi[0] = min(lo_hi[0], t0)
+            lo_hi[1] = max(lo_hi[1], t0 + dur)
+        for source, seconds in sorted(per.items()):
+            useful = sum(seconds.get(c, 0.0)
+                         for c in GOODPUT_USEFUL.get(source, ()))
+            waste = sum(seconds.get(c, 0.0)
+                        for c in GOODPUT_WASTE.get(source, ()))
+            total = sum(seconds.values())
+            lo, hi = window[source]
+            processes.append({
+                "path": os.path.basename(path),
+                "role": role,
+                "source": source,
+                "wall_s": round(hi - lo, 9),
+                "accounted_s": round(total, 9),
+                "seconds": {c: round(v, 9)
+                            for c, v in sorted(seconds.items())},
+                "useful_s": round(useful, 9),
+                "waste_s": round(waste, 9),
+                "useful_fraction": round(useful / total, 6) if total else 0.0,
+                "waste_fraction": round(waste / total, 6) if total else 0.0,
+            })
+            agg = fleet_seconds.setdefault(source, {})
+            for c, v in seconds.items():
+                agg[c] = agg.get(c, 0.0) + v
+    total = sum(v for agg in fleet_seconds.values() for v in agg.values())
+    useful = sum(agg.get(c, 0.0)
+                 for source, agg in fleet_seconds.items()
+                 for c in GOODPUT_USEFUL.get(source, ()))
+    waste_by_cat: Dict[str, float] = {}
+    for source, agg in fleet_seconds.items():
+        for c in GOODPUT_WASTE.get(source, ()):
+            if agg.get(c, 0.0) > 0.0:
+                waste_by_cat[c] = waste_by_cat.get(c, 0.0) + agg[c]
+    waste = sum(waste_by_cat.values())
+    return {
+        "processes": processes,
+        "fleet": {
+            "accounted_s": round(total, 9),
+            "useful_s": round(useful, 9),
+            "waste_s": round(waste, 9),
+            "useful_fraction": round(useful / total, 6) if total else 0.0,
+            "waste_fraction": round(waste / total, 6) if total else 0.0,
+            "waste_by_category": {c: round(v, 9)
+                                  for c, v in sorted(waste_by_cat.items())},
+            "seconds": {s: {c: round(v, 9)
+                            for c, v in sorted(agg.items())}
+                        for s, agg in sorted(fleet_seconds.items())},
+        },
+    }
+
+
 def validate_chaos_trace(paths: Sequence[str]) -> List[str]:
     """The chaos harness's *generic* trace-validity oracle: one check
     that any faulted arm's per-process trace files describe complete,
@@ -671,7 +1017,11 @@ def validate_chaos_trace(paths: Sequence[str]) -> List[str]:
     * *exclusive prefill*: the engine runs one prefill window per
       tick, so no two requests' prefill/recompute spans may overlap
       within one file — overlap means a wait between windows was
-      booked as prefill instead of queue.
+      booked as prefill instead of queue;
+    * any ``<source>.goodput`` segments pass the partition oracle
+      (:func:`validate_goodput_events`): closed vocabulary, contiguous
+      tiling, sum == recorded window — so a faulted trainer's ledger
+      is held to the same exactness as a serving replica's phases.
 
     Across files:
 
@@ -692,6 +1042,7 @@ def validate_chaos_trace(paths: Sequence[str]) -> List[str]:
             readable = False
             continue
         label = f"{os.path.basename(path)}[{meta.get('role', '?')}]"
+        problems.extend(validate_goodput_events(label, events))
         reqs: Dict[str, Dict[str, Any]] = {}
         for ev in events:
             name = ev["name"]
