@@ -1,0 +1,335 @@
+#!/usr/bin/env python
+"""Produce the disaggregation evidence artifact
+(docs/ci-evidence/disagg-<tag>.json): the ISSUE 18 acceptance gates,
+measured.
+
+**A. Split A/B.** The same long-prompt-heavy request trace runs through
+two equal-chip fleets on the deterministic ManualClock: *colocated*
+(two full engines, requests round-robined) vs *disaggregated* (one
+prefill engine handing off through export/import to one decode
+engine). Gates: the disaggregated arm's TTFT p99 beats colocated (long
+prefills no longer queue behind resident decodes for a slot), decode
+TPOT p99 stays flat (flight-recorder ``decode_s`` per token, so queue
+time never pollutes the comparison), and every request's token stream
+is bitwise identical across the arms.
+
+**B. Parity cross.** kv_dtype {auto, int8, fp8} x spec_k {0, 3}: each
+cell's handoff-migrated stream must equal its never-migrated solo twin
+bit for bit — quantized pages ship as raw bytes with their anchored
+scales, so no cell may dequantize/requantize anywhere on the path.
+fp8 cells skip LOUDLY (typed reason in the journal) on jax builds
+without float8_e4m3fn.
+
+**C. Drain A/B through ``tk8s goodput report``.** The same mid-decode
+fleet state drains twice — via live migration (export -> import ->
+finish the tail) and via recompute re-land (kill the source, resubmit
+from scratch) — each arm's engines wearing GoodputRecorders. Both
+drains must produce bitwise-identical streams, and the migration arm
+must book fewer busy chip-seconds in the report the real ``tk8s
+goodput report --json`` CLI renders from the trace files.
+
+Usage: JAX_PLATFORMS=cpu python scripts/ci/disagg_evidence.py [tag]
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from triton_kubernetes_tpu.models import get_config, init_params  # noqa: E402
+from triton_kubernetes_tpu.ops.quantization import fp8_supported  # noqa: E402
+from triton_kubernetes_tpu.serve import (  # noqa: E402
+    ManualClock, Request, ServeEngine)
+from triton_kubernetes_tpu.utils import metrics  # noqa: E402
+from triton_kubernetes_tpu.utils.trace import (  # noqa: E402
+    FlightRecorder, GoodputRecorder, TraceWriter)
+
+# Equal chips per arm: every engine is one replica's worth.
+ENGINE_KW = dict(block_size=4, num_blocks=256, max_batch=4,
+                 max_model_len=128, prefill_chunk=8)
+GATE_TPOT_SLACK = 1.15   # decode TPOT p99 "flat": within 15% of colocated
+MAX_NEW = 8              # parity-phase decode tail
+
+# Long-prompt-heavy with real decode tails: more requests than slots,
+# so in the colocated arm a second admission wave queues behind slots
+# held through entire decodes — the head-of-line blocking
+# disaggregation removes (a prefill-pool slot frees at the handoff,
+# after ceil(plen/chunk) ticks instead of ceil(plen/chunk) + max_new).
+SPLIT_MAX_NEW = 80
+SPLIT_PROMPT_LENS = (24, 16, 24, 24, 16, 24,
+                     24, 16, 24, 24, 16, 24)
+
+
+def make_engine(model, **over):
+    cfg, params = model
+    kw = dict(ENGINE_KW, clock=ManualClock(tick=0.001))
+    kw.update(over)
+    return ServeEngine(params, cfg, **kw)
+
+
+def trace_requests():
+    reqs = []
+    for i, plen in enumerate(SPLIT_PROMPT_LENS):
+        reqs.append(Request(f"q{i}", [(7 * j + i) % 29 for j in range(plen)],
+                            SPLIT_MAX_NEW, seed=100 + i))
+    return reqs
+
+
+def p99(xs):
+    s = sorted(xs)
+    return s[min(len(s) - 1, max(0, int(0.99 * len(s))))]
+
+
+def tpot(fin):
+    """Decode seconds per generated decode token, from the flight
+    recorder's exact phase attribution (queue time excluded)."""
+    return fin.phases["decode_s"] / max(1, len(fin.tokens) - 1)
+
+
+def phase_split(model):
+    """Phase A: colocated vs disaggregated on the same trace."""
+    # Colocated: two full engines, round-robin.
+    engines = [make_engine(model, flight=FlightRecorder())
+               for _ in range(2)]
+    for i, req in enumerate(trace_requests()):
+        engines[i % 2].submit(req)
+    colo = {}
+    for eng in engines:
+        for fin in eng.run_until_idle():
+            colo[fin.request_id] = fin
+
+    # Disaggregated: one prefill engine ships to one decode engine.
+    pf = make_engine(model, flight=FlightRecorder())
+    dc = make_engine(model, flight=FlightRecorder())
+    for req in trace_requests():
+        pf.submit(Request(req.request_id, list(req.tokens),
+                          req.max_new_tokens, seed=req.seed, handoff=True))
+    handoffs = {f.request_id: f for f in pf.run_until_idle()}
+    for rid in sorted(handoffs, key=lambda r: handoffs[r].finished_at):
+        blob = pf.export_session(rid)
+        dc.import_session(blob, request_id=rid)
+        pf.release_session(rid)
+    disagg = {f.request_id: f for f in dc.run_until_idle()}
+
+    bitwise = all(disagg[rid].tokens == colo[rid].tokens for rid in colo)
+    report = {
+        "requests": len(colo),
+        "prompt_lens": list(SPLIT_PROMPT_LENS),
+        "max_new_tokens": SPLIT_MAX_NEW,
+        "ttft_p99_colocated_s": round(
+            p99([f.ttft for f in colo.values()]), 6),
+        "ttft_p99_disaggregated_s": round(
+            p99([f.ttft for f in handoffs.values()]), 6),
+        "decode_tpot_p99_colocated_s": round(
+            p99([tpot(f) for f in colo.values()]), 6),
+        "decode_tpot_p99_disaggregated_s": round(
+            p99([tpot(f) for f in disagg.values()]), 6),
+        "outputs_bitwise_identical": bitwise,
+    }
+    report["ttft_p99_ratio"] = round(
+        report["ttft_p99_disaggregated_s"]
+        / report["ttft_p99_colocated_s"], 4)
+    return report
+
+
+def phase_parity(model):
+    """Phase B: kv_dtype x spec_k, migrated stream == solo stream."""
+    prompt = [5, 7, 5, 7, 5, 7, 9, 2]
+    cells = {}
+    for kv_dtype in ("auto", "int8", "fp8"):
+        if kv_dtype == "fp8" and not fp8_supported():
+            for spec_k in (0, 3):
+                cells[f"{kv_dtype}/spec{spec_k}"] = \
+                    "skipped:no-float8_e4m3fn"
+            continue
+        for spec_k in (0, 3):
+            over = dict(kv_dtype=kv_dtype, spec_k=spec_k)
+            solo = make_engine(model, **over)
+            solo.submit(Request("solo", list(prompt), MAX_NEW, seed=9))
+            want = solo.run_until_idle()[0].tokens
+            src = make_engine(model, **over)
+            dst = make_engine(model, **over)
+            src.submit(Request("r", list(prompt), MAX_NEW, seed=9,
+                               handoff=True))
+            first = src.run_until_idle()[0]
+            blob = src.export_session("r")
+            rid2 = dst.import_session(blob, request_id="mig-r")
+            src.release_session("r")
+            done = {f.request_id: f for f in dst.run_until_idle()}
+            ok = (first.finish_reason == "handoff"
+                  and first.tokens == want[:1]
+                  and done[rid2].tokens == want)
+            cells[f"{kv_dtype}/spec{spec_k}"] = \
+                "bitwise" if ok else (f"MISMATCH solo={want} "
+                                      f"migrated={done[rid2].tokens}")
+    return cells
+
+
+def _goodput_fleet(workdir, arm, model):
+    """One drained fleet: a source engine stepped to mid-decode with a
+    GoodputRecorder attached, plus an instrumented empty destination."""
+    fleet = {}
+    for role in ("src", "dst"):
+        writer = TraceWriter(
+            os.path.join(workdir, f"drain-{arm}-{role}.jsonl"),
+            f"drain-{arm}-{role}")
+        engine = make_engine(model)
+        engine.goodput = GoodputRecorder("serve", clock=engine.clock,
+                                         writer=writer)
+        fleet[role] = (engine, writer)
+    src, _ = fleet["src"]
+    for i in range(3):
+        src.submit(Request(f"d{i}", [(5 * j + i) % 29 for j in range(16)],
+                           12, seed=70 + i))
+    for _ in range(10):  # two prefill chunks, then mid-decode
+        src.step()
+    return fleet
+
+
+def _close_fleet(fleet, roles):
+    for role in roles:
+        engine, writer = fleet[role]
+        engine.goodput.close()
+        writer.close()
+
+
+def phase_drain(model, workdir, repo):
+    """Phase C: drain-via-migration vs drain-via-recompute, chip time
+    judged by the real `tk8s goodput report` CLI over the traces."""
+    streams = {}
+    busy = {}
+    for arm in ("migrate", "recompute"):
+        fleet = _goodput_fleet(workdir, arm, model)
+        src, _ = fleet["src"]
+        dst, _ = fleet["dst"]
+        if arm == "migrate":
+            for rid in src.exportable_sessions():
+                blob = src.export_session(rid, reason="drain")
+                dst.import_session(blob, request_id=f"mig-{rid}",
+                                   reason="drain")
+                src.release_session(rid)
+            _close_fleet(fleet, ("src",))
+            done = dst.run_until_idle()
+            streams[arm] = {f.request_id.removeprefix("mig-"): f.tokens
+                            for f in done}
+        else:
+            # Replica death: the source's work so far is sunk cost, the
+            # sessions re-land from scratch on the destination.
+            inflight = [s.request for s in src.slots if s is not None]
+            _close_fleet(fleet, ("src",))
+            for req in inflight:
+                dst.submit(Request(req.request_id, list(req.tokens),
+                                   req.max_new_tokens, seed=req.seed))
+            done = dst.run_until_idle()
+            streams[arm] = {f.request_id: f.tokens for f in done}
+        _close_fleet(fleet, ("dst",))
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "triton_kubernetes_tpu.cli.main",
+             "--json", "goodput", "report",
+             os.path.join(workdir, f"drain-{arm}-src.jsonl"),
+             os.path.join(workdir, f"drain-{arm}-dst.jsonl")],
+            cwd=repo, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            return {"error": f"goodput report ({arm}) rc="
+                             f"{proc.returncode}: {proc.stderr[-400:]}"}
+        rep = json.loads(proc.stdout)
+        busy[arm] = {
+            "processes": {
+                p["path"]: round(
+                    p["accounted_s"] - p["seconds"].get("idle", 0.0), 6)
+                for p in rep["processes"]},
+            "seconds_by_category": {
+                p["path"]: p["seconds"] for p in rep["processes"]},
+        }
+        busy[arm]["busy_chip_seconds"] = round(
+            sum(busy[arm]["processes"].values()), 6)
+    return {
+        "sessions": 3,
+        "streams_bitwise_identical": streams["migrate"]
+        == streams["recompute"],
+        "migrate": busy["migrate"],
+        "recompute": busy["recompute"],
+        "chip_seconds_saved": round(
+            busy["recompute"]["busy_chip_seconds"]
+            - busy["migrate"]["busy_chip_seconds"], 6),
+    }
+
+
+def main(argv):
+    tag = argv[1] if len(argv) > 1 else "local"
+    repo = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir))
+    out_dir = os.path.join(repo, "docs", "ci-evidence")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"disagg-{tag}.json")
+    workdir = os.path.join(out_dir, f".disagg-work-{tag}")
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir)
+
+    metrics.configure()
+    cfg = get_config("llama-test")
+    model = (cfg, init_params(cfg, jax.random.PRNGKey(0)))
+
+    journal = {"tag": tag, "config": cfg.name,
+               "engine": {k: v for k, v in ENGINE_KW.items()}}
+    journal["split"] = phase_split(model)
+    journal["parity"] = phase_parity(model)
+    journal["drain"] = phase_drain(model, workdir, repo)
+
+    with open(out_path, "w") as f:
+        json.dump(journal, f, indent=2, sort_keys=True)
+        f.write("\n")
+    shutil.rmtree(workdir, ignore_errors=True)  # the journal is the artifact
+    print(f"disagg evidence written: {out_path}")
+    print(json.dumps(journal["split"]))
+    print(json.dumps(journal["parity"]))
+    print(json.dumps({k: journal["drain"].get(k) for k in
+                      ("streams_bitwise_identical", "chip_seconds_saved")}))
+
+    failures = []
+    sp = journal["split"]
+    if not sp["outputs_bitwise_identical"]:
+        failures.append("split A/B streams are not bitwise identical")
+    if sp["ttft_p99_disaggregated_s"] >= sp["ttft_p99_colocated_s"]:
+        failures.append(
+            f"disaggregated TTFT p99 {sp['ttft_p99_disaggregated_s']}s "
+            f"does not beat colocated {sp['ttft_p99_colocated_s']}s")
+    if sp["decode_tpot_p99_disaggregated_s"] > \
+            sp["decode_tpot_p99_colocated_s"] * GATE_TPOT_SLACK:
+        failures.append(
+            f"decode TPOT p99 regressed: "
+            f"{sp['decode_tpot_p99_disaggregated_s']}s vs colocated "
+            f"{sp['decode_tpot_p99_colocated_s']}s "
+            f"(slack {GATE_TPOT_SLACK})")
+    for cell, verdict in journal["parity"].items():
+        if verdict != "bitwise" and not verdict.startswith("skipped:"):
+            failures.append(f"parity cell {cell}: {verdict}")
+    dr = journal["drain"]
+    if "error" in dr:
+        failures.append(dr["error"])
+    else:
+        if not dr["streams_bitwise_identical"]:
+            failures.append("drain arms produced different streams")
+        if dr["chip_seconds_saved"] <= 0:
+            failures.append(
+                f"drain-via-migration did not save chip time: migrate "
+                f"{dr['migrate']['busy_chip_seconds']}s vs recompute "
+                f"{dr['recompute']['busy_chip_seconds']}s")
+    for f_ in failures:
+        print(f"FAIL: {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

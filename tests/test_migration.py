@@ -1,0 +1,470 @@
+"""KV-page session migration: wire format, engine import/export, HTTP
+plane, and the operator's rebalance planner.
+
+The contract under test is the disaggregation tentpole's: a session
+packs into ONE self-describing unit, ships over the ordinary HTTP
+plane, and unpacks **byte-exactly** — subsequent tokens are bitwise
+identical to a never-migrated run, any torn transfer is rejected by
+the digest with the destination pool untouched, and pages the
+destination's prefix cache already indexes transfer by refcount
+instead of by copy (docs/guide/serving.md §Disaggregation).
+"""
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from triton_kubernetes_tpu.models import get_config, init_params
+from triton_kubernetes_tpu.serve import (
+    ManualClock,
+    MigrationError,
+    Request,
+    ServeEngine,
+    ServeHTTPServer,
+    TornPayloadError,
+    corrupt,
+    pack_session,
+    unpack_session,
+)
+from triton_kubernetes_tpu.serve.migration import check_compatible
+from triton_kubernetes_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    metrics.configure()
+    yield
+    metrics.configure()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = get_config("llama-test")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def make_engine(model, **over):
+    cfg, params = model
+    kw = dict(block_size=4, num_blocks=40, max_batch=4, max_model_len=64,
+              clock=ManualClock(tick=0.001))
+    kw.update(over)
+    return ServeEngine(params, cfg, **kw)
+
+
+def solo_tokens(model, prompt, n, seed=0, **over):
+    eng = make_engine(model, **over)
+    eng.submit(Request("solo", list(prompt), n, seed=seed))
+    done = eng.run_until_idle()
+    eng.release_prefix_cache()
+    assert len(done) == 1 and eng.allocator.in_use == 0
+    return done[0].tokens
+
+
+def _pack_kw(rng, pages, dtype, scales):
+    """One synthetic session unit: ragged page counts, optional
+    quantization scales, a request dict with the sampling state."""
+    arrays = {
+        "k": rng.integers(-100, 100, (2, pages, 4, 3, 5)).astype(dtype),
+        "v": rng.integers(-100, 100, (2, pages, 4, 3, 5)).astype(dtype),
+    }
+    if scales:
+        arrays["k_scale"] = rng.random((2, pages, 4, 3),
+                                       dtype=np.float32)
+        arrays["v_scale"] = rng.random((2, pages, 4, 3),
+                                       dtype=np.float32)
+    return dict(model="llama-test", kv_dtype="auto", block_size=4,
+                arrays=arrays,
+                request={"request_id": "r1", "tokens": [1, 2, 3],
+                         "max_new_tokens": 8, "seed": 7},
+                generated=[4, 5], prefilled=3, target=3, preemptions=1)
+
+
+# ---------------------------------------------------------- wire format
+def test_pack_unpack_roundtrip_sweep_is_byte_exact():
+    """Seeded sweep over ragged page counts x dtypes x scale presence:
+    every array comes back byte-equal, and the header carries the whole
+    request/sampling state."""
+    rng = np.random.default_rng(11)
+    for pages in (1, 2, 3, 7):
+        for dtype in (np.float32, np.int8):
+            for scales in (False, True):
+                kw = _pack_kw(rng, pages, dtype, scales)
+                sp = unpack_session(pack_session(**kw))
+                assert sorted(sp.arrays) == sorted(kw["arrays"])
+                for name, arr in kw["arrays"].items():
+                    got = sp.arrays[name]
+                    assert got.dtype == arr.dtype
+                    assert got.shape == arr.shape
+                    assert got.tobytes() == arr.tobytes()
+                assert sp.pages == pages
+                assert sp.request == kw["request"]
+                assert sp.header["generated"] == [4, 5]
+                assert sp.header["prefilled"] == 3
+                assert sp.header["preemptions"] == 1
+
+
+def test_digest_rejects_every_single_flipped_bit():
+    """The torn-transfer pin at full strength: flip each bit of the
+    blob in turn — header, payload, and the digest itself — and every
+    mutant must raise TornPayloadError."""
+    rng = np.random.default_rng(3)
+    blob = pack_session(**_pack_kw(rng, 1, np.int8, False))
+    for byte in range(len(blob)):
+        for bit in range(8):
+            b = bytearray(blob)
+            b[byte] ^= 1 << bit
+            with pytest.raises(TornPayloadError):
+                unpack_session(bytes(b))
+
+
+def test_digest_rejects_every_truncation_point():
+    rng = np.random.default_rng(4)
+    blob = pack_session(**_pack_kw(rng, 2, np.float32, True))
+    r = random.Random(5)
+    offsets = {0, 1, len(blob) - 1} | {r.randrange(len(blob))
+                                       for _ in range(64)}
+    for off in sorted(offsets):
+        with pytest.raises(TornPayloadError):
+            unpack_session(corrupt(blob, mode="truncate", offset=off))
+
+
+def test_check_compatible_refuses_mismatches():
+    rng = np.random.default_rng(6)
+    kw = _pack_kw(rng, 2, np.float32, False)
+    sp = unpack_session(pack_session(**kw))
+    ok = dict(model="llama-test", kv_dtype="auto", block_size=4,
+              expect_arrays=("k", "v"))
+    check_compatible(sp, **ok)
+    for bad in (dict(ok, model="other-model"),
+                dict(ok, kv_dtype="int8"),
+                dict(ok, block_size=8),
+                dict(ok, expect_arrays=("k", "v", "k_scale", "v_scale"))):
+        with pytest.raises(MigrationError):
+            check_compatible(sp, **bad)
+
+
+# ------------------------------------------------------- engine parity
+def _migrate(src, dst, rid, reason="handoff"):
+    blob = src.export_session(rid, reason=reason)
+    new_rid = dst.import_session(blob, request_id=f"mig-{rid}",
+                                 reason=reason)
+    src.release_session(rid)
+    return new_rid, blob
+
+
+def test_handoff_migration_is_bitwise_identical(model):
+    """The core parity gate: first token on the source, KV pages
+    migrate, the decode tail on the destination — the combined stream
+    equals the never-migrated solo run bit for bit, across ragged
+    prompt lengths crossing block boundaries."""
+    src, dst = make_engine(model), make_engine(model)
+    for i, plen in enumerate((4, 5, 7, 8, 11)):
+        prompt = [(3 * j + i) % 29 for j in range(plen)]
+        want = solo_tokens(model, prompt, 6, seed=40 + i)
+        rid = f"r{i}"
+        src.submit(Request(rid, prompt, 6, seed=40 + i, handoff=True))
+        first = src.run_until_idle()
+        assert [d.request_id for d in first] == [rid]
+        assert first[0].finish_reason == "handoff"
+        assert first[0].tokens == want[:1]
+        new_rid, blob = _migrate(src, dst, rid)
+        done = dst.run_until_idle()
+        assert [d.request_id for d in done] == [new_rid]
+        assert done[0].tokens == want
+        assert done[0].finish_reason in ("length", "eos")
+        assert len(blob) > 0
+    assert src.allocator.in_use == 0
+    dst.release_prefix_cache()
+    assert dst.allocator.in_use == 0
+
+
+def test_imported_pool_bytes_and_block_table_are_byte_equal(model):
+    """Byte-exactness of the pool landing: after import, reading the
+    destination pool back through the imported session's rebuilt block
+    table reproduces the shipped unit's pages and scales byte for byte
+    — no dequantize/requantize cycle anywhere on the path."""
+    for kv_dtype in ("auto", "int8"):
+        src = make_engine(model, kv_dtype=kv_dtype)
+        dst = make_engine(model, kv_dtype=kv_dtype)
+        src.submit(Request("r", [5, 7, 9, 11, 2, 13, 4], 4, seed=3,
+                           handoff=True))
+        src.run_until_idle()
+        blob = src.export_session("r")
+        a = unpack_session(blob)
+        if kv_dtype == "int8":
+            assert {"k_scale", "v_scale"} <= set(a.arrays)
+        rid2 = dst.import_session(blob, request_id="mig-r")
+        seq = next(s for s in dst.waiting
+                   if s.request.request_id == rid2)
+        pool = {"k": dst.cache.k, "v": dst.cache.v}
+        if dst.cache.quantized:
+            pool["k_scale"] = dst.cache.k_scale
+            pool["v_scale"] = dst.cache.v_scale
+        assert sorted(pool) == sorted(a.arrays)
+        for name, full in pool.items():
+            landed = np.asarray(full[:, np.asarray(seq.pages)])
+            assert landed.tobytes() == a.arrays[name].tobytes(), \
+                (kv_dtype, name)
+        done = dst.run_until_idle()
+        assert [d.request_id for d in done] == [rid2]
+        src.release_session("r")
+        assert src.allocator.in_use == 0 and dst.allocator.in_use == 0
+
+
+@pytest.mark.slow
+def test_migration_parity_sweep_kv_dtype_by_spec_k(model):
+    """The full acceptance cross: kv_dtype x spec_k, each migrated
+    stream bitwise equal to its never-migrated twin."""
+    for kv_dtype in ("auto", "int8"):
+        for spec_k in (0, 3):
+            over = dict(kv_dtype=kv_dtype, spec_k=spec_k)
+            prompt = [5, 7, 5, 7, 5, 7, 9, 2]
+            want = solo_tokens(model, prompt, 8, seed=9, **over)
+            src = make_engine(model, **over)
+            dst = make_engine(model, **over)
+            src.submit(Request("r", prompt, 8, seed=9, handoff=True))
+            src.run_until_idle()
+            new_rid, _ = _migrate(src, dst, "r")
+            done = dst.run_until_idle()
+            assert done[0].tokens == want, (kv_dtype, spec_k)
+
+
+def test_torn_import_leaves_destination_untouched(model):
+    src, dst = make_engine(model), make_engine(model)
+    src.submit(Request("r", [5, 7, 9, 11], 6, seed=1, handoff=True))
+    src.run_until_idle()
+    blob = src.export_session("r")
+    before = dst.allocator.in_use
+    for mode, off in (("truncate", len(blob) // 3),
+                      ("bitflip", 10), ("bitflip", len(blob) - 1)):
+        with pytest.raises(TornPayloadError):
+            dst.import_session(corrupt(blob, mode=mode, offset=off))
+        assert dst.allocator.in_use == before
+        assert "r" in src.parked  # source still owns the session
+    # The intact retry still lands.
+    rid2 = dst.import_session(blob, request_id="mig-r")
+    src.release_session("r")
+    dst.run_until_idle()
+    assert rid2 == "mig-r"
+    fams = metrics.get_registry().render_openmetrics()
+    assert 'tk8s_serve_migrations_total{direction="in"' in fams
+    assert 'status="torn"' in fams
+
+
+def test_prefix_cached_pages_transfer_by_refcount(model):
+    """The refcount handshake: when the destination's radix index
+    already holds the session's full-page prompt prefix, the import
+    increfs those pages instead of allocating copies."""
+    over = dict(prefill_chunk=8, prefix_cache=True)
+    prefix = [2, 4, 6, 8, 1, 3, 5, 7]
+    src = make_engine(model, **over)
+    dst = make_engine(model, **over)
+    # Warm the destination's prefix cache with the same prompt.
+    dst.submit(Request("warm", list(prefix), 2, seed=5))
+    dst.run_until_idle()
+    in_use = dst.allocator.in_use
+    src.submit(Request("r", list(prefix), 4, seed=5, handoff=True))
+    src.run_until_idle()
+    new_rid, _ = _migrate(src, dst, "r")
+    # Both prompt pages were already indexed: zero fresh allocations.
+    assert dst.allocator.in_use == in_use
+    want = solo_tokens(model, prefix, 4, seed=5, **over)
+    done = dst.run_until_idle()
+    assert done[0].tokens == want
+    dst.release_prefix_cache()
+    assert dst.allocator.in_use == 0
+
+
+def test_drain_migrates_live_decode_mid_stream(model):
+    """The drain path: a session mid-decode (no handoff flag) exports,
+    migrates, and finishes on the destination with the full bitwise
+    stream; the source closes it as finish_reason=migrated."""
+    want = solo_tokens(model, [5, 7, 9, 11, 2], 8, seed=2)
+    src, dst = make_engine(model), make_engine(model)
+    src.submit(Request("r", [5, 7, 9, 11, 2], 8, seed=2))
+    for _ in range(4):  # prefill + a few decode steps
+        src.step()
+    assert src.exportable_sessions() == ["r"]
+    blob = src.export_session("r", reason="drain")
+    rid2 = dst.import_session(blob, request_id="mig-r", reason="drain")
+    done_src = src.release_session("r")
+    assert done_src is not None
+    assert done_src.finish_reason == "migrated"
+    done = dst.run_until_idle()
+    assert [d.request_id for d in done] == [rid2]
+    assert done[0].tokens == want
+    assert src.allocator.in_use == 0
+
+
+def test_resume_after_failed_ship_finishes_locally(model):
+    want = solo_tokens(model, [5, 7, 9, 11, 2], 6, seed=8)
+    src = make_engine(model)
+    src.submit(Request("r", [5, 7, 9, 11, 2], 6, seed=8, handoff=True))
+    first = src.run_until_idle()
+    assert first[0].finish_reason == "handoff"
+    src.export_session("r")  # the ship that will "fail"
+    src.resume_session("r")
+    done = src.run_until_idle()
+    assert [d.request_id for d in done] == ["r"]
+    assert done[0].tokens == want
+    assert src.allocator.in_use == 0
+
+
+# ------------------------------------------------------------ HTTP plane
+def _post(url, path, payload, timeout=60.0):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_http_handoff_migrate_await_roundtrip(model):
+    want = solo_tokens(model, [5, 7, 9, 11, 2], 6, seed=4)
+    with ServeHTTPServer(make_engine(model)) as src, \
+            ServeHTTPServer(make_engine(model)) as dst:
+        src_url, dst_url = src.url, dst.url
+        out = _post(src_url, "/generate",
+                    {"tokens": [5, 7, 9, 11, 2], "max_new_tokens": 6,
+                     "seed": 4, "handoff": True})
+        assert out["finish_reason"] == "handoff"
+        assert out["tokens"] == want[:1]
+        mig = _post(src_url, "/migrate/out",
+                    {"request_id": out["request_id"], "dest": dst_url,
+                     "reason": "handoff"})
+        assert mig["bytes"] > 0
+        awaited = _post(dst_url, "/await",
+                        {"request_id": mig["dest_request_id"]})
+        assert awaited["tokens"] == want
+        assert awaited["finish_reason"] in ("length", "eos")
+
+
+def test_http_torn_body_rejected_with_400(model):
+    with ServeHTTPServer(make_engine(model)) as src, \
+            ServeHTTPServer(make_engine(model)) as dst:
+        src_url, dst_url = src.url, dst.url
+        out = _post(src_url, "/generate",
+                    {"tokens": [5, 7, 9, 11], "max_new_tokens": 4,
+                     "handoff": True})
+        mig_req = urllib.request.Request(
+            dst_url + "/migrate/in", data=b"TK8SKV1\n not a payload",
+            headers={"Content-Type": "application/octet-stream"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(mig_req, timeout=30)
+        assert err.value.code == 400
+        body = json.loads(err.value.read())
+        assert body["torn"] is True
+        # The source still owns the session: /resume finishes locally.
+        resumed = _post(src_url, "/resume",
+                        {"request_id": out["request_id"]})
+        assert len(resumed["tokens"]) == 4
+
+
+def test_http_unreachable_dest_degrades_to_resume(model):
+    want = solo_tokens(model, [5, 7, 9, 11, 2], 6, seed=6)
+    with ServeHTTPServer(make_engine(model)) as src:
+        src_url = src.url
+        out = _post(src_url, "/generate",
+                    {"tokens": [5, 7, 9, 11, 2], "max_new_tokens": 6,
+                     "seed": 6, "handoff": True})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(src_url, "/migrate/out",
+                  {"request_id": out["request_id"],
+                   "dest": "http://127.0.0.1:9", "reason": "handoff"})
+        assert err.value.code == 502
+        body = json.loads(err.value.read())
+        assert body["resumed"] is False  # parked, awaiting /resume
+        resumed = _post(src_url, "/resume",
+                        {"request_id": out["request_id"]})
+        assert resumed["tokens"] == want
+
+
+# ------------------------------------------------------------- rebalance
+def test_plan_rebalance_fires_only_hot_and_spread():
+    from triton_kubernetes_tpu.operator import plan_rebalance
+
+    # Hot + spread: hottest above watermark, gap above threshold.
+    plan = plan_rebalance({0: 0.9, 1: 0.2, 2: 0.5},
+                          gap_threshold=0.3)
+    assert (plan.source, plan.target) == (0, 1)
+    assert plan.gap == pytest.approx(0.7)
+    # Spread without heat: below the high watermark, never fires.
+    assert plan_rebalance({0: 0.5, 1: 0.05},
+                          gap_threshold=0.3) is None
+    # Heat without spread.
+    assert plan_rebalance({0: 0.9, 1: 0.8},
+                          gap_threshold=0.3) is None
+    # Disabled / degenerate inputs.
+    assert plan_rebalance({0: 0.9, 1: 0.1}, gap_threshold=0.0) is None
+    assert plan_rebalance({0: 0.9}, gap_threshold=0.3) is None
+    assert plan_rebalance({}, gap_threshold=0.3) is None
+    # Deterministic tie-break: equal utilization -> lowest index.
+    plan = plan_rebalance({2: 0.9, 1: 0.9, 0: 0.1, 3: 0.1},
+                          gap_threshold=0.3)
+    assert (plan.source, plan.target) == (1, 0)
+
+
+def test_http_rebalancer_moves_one_session(model):
+    """The operator's actuation seam end-to-end: hottest replica's
+    oldest exportable session migrates to the coolest, over the same
+    /migrate plane the router uses."""
+    from triton_kubernetes_tpu.operator import (http_rebalancer,
+                                                plan_rebalance)
+
+    want = solo_tokens(model, [5, 7, 9, 11, 2], 6, seed=12)
+    with ServeHTTPServer(make_engine(model)) as src, \
+            ServeHTTPServer(make_engine(model)) as dst:
+        src_url, dst_url = src.url, dst.url
+        out = _post(src_url, "/generate",
+                    {"tokens": [5, 7, 9, 11, 2], "max_new_tokens": 6,
+                     "seed": 12, "handoff": True})
+        assert out["finish_reason"] == "handoff"
+        plan = plan_rebalance({0: 0.92, 1: 0.04}, gap_threshold=0.25)
+        move = http_rebalancer(
+            [src_url + "/metrics", dst_url + "/metrics"])(plan)
+        assert move["status"] == "ok", move
+        assert move["request_id"] == out["request_id"]
+        awaited = _post(dst_url, "/await",
+                        {"request_id": move["dest_request_id"]})
+        assert awaited["tokens"] == want
+
+
+# -------------------------------------------------------------- topology
+def test_disaggregated_deployments_render_pools():
+    from triton_kubernetes_tpu.topology import (
+        SliceSpec, render_disaggregated_deployments)
+    from triton_kubernetes_tpu.topology.serving import POOL_LABEL
+    from triton_kubernetes_tpu.topology.validate import validate_manifest
+
+    spec = SliceSpec.from_accelerator("v5e-8")
+    deps = render_disaggregated_deployments(
+        "llm", spec, "pool0", image="tk8s/jax-tpu-runtime:0.1.0",
+        model="llama3-bench", prefill_replicas=2, decode_replicas=3)
+    assert [d["metadata"]["name"] for d in deps] == ["llm-prefill",
+                                                     "llm-decode"]
+    for dep, pool, replicas in zip(deps, ("prefill", "decode"), (2, 3)):
+        validate_manifest(dep)
+        assert dep["spec"]["replicas"] == replicas
+        labels = dep["spec"]["template"]["metadata"]["labels"]
+        assert labels[POOL_LABEL] == pool
+        cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert cmd[cmd.index("--pool") + 1] == pool
+
+
+def test_router_deployment_renders_decode_replicas():
+    from triton_kubernetes_tpu.topology import render_router_deployment
+
+    dep = render_router_deployment(
+        "llm-route", image="tk8s/jax-tpu-runtime:0.1.0",
+        replica_urls=["http://p0:8000"],
+        decode_urls=["http://d0:8000", "http://d1:8000"])
+    cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd.count("--decode-replica") == 2
+    assert "http://d1:8000" in cmd

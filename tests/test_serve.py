@@ -532,7 +532,8 @@ def test_http_trace_header_phases_and_exemplars(model):
         assert out["trace_id"] == "t-wire-1"
         phases = out["phases"]
         assert set(phases) == {"queue_s", "prefill_s", "decode_s",
-                               "recompute_s"}
+                               "recompute_s", "migrate_out_s",
+                               "migrate_in_s"}
         assert sum(phases.values()) > 0
 
         # Headerless traffic still traces under the local request id.

@@ -100,7 +100,8 @@ def test_flight_recorder_phases_partition_lifetime():
     fr.event("r1", "serve.resume", 8.0)
     rec = fr.finish("r1", 10.0, "length")
     assert rec.phases == {"queue_s": 3.0, "prefill_s": 1.5,
-                          "decode_s": 3.5, "recompute_s": 2.0}
+                          "decode_s": 3.5, "recompute_s": 2.0,
+                          "migrate_out_s": 0.0, "migrate_in_s": 0.0}
     assert sum(rec.phases.values()) == pytest.approx(rec.e2e_s)
     assert rec.preemptions == 1 and rec.outcome == "length"
     # Segments tile the lifetime: contiguous, gap-free.

@@ -50,6 +50,7 @@ WORKLOAD_FAULT_KINDS = (
     "rank-death",         # one worker dies at a step offset
     "coordinator-loss",   # rank 0 dies at a step offset
     "sigterm-flush",      # SIGTERM the route process; flush must land
+    "kv-migration-torn",  # KV-page transfer torn mid-flight; digest bites
 )
 
 #: Per-kind fault-field defaults. A spec's workload dict may override
@@ -68,6 +69,8 @@ WORKLOAD_DEFAULTS = {
     "rank-death": {"crash_step": 1, "steps": 4},
     "coordinator-loss": {"crash_step": 1, "steps": 4},
     "sigterm-flush": {"process": "route", "after_requests": 1},
+    "kv-migration-torn": {"cut": "bitflip", "offset_frac": 0.5,
+                          "prompt_len": 12, "max_new_tokens": 6},
 }
 
 

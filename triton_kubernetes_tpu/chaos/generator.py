@@ -79,9 +79,10 @@ PROFILES: Dict[str, Dict[str, Any]] = {
                  "fault_rules": (0, 1), "latency_weight": 0.1,
                  "kill_weight": 0.1, "operator_weight": 0.0,
                  "workload_weight": 1.0,
-                 "workload_kinds": (("engine-preempt", 0.45),
+                 "workload_kinds": (("engine-preempt", 0.35),
                                     ("torn-checkpoint", 0.2),
-                                    ("sigterm-flush", 0.2),
+                                    ("sigterm-flush", 0.15),
+                                    ("kv-migration-torn", 0.15),
                                     ("replica-death", 0.15))},
     # Training-plane workload faults (multi-host subprocess launches —
     # seconds per arm, so sweeps keep the run counts small).
@@ -294,6 +295,13 @@ def _draw_workload(rng: random.Random, prof: Dict[str, Any]
     elif kind == "sigterm-flush":
         fault["process"] = "route"
         fault["after_requests"] = rng.randint(1, 3)
+    elif kind == "kv-migration-torn":
+        fault["cut"] = rng.choice(("truncate", "bitflip"))
+        # Anywhere in the frame: header (metadata), payload (pages),
+        # or the trailing digest itself — all must be caught.
+        fault["offset_frac"] = round(rng.uniform(0.0, 1.0), 3)
+        fault["prompt_len"] = rng.randint(8, 16)
+        fault["max_new_tokens"] = rng.randint(4, 8)
     return fault
 
 

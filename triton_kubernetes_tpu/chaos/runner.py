@@ -68,7 +68,7 @@ INVARIANTS = ("parity", "kill-resume", "trace-journal", "metrics-journal",
               # Workload fault arms (ISSUE 16, chaos/workload.py):
               "engine-parity", "reland-parity", "pool-convergence",
               "trace-valid", "ckpt-fallback", "train-resume",
-              "flush-clean")
+              "flush-clean", "migration-integrity")
 
 #: Deliberate invariant breakages (mutation testing of the harness
 #: itself): each key names a way run_scenario corrupts its own checking
@@ -79,9 +79,11 @@ INVARIANTS = ("parity", "kill-resume", "trace-journal", "metrics-journal",
 #: invariant each: ``dropped-reland`` truncates the re-landed response
 #: before the parity compare, ``leaked-pages`` skips the page-pool
 #: release before the convergence check, ``swallowed-abort`` drops the
-#: abort flush so lifecycles end terminal-less.
+#: abort flush so lifecycles end terminal-less, ``accepted-torn``
+#: pretends the destination imported a torn KV payload so
+#: migration-integrity must catch the phantom acceptance.
 MUTATIONS = ("unfaulted-reference", "dropped-reland", "leaked-pages",
-             "swallowed-abort")
+             "swallowed-abort", "accepted-torn")
 
 _MAX_APPLY_ATTEMPTS = 6
 

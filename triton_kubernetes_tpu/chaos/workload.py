@@ -364,6 +364,116 @@ def _arm_replica_death(cfg, spec, res, check, recorder) -> None:
     shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ---------------------------------------------------- kv-migration-torn
+def _arm_kv_migration_torn(cfg, spec, res, check, recorder) -> None:
+    """Tear a KV-page migration mid-flight (socket cut or corrupted
+    frame at a generated byte offset): the destination must reject the
+    torn payload on its digest with ZERO pages allocated, the source
+    must keep serving un-degraded with the session still parked, and a
+    retry with the intact bytes must land bitwise-identical to the
+    never-migrated solo reference. The ``accepted-torn`` mutation
+    pretends the destination imported the torn bytes — the
+    migration-integrity oracle must catch the phantom acceptance."""
+    from ..serve.engine import Request
+    from ..serve.migration import TornPayloadError, corrupt
+
+    mutation = spec.get("mutation")
+    prompt = [(11 * i + 5) % 29 for i in range(int(cfg["prompt_len"]))]
+    max_new = int(cfg["max_new_tokens"])
+    want = _reference_tokens(("solo",), prompt, max_new, 31)
+    src, sclock = _engine(("mig-src",))
+    dst, dclock = _engine(("mig-dst",))
+    sclock.tick = dclock.tick = ENGINE_CLOCK_TICK
+    t0s, t0d = sclock.now, dclock.now
+    tmp = tempfile.mkdtemp(prefix="tk8s-chaos-wl-")
+    src_path = os.path.join(tmp, "mig-src.jsonl")
+    dst_path = os.path.join(tmp, "mig-dst.jsonl")
+    src.flight = FlightRecorder(
+        writer=TraceWriter(src_path, role="replica", clock=sclock))
+    dst.flight = FlightRecorder(
+        writer=TraceWriter(dst_path, role="replica", clock=dclock))
+    rid = "wl-mig"
+    try:
+        src.submit(Request(rid, list(prompt), max_new, seed=31,
+                           handoff=True))
+        first = {d.request_id: d for d in src.run_until_idle()}
+        parked = (first.get(rid) is not None
+                  and first[rid].finish_reason == "handoff"
+                  and rid in src.parked)
+        blob = src.export_session(rid) if parked else b""
+        torn = corrupt(blob, mode=cfg["cut"],
+                       offset=int(float(cfg["offset_frac"]) * len(blob))
+                       ) if parked else b""
+        dest_before = dst.allocator.in_use
+        rejected = False
+        if parked:
+            try:
+                dst.import_session(torn, request_id=f"mig-{rid}")
+            except TornPayloadError:
+                rejected = True
+            except Exception:  # wrong error class = wrong rejection
+                rejected = False
+        if mutation == "accepted-torn":
+            # The seeded harness self-test: a receiver that swallowed
+            # the digest mismatch and kept the torn pages.
+            rejected = False
+        # Source un-degraded after the torn attempt: the session is
+        # still parked (pages intact, retryable) and a fresh request
+        # decodes bitwise-clean alongside it.
+        probe_prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        probe_want = _reference_tokens(("solo",), probe_prompt, 4, 32)
+        src.submit(Request("wl-mig-probe", list(probe_prompt), 4,
+                           seed=32))
+        probe = {d.request_id: d for d in src.run_until_idle()}
+        probe_done = probe.get("wl-mig-probe")
+        check(res, "migration-integrity",
+              parked and rejected and dst.allocator.in_use == dest_before
+              and rid in src.parked
+              and probe_done is not None
+              and probe_done.tokens == probe_want,
+              f"torn transfer ({cfg['cut']} at "
+              f"{cfg['offset_frac']}): parked={parked} "
+              f"rejected={rejected} dest pages "
+              f"{dest_before}->{dst.allocator.in_use}, source probe "
+              f"tokens={getattr(probe_done, 'tokens', None)} "
+              f"(want {probe_want})")
+        tokens = None
+        if parked:
+            new_rid = dst.import_session(blob, request_id=f"mig-{rid}")
+            done = {d.request_id: d for d in dst.run_until_idle()}
+            src.release_session(rid)
+            got = done.get(new_rid)
+            tokens = got.tokens if got is not None else None
+        check(res, "engine-parity", tokens == want,
+              f"retried migration diverged from the solo reference: "
+              f"got={tokens} want={want}")
+    finally:
+        leaked = 0
+        for eng, clock, t0 in ((src, sclock, t0s), (dst, dclock, t0d)):
+            flight, eng.flight = eng.flight, None
+            if flight is not None:
+                flight.flush_aborted(clock(), "chaos: arm teardown")
+                if flight.writer is not None:
+                    flight.writer.close()
+            # A failed arm may strand a parked session; release it so
+            # the cached engine stays reusable (release is also the
+            # protocol's own page-free path — a buggy release still
+            # shows up as leaked pages below).
+            for leftover in list(eng.parked):
+                try:
+                    eng.release_session(leftover)
+                except Exception:
+                    pass
+            leaked += _drain(eng)
+            recorder(max(0.0, clock.now - t0))
+    check(res, "pool-convergence", leaked == 0,
+          f"{leaked} KV pages still allocated across source + "
+          f"destination after release + drain")
+    problems = validate_chaos_trace([src_path, dst_path])
+    check(res, "trace-valid", not problems, "; ".join(problems[:4]))
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ------------------------------------------------------ torn-checkpoint
 def _arm_torn_checkpoint(cfg, spec, res, check, recorder) -> None:
     """Corrupt one committed step (truncated file, flipped bit, torn
@@ -686,6 +796,7 @@ _ARMS = {
     "rank-death": _arm_rank_death,
     "coordinator-loss": _arm_coordinator_loss,
     "sigterm-flush": _arm_sigterm_flush,
+    "kv-migration-torn": _arm_kv_migration_torn,
 }
 
 

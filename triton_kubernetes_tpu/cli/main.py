@@ -336,6 +336,17 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--seed", type=int, default=0, metavar="N",
                        help="parameter-init seed for the randomly "
                             "initialized model (default: 0)")
+    serve.add_argument("--pool", default="colocated",
+                       choices=["colocated", "prefill", "decode"],
+                       metavar="ROLE",
+                       help="disaggregation role label for this replica: "
+                            "colocated (default) serves prefill + decode; "
+                            "prefill replicas answer the first token and "
+                            "hand sessions off, decode replicas import "
+                            "migrated KV pages and stream the rest — the "
+                            "router drives the handoff, the engine "
+                            "behaves identically either way "
+                            "(docs/guide/serving.md §Disaggregation)")
     serve.add_argument("--trace-jsonl", default=None, metavar="FILE",
                        help="append this replica's request-lifecycle "
                             "spans (admit/prefill/first-token/preempt/"
@@ -352,7 +363,17 @@ def build_parser() -> argparse.ArgumentParser:
     route.add_argument("--replica", action="append", required=True,
                        metavar="URL", dest="replicas",
                        help="replica base URL (repeatable), e.g. "
-                            "http://10.0.0.7:8000")
+                            "http://10.0.0.7:8000; with --decode-replica "
+                            "these become the prefill pool")
+    route.add_argument("--decode-replica", action="append", default=[],
+                       metavar="URL", dest="decode_replicas",
+                       help="decode-pool replica base URL (repeatable); "
+                            "any present switches the router to "
+                            "disaggregated mode — prompts prefill on a "
+                            "--replica, then the session's KV pages "
+                            "migrate to a decode replica for the "
+                            "remaining tokens (docs/guide/serving.md "
+                            "§Disaggregation)")
     route.add_argument("--route-host", default="127.0.0.1", metavar="ADDR",
                        help="bind address (default: 127.0.0.1; manifests "
                             "use 0.0.0.0)")
@@ -449,6 +470,20 @@ def build_parser() -> argparse.ArgumentParser:
                          help="hold after any grow/drain so the fleet's "
                               "response is judged, not the action "
                               "(default: 60)")
+    operate.add_argument("--rebalance-gap", type=float, default=0.0,
+                         metavar="FRACTION",
+                         help="KV-pressure spread between the hottest "
+                              "and coolest scraped replica beyond which "
+                              "the operator live-migrates one session "
+                              "per tick from hot to cool (default: 0 = "
+                              "rebalancing off; docs/guide/operator.md "
+                              "§Rebalance)")
+    operate.add_argument("--rebalance-high", type=float, default=0.75,
+                         metavar="FRACTION",
+                         help="KV-pool utilization the hottest replica "
+                              "must exceed before a rebalance fires — "
+                              "a cold fleet is never shuffled "
+                              "(default: 0.75)")
     operate.add_argument("--operator-host", default="127.0.0.1",
                          metavar="ADDR",
                          help="bind address for the operator's own "
@@ -794,9 +829,11 @@ def main(argv: Optional[List[str]] = None,
                     num_blocks=args.num_blocks, max_batch=args.max_batch,
                     kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
                     prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache, spec_k=args.spec_k)
+                    prefix_cache=prefix_cache, spec_k=args.spec_k,
+                    pool=args.pool)
         print(f"serving {args.model} on http://{host}:{port} "
-              f"(POST /generate, GET /metrics, GET /healthz)", flush=True)
+              f"(POST /generate, GET /metrics, GET /healthz, "
+              f"pool={args.pool})", flush=True)
         _sigterm_runs_finally()
         try:
             server.serve_forever()
@@ -835,7 +872,8 @@ def main(argv: Optional[List[str]] = None,
                 virtual_nodes=args.virtual_nodes,
                 request_timeout_s=args.request_timeout,
                 trace_seed=args.trace_seed,
-                trace=route_writer)
+                trace=route_writer,
+                decode_urls=args.decode_replicas or None)
         except ValueError as e:
             logger.error(str(e), kind="ValueError")
             return 2
@@ -846,6 +884,7 @@ def main(argv: Optional[List[str]] = None,
         host, port = router.address
         logger.info("routing", url=f"http://{host}:{port}",
                     replicas=len(args.replicas),
+                    decode_replicas=len(args.decode_replicas),
                     spill_threshold=args.spill_threshold)
         print(f"routing {len(args.replicas)} replicas on "
               f"http://{host}:{port} (POST /generate, GET /metrics, "
@@ -933,6 +972,7 @@ def main(argv: Optional[List[str]] = None,
                 OperatorError,
                 OperatorHTTPServer,
                 Reconciler,
+                http_rebalancer,
             )
             from ..utils import metrics as _metrics
             from ..workflows.common import select_manager
@@ -960,6 +1000,15 @@ def main(argv: Optional[List[str]] = None,
 
                 operate_writer = TraceWriter(args.trace_jsonl,
                                              role="operator")
+            rebalancer = None
+            if args.rebalance_gap > 0:
+                if not args.scrape_urls:
+                    logger.error(
+                        "--rebalance-gap needs at least one --scrape: "
+                        "KV pressure is read from the serving fleet's "
+                        "/metrics", kind="ValueError")
+                    return 2
+                rebalancer = http_rebalancer(list(args.scrape_urls))
             reconciler = Reconciler(
                 be, ex, manager,
                 autoscaler=autoscaler,
@@ -968,6 +1017,9 @@ def main(argv: Optional[List[str]] = None,
                 interval_s=args.interval,
                 journal_path=args.journal_out,
                 trace=operate_writer,
+                rebalancer=rebalancer,
+                rebalance_gap=args.rebalance_gap,
+                rebalance_high=args.rebalance_high,
                 log=logger.info)
             server = None
             if args.operator_port is not None:

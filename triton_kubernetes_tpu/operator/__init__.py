@@ -23,6 +23,7 @@ from .observe import (
     observe,
     tpu_pool_modules,
 )
+from .rebalance import RebalanceDecision, http_rebalancer, plan_rebalance
 from .reconcile import RULES, ReconcileDelta, act, compute_delta
 from .server import OperatorHTTPServer
 
@@ -33,6 +34,7 @@ __all__ = [
     "ObservedState",
     "OperatorError",
     "OperatorHTTPServer",
+    "RebalanceDecision",
     "Reconciler",
     "ReconcileDelta",
     "ReconcileTick",
@@ -42,6 +44,8 @@ __all__ = [
     "act",
     "apply_decision",
     "compute_delta",
+    "http_rebalancer",
     "observe",
+    "plan_rebalance",
     "tpu_pool_modules",
 ]

@@ -34,6 +34,7 @@ from ..utils.trace import TraceWriter
 from .autoscaler import Autoscaler, ScaleDecision, apply_decision, \
     record_decision
 from .observe import MetricsWatcher, MetricsSource, ObservedState, observe
+from .rebalance import RebalanceDecision, plan_rebalance
 from .reconcile import act, compute_delta
 
 #: Tick outcomes (journal/metrics vocabulary).
@@ -100,6 +101,10 @@ class Reconciler:
                  journal_limit: int = 1000,
                  trace: Optional[TraceWriter] = None,
                  log: Optional[Callable[[str], None]] = None,
+                 rebalancer: Optional[Callable[[RebalanceDecision],
+                                               Dict[str, Any]]] = None,
+                 rebalance_gap: float = 0.0,
+                 rebalance_high: float = 0.75,
                  between_observe_and_act: Optional[
                      Callable[[ObservedState], None]] = None):
         from ..utils import get_logger
@@ -121,6 +126,13 @@ class Reconciler:
         # timestamped on the injected clock (the writer's meta anchor
         # maps it onto the shared wall timeline).
         self.trace = trace
+        # KV-pressure rebalancing (operator/rebalance.py): the
+        # actuation between grow and drain. ``rebalance_gap`` <= 0
+        # disables it; ``rebalancer`` is the actuation seam
+        # (http_rebalancer in production, a lambda in tests).
+        self.rebalancer = rebalancer
+        self.rebalance_gap = float(rebalance_gap)
+        self.rebalance_high = float(rebalance_high)
         self.journal: List[ReconcileTick] = []
         self.log = log or (lambda m: get_logger().info(m))
         self._between = between_observe_and_act
@@ -250,6 +262,7 @@ class Reconciler:
                 record.error = failed[0].error
                 self.log(f"reconcile tick {self._ticks}: rule "
                          f"{failed[0].rule} failed: {failed[0].error}")
+        self._maybe_rebalance(record, serving, decision)
         if decision is not None:
             landed = True
             if decision.direction in ("grow", "drain"):
@@ -299,6 +312,58 @@ class Reconciler:
             record.duration_s)
         self._journal(record)
         return record
+
+    # ---------------------------------------------------------- rebalance
+    def _maybe_rebalance(self, record: ReconcileTick, serving: Any,
+                         decision: Optional[ScaleDecision]) -> None:
+        """The actuation BETWEEN grow and drain: only on a tick where
+        the fleet converged (outcome noop) and the scaling policy held
+        — growing or draining already changes every replica's share,
+        so moving sessions in the same tick would chase a stale
+        picture. Fires at most one migration per tick (the next tick
+        re-observes both pools before moving anything else)."""
+        if (self.rebalancer is None or self.rebalance_gap <= 0
+                or record.outcome != "noop"
+                or (decision is not None
+                    and decision.direction != "hold")):
+            return
+        plan = plan_rebalance(serving.kv_utilization,
+                              gap_threshold=self.rebalance_gap,
+                              high_watermark=self.rebalance_high)
+        if plan is None:
+            return
+        t0 = self.clock()
+        try:
+            result = self.rebalancer(plan)
+            status = str(result.get("status", "ok"))
+        except Exception as e:  # the seam reaches the network
+            result, status = {"error": str(e)}, "failed"
+        action: Dict[str, Any] = {"rule": "rebalance",
+                                  "ok": status != "failed",
+                                  "status": status, **plan.to_dict()}
+        for key in ("request_id", "error"):
+            if result.get(key):
+                action[key] = str(result[key])
+        record.actions.append(action)
+        if status == "failed":
+            record.outcome = "failed"
+            record.error = action.get("error", "rebalance failed")
+            self.log(f"rebalance failed: {record.error}")
+        elif status == "ok":
+            record.outcome = "acted"
+            self.log(f"rebalance: moved {action.get('request_id')} "
+                     f"from source {plan.source} to {plan.target} "
+                     f"(gap {plan.gap:.2f})")
+        if status in ("ok", "failed"):
+            # "noop" (nothing exportable) is observation, not
+            # actuation — only real attempts count.
+            metrics.counter("tk8s_operator_rebalances_total").inc(
+                status=status)
+        if self.trace is not None:
+            self.trace.event("operator.rebalance", t0,
+                             self.clock() - t0, source=plan.source,
+                             target=plan.target, gap=round(plan.gap, 6),
+                             status=status)
 
     # ------------------------------------------------------------ journal
     def _journal(self, record: ReconcileTick) -> None:

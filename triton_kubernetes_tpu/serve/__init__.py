@@ -54,6 +54,14 @@ _LAZY = {
     "Request": ".engine",
     "ServeEngine": ".engine",
     "ServeHTTPServer": ".server",
+    # KV-page migration wire protocol (numpy-only, but it rides the
+    # lazy slice with the engine it serializes for).
+    "MigrationError": ".migration",
+    "SessionPayload": ".migration",
+    "TornPayloadError": ".migration",
+    "corrupt": ".migration",
+    "pack_session": ".migration",
+    "unpack_session": ".migration",
 }
 
 
@@ -73,6 +81,7 @@ __all__ = [
     "FinishedRequest",
     "HashRing",
     "ManualClock",
+    "MigrationError",
     "OutOfBlocksError",
     "PoissonSchedule",
     "PrefixCache",
@@ -81,9 +90,14 @@ __all__ = [
     "Router",
     "RouterHTTPServer",
     "ServeEngine",
+    "SessionPayload",
     "SessionSchedule",
     "SharedPrefixSchedule",
+    "TornPayloadError",
+    "corrupt",
     "draft_ngram",
     "longest_agreeing_prefix",
+    "pack_session",
     "percentile",
+    "unpack_session",
 ]

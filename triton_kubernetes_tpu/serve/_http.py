@@ -18,7 +18,8 @@ from urllib.parse import parse_qs
 # Both endpoints expose the same wire surface; unknown paths are
 # bucketed as "other" in the HTTP counters so label cardinality cannot
 # be driven by scanners.
-ROUTES = ("/healthz", "/metrics", "/stats", "/generate")
+ROUTES = ("/healthz", "/metrics", "/stats", "/generate",
+          "/migrate/out", "/migrate/in", "/await", "/resume")
 
 
 def route_label(path: str) -> str:

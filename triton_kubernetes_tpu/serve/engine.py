@@ -76,6 +76,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.config import ModelConfig
 from ..models.generate import sample_token
@@ -93,6 +94,13 @@ from ..train.precision import quantize_for_decode
 from ..utils import metrics
 from ..utils.trace import FlightRecorder
 from .blocks import BlockAllocator, OutOfBlocksError, PrefixCache
+from .migration import (
+    MigrationError,
+    TornPayloadError,
+    check_compatible,
+    pack_session,
+    unpack_session,
+)
 from .speculation import draft_ngram, longest_agreeing_prefix
 
 
@@ -130,6 +138,11 @@ class Request:
     eos_id: Optional[int] = None
     seed: int = 0
     trace_id: Optional[str] = None
+    # Disaggregated prefill/decode: a handoff request finishes right
+    # after its first token (finish_reason "handoff") with its KV pages
+    # PARKED for export_session instead of freed — the prefill pool's
+    # half of the prefill->ship->decode flow.
+    handoff: bool = False
 
 
 @dataclass
@@ -137,7 +150,7 @@ class FinishedRequest:
     request_id: str
     prompt_len: int
     tokens: List[int]  # generated only
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | "handoff" | "migrated"
     submitted_at: float
     first_token_at: float
     finished_at: float
@@ -148,6 +161,11 @@ class FinishedRequest:
     trace_id: Optional[str] = None
     phases: Optional[Dict[str, float]] = None
     spec: Optional[Dict[str, int]] = None
+    # Set by the HTTP layer when finish_reason is "migrated": where the
+    # session now lives, so the caller can follow it (/await there) and
+    # hand the client the complete stream.
+    migrated_to: Optional[str] = None
+    dest_request_id: Optional[str] = None
 
     @property
     def ttft(self) -> float:
@@ -186,6 +204,14 @@ class _Sequence:
     # runs), consumed and cleared by the verify. Never survives a
     # preemption — a readmitted sequence re-drafts from its history.
     draft: List[int] = field(default_factory=list)
+    # Migration state: handed_off means this sequence's lifecycle
+    # already closed with a "handoff" FinishedRequest (pages parked for
+    # export); imported means it arrived via import_session (its TTFT
+    # was measured on the source replica, not here); migrate_reason is
+    # the reason label its migration counters carry.
+    handed_off: bool = False
+    imported: bool = False
+    migrate_reason: str = ""
 
     @property
     def length(self) -> int:
@@ -285,6 +311,12 @@ class ServeEngine:
                                       kv_dtype=kv_dtype)
         self.waiting: Deque[_Sequence] = deque()
         self.slots: List[Optional[_Sequence]] = [None] * max_batch
+        # Sessions frozen out of the scheduler with their pages intact:
+        # handed-off sequences awaiting export, and live sequences
+        # mid-migration (the shipped snapshot must stay authoritative
+        # while the transfer is in flight — a torn transfer resumes
+        # them un-degraded via resume_session).
+        self.parked: Dict[str, _Sequence] = {}
         self._admit_counter = 0
         self._steps = 0
         cfg = config
@@ -457,6 +489,23 @@ class ServeEngine:
             if slot is None:
                 return
             seq = self.waiting[0]
+            if seq.pages:
+                # A resumed or migrated-in session: its pages already
+                # hold the whole teacher-forced history, so admission
+                # only grants the slot and it rejoins decode directly
+                # (no prefill windows, no page math).
+                self.waiting.popleft()
+                seq.admit_seq = self._admit_counter
+                self._admit_counter += 1
+                self.slots[slot] = seq
+                if self.flight is not None:
+                    now = self.clock()
+                    rid = seq.request.request_id
+                    self.flight.event(rid, "serve.admitted", now,
+                                      slot=slot, reused_pages=0,
+                                      recompute=False, deferred=True)
+                    self.flight.event(rid, "serve.resume", now)
+                continue
             prompt = list(seq.request.tokens) + list(seq.generated)
             need = blocks_for(len(prompt), self.block_size)
             reuse: List[int] = []
@@ -932,9 +981,23 @@ class ServeEngine:
             reason = "eos"
         elif len(seq.generated) >= r.max_new_tokens:
             reason = "length"
+        # Handoff parks AFTER the genuine-completion checks: a one-token
+        # request that is already done has nothing left to disaggregate.
+        if reason is None and r.handoff and seq.prefilled >= seq.target:
+            reason = "handoff"
         if reason is None:
             return False
-        self.allocator.free(seq.pages)
+        if reason == "handoff":
+            # The decode half of this request runs elsewhere: keep the
+            # pages (parked, off the scheduler) for export_session and
+            # give back only the slot. The lifecycle closes here — the
+            # pack/ship that follows is process-level work, not this
+            # request's latency.
+            seq.handed_off = True
+            seq.draft = []
+            self.parked[r.request_id] = seq
+        else:
+            self.allocator.free(seq.pages)
         self.slots[slot] = None
         now = self.clock()
         done = FinishedRequest(
@@ -956,9 +1019,14 @@ class ServeEngine:
         # The trace id rides the latency observations as an OpenMetrics
         # exemplar: each bucket remembers the last trace that landed in
         # it, so a breaching TTFT p99 resolves to a concrete request
-        # whose phase breakdown explains the latency.
-        metrics.histogram("tk8s_serve_ttft_seconds").observe(
-            done.ttft, exemplar=done.trace_id)
+        # whose phase breakdown explains the latency. An imported
+        # session's first token was sampled on its SOURCE replica — its
+        # near-zero local "TTFT" would poison this pool's histogram (and
+        # the operator's windowed p99), so only its genuine decode pace
+        # is observed here.
+        if not seq.imported:
+            metrics.histogram("tk8s_serve_ttft_seconds").observe(
+                done.ttft, exemplar=done.trace_id)
         if len(done.tokens) > 1:
             metrics.histogram("tk8s_serve_tpot_seconds").observe(
                 done.tpot, exemplar=done.trace_id)
@@ -999,6 +1067,10 @@ class ServeEngine:
             "prefix_cache": self.prefix is not None,
             "prefix_cache_pages": (self.prefix.pages
                                    if self.prefix is not None else 0),
+            # Migration surface: what drain/rebalance could ship away
+            # right now, and what is frozen awaiting a transfer verdict.
+            "parked": sorted(self.parked),
+            "sessions": self.exportable_sessions(),
             "tracing": (self.flight.snapshot()
                         if self.flight is not None else None),
         }
@@ -1021,6 +1093,310 @@ class ServeEngine:
         if self.prefix is None:
             return 0
         return self.prefix.clear()
+
+    # --------------------------------------------------------- migration
+    def exportable_sessions(self) -> List[str]:
+        """Request ids a migration could ship right now: parked
+        sessions plus fully-prefilled live ones (a mid-prefill sequence
+        has no complete state to pack — drain re-lands it via
+        recompute instead)."""
+        live = [s.request.request_id for s in self.slots
+                if s is not None and s.prefilled >= s.target
+                and s.generated]
+        return sorted(self.parked) + live
+
+    def _trace_id(self, seq: _Sequence) -> str:
+        return seq.request.trace_id or seq.request.request_id
+
+    def export_session(self, request_id: str,
+                       reason: str = "handoff") -> bytes:
+        """Pack one session into the self-describing wire unit
+        (serve/migration.py). Non-destructive: the pages stay allocated
+        and the session stays parked until the destination confirms the
+        import (``release_session``) or the transfer fails
+        (``resume_session``) — a torn transfer costs nothing but the
+        bytes.
+
+        A live decoding session (drain/rebalance) is parked here first,
+        freezing it out of the scheduler so the shipped snapshot stays
+        authoritative while the bytes are in flight."""
+        seq = self.parked.get(request_id)
+        if seq is None:
+            slot = next(
+                (i for i, s in enumerate(self.slots)
+                 if s is not None and s.request.request_id == request_id),
+                None)
+            if slot is None:
+                raise MigrationError(
+                    f"no exportable session {request_id!r} (not parked, "
+                    f"not in a decode slot)")
+            seq = self.slots[slot]
+            if seq.prefilled < seq.target or not seq.generated:
+                raise MigrationError(
+                    f"session {request_id!r} is still prefilling — "
+                    f"drain re-lands it via recompute, not migration")
+            seq.draft = []  # drafts are per-tick state; they never ship
+            self.slots[slot] = None
+            self.parked[request_id] = seq
+        seq.migrate_reason = reason
+        t0 = self.clock()
+        if self.goodput is not None:
+            self.goodput.transition("migrate_out")
+        pages = jnp.asarray(seq.pages, jnp.int32)
+        arrays = {"k": np.asarray(self.cache.k[:, pages]),
+                  "v": np.asarray(self.cache.v[:, pages])}
+        if self.cache.quantized:
+            arrays["k_scale"] = np.asarray(self.cache.k_scale[:, pages])
+            arrays["v_scale"] = np.asarray(self.cache.v_scale[:, pages])
+        r = seq.request
+        blob = pack_session(
+            model=self.config.name, kv_dtype=self.kv_dtype,
+            block_size=self.block_size, arrays=arrays,
+            request={"request_id": r.request_id,
+                     "tokens": list(r.tokens),
+                     "max_new_tokens": r.max_new_tokens,
+                     "temperature": r.temperature, "top_k": r.top_k,
+                     "top_p": r.top_p, "eos_id": r.eos_id,
+                     "seed": r.seed, "trace_id": r.trace_id},
+            generated=list(seq.generated), prefilled=seq.prefilled,
+            target=seq.target, preemptions=seq.preemptions)
+        if self.goodput is not None:
+            self.goodput.transition("idle")
+        metrics.counter("tk8s_serve_migration_bytes_total").inc(
+            len(blob), direction="out", exemplar=self._trace_id(seq))
+        if self.flight is not None:
+            now = self.clock()
+            if seq.handed_off:
+                # The handoff lifecycle already closed — the pack lands
+                # as a writer-only span so the timeline still shows it.
+                self.flight.migration(
+                    "serve.migrate_out", t0, now - t0,
+                    trace=self._trace_id(seq), request=request_id,
+                    bytes=len(blob), pages=len(seq.pages), reason=reason)
+            else:
+                self.flight.event(
+                    request_id, "serve.migrate_out", t0,
+                    bytes=len(blob), pages=len(seq.pages), reason=reason)
+        return blob
+
+    def release_session(self, request_id: str,
+                        ) -> Optional[FinishedRequest]:
+        """The destination confirmed the import: free the parked pages
+        (dropping this session's references — prefix-cache-shared pages
+        survive under the cache's own refs). For a drain/rebalance
+        migration the session's lifecycle is still open here, so it
+        closes with finish_reason ``migrated`` and the returned
+        FinishedRequest resolves whatever waiter the original request
+        holds; a handed-off session already answered its waiter and
+        returns None."""
+        seq = self.parked.pop(request_id, None)
+        if seq is None:
+            raise MigrationError(f"no parked session {request_id!r}")
+        self.allocator.free(seq.pages)
+        seq.pages = []
+        metrics.counter("tk8s_serve_migrations_total").inc(
+            direction="out", reason=seq.migrate_reason or "handoff",
+            status="ok", exemplar=self._trace_id(seq))
+        if seq.handed_off:
+            return None
+        now = self.clock()
+        done = FinishedRequest(
+            request_id=request_id, prompt_len=len(seq.request.tokens),
+            tokens=list(seq.generated), finish_reason="migrated",
+            submitted_at=seq.submitted_at,
+            first_token_at=seq.first_token_at or now,
+            finished_at=now, preemptions=seq.preemptions)
+        if self.flight is not None:
+            rec = self.flight.finish(request_id, now, "migrated")
+            if rec is not None:
+                done.trace_id = rec.trace_id
+                done.phases = dict(rec.phases)
+        metrics.counter("tk8s_serve_requests_total").inc(
+            outcome="migrated")
+        if not seq.imported:
+            metrics.histogram("tk8s_serve_ttft_seconds").observe(
+                done.ttft, exemplar=done.trace_id)
+        return done
+
+    def resume_session(self, request_id: str) -> None:
+        """The transfer failed (torn payload, unreachable destination):
+        un-park the session with everything intact and let it finish
+        HERE — the source keeps serving un-degraded. Clears the
+        handoff flag so the sequence decodes to genuine completion
+        instead of re-parking at its next completion check."""
+        seq = self.parked.pop(request_id, None)
+        if seq is None:
+            raise MigrationError(f"no parked session {request_id!r}")
+        seq.request.handoff = False
+        seq.admit_seq = -1
+        self.waiting.appendleft(seq)
+
+    def import_session(self, payload: bytes,
+                       request_id: Optional[str] = None,
+                       reason: str = "handoff") -> str:
+        """Verify, unpack, and install a shipped session byte-exactly.
+
+        The digest check runs before anything else — a torn payload
+        raises :class:`~.migration.TornPayloadError` with this pool
+        untouched. Pages whose exact token content the local radix
+        prefix cache already indexes transfer by REFERENCE (incref, no
+        scatter — the refcount handshake); the rest are allocated fresh
+        and their raw bytes scattered in. The installed sequence
+        re-enters decode on the next tick and keeps sampling with the
+        request's own (seed, position) keys, so its tokens stay bitwise
+        the never-migrated stream.
+
+        ``request_id`` renames the session on arrival (the HTTP plane
+        passes a locally-unique id — two sources may both ship their
+        own ``req-0``). Sampling is keyed by seed, never by id, so the
+        rename is invisible in the output."""
+        t0 = self.clock()
+        if self.goodput is not None:
+            self.goodput.transition("migrate_in")
+        mig = metrics.counter("tk8s_serve_migrations_total")
+        try:
+            sp = unpack_session(payload)
+            expect = (("k", "v", "k_scale", "v_scale")
+                      if self.cache.quantized else ("k", "v"))
+            check_compatible(
+                sp, model=self.config.name, kv_dtype=self.kv_dtype,
+                block_size=self.block_size, expect_arrays=expect)
+            self._check_importable(sp)
+        except MigrationError as e:
+            status = ("torn" if isinstance(e, TornPayloadError)
+                      else "error")
+            mig.inc(direction="in", reason=reason, status=status)
+            if self.goodput is not None:
+                self.goodput.transition("idle")
+            raise
+        req_state = dict(sp.request)
+        rid = request_id or str(req_state["request_id"])
+        if (rid in self.parked
+                or any(s is not None and s.request.request_id == rid
+                       for s in self.slots)
+                or any(s.request.request_id == rid
+                       for s in self.waiting)):
+            mig.inc(direction="in", reason=reason, status="error")
+            if self.goodput is not None:
+                self.goodput.transition("idle")
+            raise MigrationError(
+                f"request id {rid!r} is already live on this replica — "
+                f"import under a fresh id")
+        request = Request(
+            request_id=rid, tokens=[int(t) for t in req_state["tokens"]],
+            max_new_tokens=int(req_state["max_new_tokens"]),
+            temperature=float(req_state["temperature"]),
+            top_k=int(req_state["top_k"]),
+            top_p=float(req_state["top_p"]),
+            eos_id=(None if req_state["eos_id"] is None
+                    else int(req_state["eos_id"])),
+            seed=int(req_state["seed"]),
+            trace_id=req_state.get("trace_id"), handoff=False)
+        n_pages = sp.pages
+        # The refcount handshake: full prompt pages the local radix
+        # cache already indexes are identical bytes by the determinism
+        # contract (same windows of the same tokens wrote them), so the
+        # session maps them by reference and their payload bytes are
+        # simply not scattered.
+        reuse: List[int] = []
+        if self.prefix is not None and n_pages:
+            matched = self.prefix.lookup(request.tokens)
+            cap = min(len(matched), len(request.tokens) // self.block_size,
+                      n_pages)
+            reuse = matched[:cap]
+            self.allocator.incref(reuse)
+        try:
+            fresh = self.allocator.alloc(n_pages - len(reuse))
+        except OutOfBlocksError:
+            if reuse:
+                self.allocator.free(reuse)
+            mig.inc(direction="in", reason=reason, status="error")
+            if self.goodput is not None:
+                self.goodput.transition("idle")
+            raise MigrationError(
+                f"pool pressure: session needs {n_pages - len(reuse)} "
+                f"fresh pages, {self.allocator.available} available")
+        pages = reuse + fresh
+        if fresh:
+            src = list(range(len(reuse), n_pages))
+            dest = jnp.asarray(fresh, jnp.int32)
+            c = self.cache
+            k = c.k.at[:, dest].set(
+                jnp.asarray(np.ascontiguousarray(sp.arrays["k"][:, src])))
+            v = c.v.at[:, dest].set(
+                jnp.asarray(np.ascontiguousarray(sp.arrays["v"][:, src])))
+            if c.quantized:
+                ks = c.k_scale.at[:, dest].set(jnp.asarray(
+                    np.ascontiguousarray(sp.arrays["k_scale"][:, src])))
+                vs = c.v_scale.at[:, dest].set(jnp.asarray(
+                    np.ascontiguousarray(sp.arrays["v_scale"][:, src])))
+                self.cache = c._replace(k=k, v=v, k_scale=ks, v_scale=vs)
+            else:
+                self.cache = c._replace(k=k, v=v)
+        if self.prefix is not None:
+            # Index the imported prompt pages exactly as a local final
+            # prefill window would have: the next import (or local
+            # request) sharing this prompt transfers by reference.
+            self.prefix.insert(list(request.tokens), pages)
+        now = self.clock()
+        seq = _Sequence(
+            request, submitted_at=t0,
+            generated=[int(t) for t in sp.header["generated"]],
+            first_token_at=t0, preemptions=int(sp.header["preemptions"]),
+            pages=pages, prefilled=int(sp.header["prefilled"]),
+            target=int(sp.header["target"]), imported=True,
+            migrate_reason=reason)
+        self.waiting.append(seq)
+        if self.flight is not None:
+            self.flight.begin(rid, request.trace_id, t0)
+            self.flight.event(rid, "serve.migrate_in", t0,
+                              bytes=sp.nbytes, pages=n_pages,
+                              reused_pages=len(reuse), reason=reason)
+        mig.inc(direction="in", reason=reason, status="ok",
+                exemplar=self._trace_id(seq))
+        metrics.counter("tk8s_serve_migration_bytes_total").inc(
+            sp.nbytes, direction="in", exemplar=self._trace_id(seq))
+        if self.goodput is not None:
+            self.goodput.transition("idle")
+        return rid
+
+    def _check_importable(self, sp) -> None:
+        """Geometry/dtype gate beyond the header identity check: raw
+        bytes scatter only into arrays of the identical dtype and
+        per-page shape (a silent cast would break the bitwise
+        contract), and the session must actually fit this pool."""
+        c = self.cache
+        local = {"k": c.k, "v": c.v}
+        if c.quantized:
+            local["k_scale"], local["v_scale"] = c.k_scale, c.v_scale
+        for name, arr in local.items():
+            meta = sp.header["arrays"].get(name, {})
+            want = (arr.shape[0], sp.pages) + tuple(arr.shape[2:])
+            got = tuple(meta.get("shape", ()))
+            if np.dtype(meta.get("dtype", "void")) != np.dtype(arr.dtype):
+                raise MigrationError(
+                    f"component {name!r}: payload dtype "
+                    f"{meta.get('dtype')!r} != pool dtype "
+                    f"{np.dtype(arr.dtype).name!r}")
+            if got != want:
+                raise MigrationError(
+                    f"component {name!r}: payload shape {list(got)} != "
+                    f"expected {list(want)}")
+        if sp.pages > self.blocks_per_seq:
+            raise MigrationError(
+                f"session spans {sp.pages} pages, this pool's table "
+                f"width is {self.blocks_per_seq}")
+        h = sp.header
+        total = len(h["request"]["tokens"]) + int(
+            h["request"]["max_new_tokens"])
+        if total > self.max_model_len:
+            raise MigrationError(
+                f"session needs {total} positions, max_model_len is "
+                f"{self.max_model_len}")
+        if int(h["prefilled"]) < int(h["target"]) or not h["generated"]:
+            raise MigrationError(
+                "session is not fully prefilled — only decode-ready "
+                "sessions migrate")
 
 
 def _cache_like(template, k, v, k_scale=None, v_scale=None):

@@ -31,9 +31,21 @@ with three policies stacked in order:
    a fresh replica (duplicate compute, cascading into a fleet-wide
    eject storm under a burst of long prompts).
 
+4. **Disaggregated prefill/decode pools** (``decode_urls``): the
+   replicas above become the prefill pool, every /generate rides in
+   with ``handoff=True``, and the finished prefill's parked KV pages
+   migrate (serve/migration.py wire unit) to a consistent-hashed
+   decode replica that produces the token tail. Failures degrade,
+   never drop: a refused transfer retries the next decode replica,
+   then the source resumes and finishes colocated-style; a decode
+   replica dying AFTER the import re-lands the whole request via
+   deterministic recompute. ``drain_replica`` empties a live replica
+   by shipping its sessions to pool peers — the migration half of the
+   drain A/B (dead replicas still re-land via recompute).
+
 Metrics: ``tk8s_route_requests_total{replica, reason=affine|spill|
-eject}`` and ``tk8s_route_replica_healthy{replica}`` — the scrape
-surface ROADMAP item 1's autoscaler will watch.
+eject|handoff}`` and ``tk8s_route_replica_healthy{replica}`` — the
+scrape surface the autoscaler watches.
 
 Threading shape: handler threads are independent (no single-owner
 engine here); shared state (health flags, in-flight counts) sits behind
@@ -120,6 +132,7 @@ class Router:
         self,
         replica_urls: Sequence[str],
         *,
+        decode_urls: Optional[Sequence[str]] = None,
         spill_threshold: int = 4,
         virtual_nodes: int = 64,
         request_timeout_s: float = 120.0,
@@ -164,7 +177,21 @@ class Router:
             self.replicas[name] = ReplicaState(name=name,
                                                url=url.rstrip("/"))
         self.ring = HashRing(sorted(self.replicas), virtual_nodes)
-        for name in self.replicas:
+        # Disaggregated mode: with a decode pool attached, the replicas
+        # above become the PREFILL pool — /generate lands there with
+        # handoff=True, and the finished prefill migrates to a decode
+        # replica (its own affinity ring, named d0..dN) for the long
+        # token-by-token tail. Empty decode pool = classic colocated
+        # serving, byte-for-byte the old router.
+        self.decode_replicas: Dict[str, ReplicaState] = {}
+        for i, url in enumerate(decode_urls or ()):
+            name = f"d{i}"
+            self.decode_replicas[name] = ReplicaState(name=name,
+                                                      url=url.rstrip("/"))
+        self.decode_ring = (HashRing(sorted(self.decode_replicas),
+                                     virtual_nodes)
+                            if self.decode_replicas else None)
+        for name in list(self.replicas) + list(self.decode_replicas):
             metrics.gauge("tk8s_route_replica_healthy").set(1, replica=name)
 
     # ------------------------------------------------------------ policy
@@ -244,6 +271,11 @@ class Router:
     def _forward(self, payload: Dict[str, Any], trace_id: str,
                  ) -> Tuple[int, Dict[str, Any]]:
         key = self.route_key(payload)
+        if self.decode_ring is not None:
+            # Disaggregated: the prefill pool answers with the first
+            # token and parks the KV pages for the migration that
+            # _handoff orchestrates next.
+            payload = dict(payload, handoff=True)
         body = json.dumps(payload).encode()
         tried: set = set()
         last: Tuple[int, Dict[str, Any]] = (503, {
@@ -300,9 +332,173 @@ class Router:
                 replica=replica.name, reason=reason)
             if isinstance(out, dict):
                 out = dict(out, replica=replica.name, trace_id=trace_id)
+            if (self.decode_ring is not None and isinstance(out, dict)
+                    and out.get("finish_reason") == "handoff"):
+                return self._handoff(key, payload, replica, out, trace_id)
+            # A drained/rebalanced session answered "migrated" with a
+            # forwarding address: follow it so the client still gets
+            # the complete stream (bounded — a session can hop again).
+            hops = 0
+            while (isinstance(out, dict)
+                   and out.get("finish_reason") == "migrated"
+                   and out.get("migrated_to") and hops < 4):
+                hops += 1
+                astat, after = self._post_json(
+                    str(out["migrated_to"]) + "/await",
+                    {"request_id": out.get("dest_request_id")}, trace_id)
+                if not (200 <= astat < 300 and isinstance(after, dict)):
+                    break  # degrade: partial body, reason "migrated"
+                out = dict(after, ttft_s=out.get("ttft_s"),
+                           replica=replica.name, trace_id=trace_id)
             return status, out
         self._abort(trace_id, last[0], "every replica failed")
         return last
+
+    # ------------------------------------------------- disaggregation
+    def pick_decode(self, key: str,
+                    exclude: frozenset = frozenset()) -> ReplicaState:
+        """The decode-pool owner for a session key: same consistent-
+        hash affinity as :meth:`pick` (repeat turns of a session land
+        their migrations on the SAME decode replica, whose prefix cache
+        then absorbs the shipped pages by refcount instead of copy)."""
+        with self._lock:
+            down = frozenset(n for n, r in self.decode_replicas.items()
+                             if not r.healthy) | exclude
+            if len(down) >= len(self.decode_replicas):
+                raise LookupError("no healthy decode replica")
+            return self.decode_replicas[self.decode_ring.owner(key, down)]
+
+    def _handoff(self, key: str, payload: Dict[str, Any],
+                 source: ReplicaState, out: Dict[str, Any],
+                 trace_id: str) -> Tuple[int, Dict[str, Any]]:
+        """The ship half of prefill→decode: migrate the parked session
+        to a decode replica and block on its completion. Every failure
+        degrades, never drops: a refused transfer retries on the next
+        decode replica; with none left the SOURCE resumes the session
+        and finishes it colocated-style (slower, still correct)."""
+        rid = out["request_id"]
+        tried: set = set()
+        for _ in range(len(self.decode_replicas)):
+            try:
+                dest = self.pick_decode(key, frozenset(tried))
+            except LookupError:
+                break
+            tried.add(dest.name)
+            with self._lock:
+                dest.in_flight += 1
+                dest.requests += 1
+            t0 = self.clock()
+            astat, body = 0, {}
+            try:
+                status, mig = self._post_json(
+                    source.url + "/migrate/out",
+                    {"request_id": rid, "dest": dest.url,
+                     "reason": "handoff"}, trace_id)
+                dest_rid = (mig.get("dest_request_id")
+                            if isinstance(mig, dict) else None)
+                if status == 200 and dest_rid:
+                    astat, body = self._post_json(
+                        dest.url + "/await",
+                        {"request_id": dest_rid}, trace_id)
+            finally:
+                with self._lock:
+                    dest.in_flight -= 1
+            if self.trace is not None:
+                self.trace.event("route.place", t0, self.clock() - t0,
+                                 trace=trace_id, replica=dest.name,
+                                 reason="handoff", status=status)
+            if status != 200:
+                # The transfer never committed (torn payload, dest
+                # refused, dest down): the source still owns the parked
+                # session. Mark an unreachable dest unhealthy and try
+                # the next one.
+                if status == -1:
+                    self._set_health(dest.name, False)
+                continue
+            if 200 <= astat < 300 and isinstance(body, dict):
+                metrics.counter("tk8s_route_requests_total").inc(
+                    replica=dest.name, reason="handoff")
+                # The decode body carries the FULL token stream (the
+                # source's first token rode along in the wire unit);
+                # TTFT is the prefill pool's — the client saw its first
+                # token before the migration even started.
+                return 200, dict(body, ttft_s=out.get("ttft_s"),
+                                 replica=source.name,
+                                 decode_replica=dest.name,
+                                 trace_id=trace_id)
+            # Committed but the decode never completed (dest died after
+            # import): the source released the pages, so re-land via
+            # RECOMPUTE — deterministic sampling reproduces the exact
+            # stream from scratch.
+            self._set_health(dest.name, False)
+            status, body = self._post_json(
+                source.url + "/generate",
+                dict(payload, handoff=False), trace_id)
+            if 200 <= status < 300 and isinstance(body, dict):
+                return status, dict(body, replica=source.name,
+                                    trace_id=trace_id)
+            self._abort(trace_id, status, "recompute re-land failed")
+            return status, body
+        # No decode replica took the session: finish on the source.
+        status, body = self._post_json(source.url + "/resume",
+                                       {"request_id": rid}, trace_id)
+        if 200 <= status < 300 and isinstance(body, dict):
+            return status, dict(body, replica=source.name,
+                                trace_id=trace_id)
+        self._abort(trace_id, status,
+                    "handoff failed and source could not resume")
+        return status, body
+
+    def drain_replica(self, name: str) -> Dict[str, Any]:
+        """Drain a LIVE replica by migration instead of recompute: pull
+        it from rotation, then ship every exportable session to its
+        healthy pool peers (round-robin). The sessions keep decoding on
+        their new homes with the prefill chip-seconds already banked —
+        the cheaper half of the drain A/B that
+        scripts/ci/disagg_evidence.py gates. Dead replicas still
+        re-land via recompute (there is nothing left to export)."""
+        with self._lock:
+            pool = (self.decode_replicas if name in self.decode_replicas
+                    else self.replicas)
+            if name not in pool:
+                raise LookupError(f"unknown replica {name!r}")
+            source = pool[name]
+            peers = [r for n, r in sorted(pool.items())
+                     if n != name and r.healthy]
+        if not peers:
+            raise LookupError(
+                f"no healthy migration target for {name!r}")
+        self._set_health(name, False)
+        status, st = self._get_json(source.url + "/stats")
+        if status != 200 or not isinstance(st, dict):
+            return {"replica": name, "migrated": [], "failed": [],
+                    "error": f"source /stats unavailable ({status})"}
+        migrated: List[str] = []
+        failed: List[str] = []
+        for i, rid in enumerate(st.get("sessions", [])):
+            dest = peers[i % len(peers)]
+            mstat, _ = self._post_json(
+                source.url + "/migrate/out",
+                {"request_id": rid, "dest": dest.url,
+                 "reason": "drain"}, None)
+            (migrated if mstat == 200 else failed).append(rid)
+        return {"replica": name, "migrated": migrated, "failed": failed}
+
+    def _post_json(self, url: str, obj: Dict[str, Any],
+                   trace_id: Optional[str] = None,
+                   ) -> Tuple[int, Dict[str, Any]]:
+        return self._post(url, json.dumps(obj).encode(), trace_id)
+
+    def _get_json(self, url: str) -> Tuple[int, Dict[str, Any]]:
+        try:
+            with urllib.request.urlopen(
+                    urllib.request.Request(url),
+                    timeout=self.request_timeout_s) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, {"type": "error", "message": str(e)}
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            return -1, {"type": "error", "message": str(e)}
 
     def _abort(self, trace_id: str, status: int, error: str) -> None:
         """Record the router giving up on a request. route.place spans
@@ -366,7 +562,9 @@ class Router:
     # ------------------------------------------------------------ health
     def _set_health(self, name: str, healthy: bool) -> None:
         with self._lock:
-            self.replicas[name].healthy = healthy
+            pool = (self.replicas if name in self.replicas
+                    else self.decode_replicas)
+            pool[name].healthy = healthy
             # Gauge write INSIDE the lock (it is in-process bookkeeping,
             # not I/O): written outside, two concurrent flips could land
             # their gauge writes in the opposite order of their state
@@ -378,7 +576,8 @@ class Router:
         """One /healthz sweep over every replica (no lock held across
         the network): 200 re-admits, anything else ejects."""
         for name, url in [(r.name, r.url)
-                          for r in self.replicas.values()]:
+                          for r in list(self.replicas.values())
+                          + list(self.decode_replicas.values())]:
             req = urllib.request.Request(url + "/healthz")
             try:
                 with urllib.request.urlopen(
@@ -393,16 +592,22 @@ class Router:
             return any(r.healthy for r in self.replicas.values())
 
     def stats(self) -> Dict[str, Any]:
-        with self._lock:
+        def pool(replicas: Dict[str, ReplicaState]) -> Dict[str, Any]:
             return {
-                "spill_threshold": self.spill_threshold,
-                "replicas": {
-                    n: {"url": r.url, "healthy": r.healthy,
-                        "in_flight": r.in_flight, "requests": r.requests,
-                        "timeouts": r.timeouts}
-                    for n, r in sorted(self.replicas.items())
-                },
+                n: {"url": r.url, "healthy": r.healthy,
+                    "in_flight": r.in_flight, "requests": r.requests,
+                    "timeouts": r.timeouts}
+                for n, r in sorted(replicas.items())
             }
+
+        with self._lock:
+            out = {
+                "spill_threshold": self.spill_threshold,
+                "replicas": pool(self.replicas),
+            }
+            if self.decode_replicas:
+                out["decode_replicas"] = pool(self.decode_replicas)
+            return out
 
 
 class _Handler(JSONHandler):
@@ -429,7 +634,8 @@ class _Handler(JSONHandler):
             self._json(404, {"type": "error", "message": "not found"})
 
     def do_POST(self) -> None:  # noqa: N802
-        if urlparse(self.path).path != "/generate":
+        path = urlparse(self.path).path
+        if path not in ("/generate", "/drain"):
             self._json(404, {"type": "error", "message": "not found"})
             return
         n = int(self.headers.get("Content-Length") or 0)
@@ -439,6 +645,15 @@ class _Handler(JSONHandler):
                 raise ValueError("body must be a JSON object")
         except ValueError as e:
             self._json(400, {"type": "error", "message": str(e)})
+            return
+        if path == "/drain":
+            try:
+                out = self.route.router.drain_replica(
+                    str(payload.get("replica", "")))
+            except LookupError as e:
+                self._json(404, {"type": "error", "message": str(e)})
+                return
+            self._json(200, out)
             return
         # An invalid header (shape-wise: hostile, truncated, binary) is
         # treated as absent — the router mints a fresh id rather than

@@ -20,10 +20,26 @@ GET       /healthz      ``{"ok": true, "model": ...}``
 GET       /metrics      Prometheus text (tk8s_serve_* et al.)
 GET       /stats        engine scheduler/pool snapshot (JSON)
 POST      /generate     ``{"tokens": [ids...], "max_new_tokens": N,
-                        "temperature"/"top_k"/"top_p"/"eos_id"/"seed"}``
+                        "temperature"/"top_k"/"top_p"/"eos_id"/"seed"
+                        /"handoff"}``
                         → ``{"tokens": [...], "finish_reason",
                         "ttft_s", "tpot_s", "preemptions", ...}``
+POST      /migrate/out  ``{"request_id", "dest", "reason"}`` — pack the
+                        session, ship it to ``dest``'s /migrate/in,
+                        release on confirm / resume on failure
+POST      /migrate/in   raw wire unit (serve/migration.py) →
+                        ``{"request_id": local id}``; 400 on torn
+POST      /await        ``{"request_id"}`` → /generate-shaped body when
+                        an imported session completes
+POST      /resume       ``{"request_id"}`` → /generate-shaped body:
+                        un-park a session and finish it HERE (the
+                        failed-transfer fallback)
 ========  ============  =========================================
+
+The migration endpoints keep the single-owner rule: engine calls run as
+closures on the engine loop (``_op``); only the dumb byte shipping —
+an outbound POST of an already-packed payload — happens on the handler
+thread, and never under a lock.
 """
 
 from __future__ import annotations
@@ -31,15 +47,18 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import urllib.error
+import urllib.request
 from dataclasses import dataclass, field
 from http.server import ThreadingHTTPServer
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import urlparse
 
 from ..utils import metrics
 from ..utils.trace import TRACE_HEADER, FlightRecorder, valid_trace_id
 from ._http import JSONHandler, route_label
 from .engine import FinishedRequest, Request, ServeEngine
+from .migration import MigrationError, TornPayloadError
 
 # Default port for rendered manifests and the CLI (the serving analog of
 # the manager's API port; /metrics rides the same listener).
@@ -53,6 +72,17 @@ class _Waiter:
     result: Optional[FinishedRequest] = None
     error: Optional[str] = None
     fatal: bool = False  # loop death (503), not request rejection (400)
+
+
+@dataclass
+class _OpResult:
+    """A migration control call marshaled onto the engine loop: the
+    closure's return value or its exception, verbatim, so the handler
+    thread can map typed MigrationErrors to status codes."""
+
+    event: threading.Event = field(default_factory=threading.Event)
+    value: Any = None
+    exc: Optional[BaseException] = None
 
 
 class _Handler(JSONHandler):
@@ -100,7 +130,20 @@ class _Handler(JSONHandler):
             self._json(404, {"type": "error", "message": "not found"})
 
     def _post(self) -> None:
-        if urlparse(self.path).path != "/generate":
+        path = urlparse(self.path).path
+        if path == "/migrate/out":
+            self._migrate_out()
+            return
+        if path == "/migrate/in":
+            self._migrate_in()
+            return
+        if path == "/await":
+            self._await()
+            return
+        if path == "/resume":
+            self._resume()
+            return
+        if path != "/generate":
             self._json(404, {"type": "error", "message": "not found"})
             return
         n = int(self.headers.get("Content-Length") or 0)
@@ -126,6 +169,10 @@ class _Handler(JSONHandler):
                 "top_p": float(d.get("top_p", 1.0)),
                 "eos_id": int(eos_id) if eos_id is not None else None,
                 "seed": int(d.get("seed", 0)),
+                # Disaggregation: a prefill-pool replica answers with
+                # the first token and finish_reason "handoff", pages
+                # parked for /migrate/out (router sets this).
+                "handoff": bool(d.get("handoff", False)),
             }
         except (ValueError, TypeError) as e:
             # TypeError too: float(None)/int([]) from a malformed body is
@@ -156,24 +203,128 @@ class _Handler(JSONHandler):
         except RuntimeError as e:  # engine-loop death: liveness event
             self._json(503, {"type": "error", "message": str(e)})
             return
-        body: Dict[str, Any] = {
-            "request_id": done.request_id,
-            "tokens": done.tokens,
-            "prompt_len": done.prompt_len,
-            "finish_reason": done.finish_reason,
-            "ttft_s": done.ttft,
-            "tpot_s": done.tpot,
-            "preemptions": done.preemptions,
-        }
-        if done.trace_id is not None:
-            # The per-phase latency attribution rides the response: the
-            # four phases sum to e2e_s exactly (the evidence-gate pin).
-            body["trace_id"] = done.trace_id
-            body["phases"] = done.phases
-            body["e2e_s"] = done.finished_at - done.submitted_at
-            if done.spec is not None:
-                body["spec"] = done.spec
+        self._json(200, _finished_body(done))
+
+    # ------------------------------------------------------- migration
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _json_body(self) -> Dict[str, Any]:
+        d = json.loads(self._read_body() or b"{}")
+        if not isinstance(d, dict):
+            raise ValueError("body must be a JSON object")
+        return d
+
+    def _migrate_out(self) -> None:
+        try:
+            d = self._json_body()
+            rid = str(d["request_id"])
+            dest = str(d["dest"])
+            reason = str(d.get("reason", "handoff"))
+        except (ValueError, KeyError, TypeError) as e:
+            self._json(400, {"type": "error", "message": str(e)})
+            return
+        try:
+            body = self.serve.migrate_out(rid, dest, reason)
+        except MigrationError as e:  # no such session / not exportable
+            self._json(404, {"type": "error", "message": str(e)})
+            return
+        except (TimeoutError, RuntimeError) as e:
+            self._json(503, {"type": "error", "message": str(e)})
+            return
+        if "error" in body:
+            # Transfer failed: the session was resumed locally and the
+            # source keeps serving it un-degraded. 502 tells the caller
+            # the DESTINATION (not this replica) refused the bytes.
+            self._json(502, body)
+            return
         self._json(200, body)
+
+    def _migrate_in(self) -> None:
+        payload = self._read_body()
+        reason = self.headers.get("X-TK8S-Migrate-Reason") or "handoff"
+        try:
+            body = self.serve.migrate_in(payload, reason)
+        except TornPayloadError as e:
+            self._json(400, {"type": "error", "torn": True,
+                             "message": str(e)})
+            return
+        except MigrationError as e:  # incompatible / pool pressure
+            self._json(409, {"type": "error", "torn": False,
+                             "message": str(e)})
+            return
+        except (TimeoutError, RuntimeError) as e:
+            self._json(503, {"type": "error", "message": str(e)})
+            return
+        self._json(200, body)
+
+    def _await(self) -> None:
+        try:
+            rid = str(self._json_body()["request_id"])
+        except (ValueError, KeyError, TypeError) as e:
+            self._json(400, {"type": "error", "message": str(e)})
+            return
+        waiter = self.serve.imported_waiter(rid)
+        if waiter is None:
+            self._json(404, {"type": "error",
+                             "message": f"no imported session {rid!r}"})
+            return
+        if not waiter.event.wait(self.serve.request_timeout_s):
+            self._json(504, {"type": "error",
+                             "message": f"{rid}: still decoding after "
+                             f"{self.serve.request_timeout_s}s"})
+            return
+        if waiter.fatal or waiter.error is not None:
+            self._json(503 if waiter.fatal else 400,
+                       {"type": "error", "message": waiter.error})
+            return
+        assert waiter.result is not None
+        self.serve.forget_imported(rid)
+        self._json(200, _finished_body(waiter.result))
+
+    def _resume(self) -> None:
+        try:
+            rid = str(self._json_body()["request_id"])
+        except (ValueError, KeyError, TypeError) as e:
+            self._json(400, {"type": "error", "message": str(e)})
+            return
+        try:
+            done = self.serve.resume(rid)
+        except MigrationError as e:
+            self._json(404, {"type": "error", "message": str(e)})
+            return
+        except TimeoutError as e:
+            self._json(504, {"type": "error", "message": str(e)})
+            return
+        except RuntimeError as e:
+            self._json(503, {"type": "error", "message": str(e)})
+            return
+        self._json(200, _finished_body(done))
+
+
+def _finished_body(done: FinishedRequest) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        "request_id": done.request_id,
+        "tokens": done.tokens,
+        "prompt_len": done.prompt_len,
+        "finish_reason": done.finish_reason,
+        "ttft_s": done.ttft,
+        "tpot_s": done.tpot,
+        "preemptions": done.preemptions,
+    }
+    if done.migrated_to is not None:
+        body["migrated_to"] = done.migrated_to
+        body["dest_request_id"] = done.dest_request_id
+    if done.trace_id is not None:
+        # The per-phase latency attribution rides the response: the
+        # phases sum to e2e_s exactly (the evidence-gate pin).
+        body["trace_id"] = done.trace_id
+        body["phases"] = done.phases
+        body["e2e_s"] = done.finished_at - done.submitted_at
+        if done.spec is not None:
+            body["spec"] = done.spec
+    return body
 
 
 class ServeHTTPServer:
@@ -194,6 +345,12 @@ class ServeHTTPServer:
         self.request_timeout_s = request_timeout_s
         self._inbox: "queue.Queue[Tuple[Request, _Waiter]]" = queue.Queue()
         self._waiters: Dict[str, _Waiter] = {}
+        # Migration control closures for the engine loop, and the
+        # waiters /await blocks on for imported sessions (resolved by
+        # the loop's ordinary finish resolution, like any request).
+        self._ops: "queue.Queue[Tuple[Callable[[], Any], _OpResult]]" = (
+            queue.Queue())
+        self._imported: Dict[str, _Waiter] = {}
         self._id_lock = threading.Lock()
         self._next_id = 0
         self._stop = threading.Event()
@@ -205,10 +362,14 @@ class ServeHTTPServer:
         self._http_thread: Optional[threading.Thread] = None
 
     # ----------------------------------------------------- handler side
-    def generate(self, tokens, **opts) -> FinishedRequest:
+    def _mint_id(self, prefix: str = "req") -> str:
         with self._id_lock:
-            rid = f"req-{self._next_id}"
+            rid = f"{prefix}-{self._next_id}"
             self._next_id += 1
+        return rid
+
+    def generate(self, tokens, **opts) -> FinishedRequest:
+        rid = self._mint_id()
         request = Request(request_id=rid, tokens=list(tokens), **{
             "max_new_tokens": opts.get("max_new_tokens", 16),
             "temperature": opts.get("temperature", 0.0),
@@ -217,6 +378,7 @@ class ServeHTTPServer:
             "eos_id": opts.get("eos_id"),
             "seed": opts.get("seed", 0),
             "trace_id": opts.get("trace_id"),
+            "handoff": opts.get("handoff", False),
         })
         # Fail fast off-loop; the loop's own submit re-validates.
         self.engine.validate_request(request)
@@ -242,6 +404,145 @@ class ServeHTTPServer:
         """Why the engine loop died, or None while it is healthy."""
         return self._loop_error
 
+    # ------------------------------------------------------- migration
+    def _op(self, fn: Callable[[], Any]) -> Any:
+        """Run ``fn`` on the engine-loop thread (the engine's single
+        owner) and return its result, re-raising its exception here so
+        typed MigrationErrors keep their meaning across the marshal."""
+        if self._loop_error is not None:
+            raise RuntimeError(f"engine loop died: {self._loop_error}")
+        box = _OpResult()
+        self._ops.put((fn, box))
+        if not box.event.wait(self.request_timeout_s):
+            if self._loop_error is not None:
+                raise RuntimeError(
+                    f"engine loop died: {self._loop_error}")
+            raise TimeoutError(
+                f"engine loop did not service the migration op within "
+                f"{self.request_timeout_s}s")
+        if box.exc is not None:
+            raise box.exc
+        return box.value
+
+    def migrate_out(self, request_id: str, dest: str,
+                    reason: str) -> Dict[str, Any]:
+        """Pack → ship → release (or resume). The engine calls run on
+        the loop; the outbound POST of the already-packed bytes runs on
+        THIS handler thread with no lock held — a slow or dead
+        destination stalls only this transfer, never the scheduler."""
+        def _export() -> Tuple[bytes, Optional[str]]:
+            blob = self.engine.export_session(request_id, reason)
+            return blob, self.engine.parked[request_id].request.trace_id
+
+        blob, trace_id = self._op(_export)
+        headers = {"Content-Type": "application/octet-stream",
+                   "X-TK8S-Migrate-Reason": reason}
+        if trace_id is not None:
+            headers[TRACE_HEADER] = trace_id
+        req = urllib.request.Request(
+            dest.rstrip("/") + "/migrate/in", data=blob,
+            headers=headers, method="POST")
+        dest_rid, err = None, None
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as resp:
+                dest_rid = json.loads(resp.read()).get("request_id")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = e.read().decode("utf-8", "replace")[:200]
+            except Exception:
+                detail = ""
+            err = f"destination refused import: HTTP {e.code} {detail}"
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            err = f"transfer failed: {e}"
+        if err is not None:
+            metrics.counter("tk8s_serve_migrations_total").inc(
+                direction="out", reason=reason, status="error",
+                exemplar=trace_id)
+            resumed = self._op(lambda: self._recover(request_id))
+            return {"type": "error", "error": err,
+                    "request_id": request_id, "resumed": resumed}
+
+        def _release() -> int:
+            done = self.engine.release_session(request_id)
+            if done is not None:
+                # Drain/rebalance: the original /generate client is
+                # still blocked — it gets finish_reason "migrated"
+                # plus the forwarding address, so the router can
+                # follow the session and return the full stream.
+                done.migrated_to = dest.rstrip("/")
+                done.dest_request_id = dest_rid
+                waiter = self._waiters.pop(request_id, None)
+                if waiter is not None:
+                    waiter.result = done
+                    waiter.event.set()
+            return len(blob)
+
+        self._op(_release)
+        return {"request_id": request_id, "dest_request_id": dest_rid,
+                "bytes": len(blob)}
+
+    def _recover(self, request_id: str) -> bool:
+        """Loop-side failure recovery: a drained session resumes at
+        once (its original client is still waiting); a handed-off one
+        — whose client was already answered — stays parked for an
+        explicit /resume, which is where its remaining tokens land."""
+        seq = self.engine.parked.get(request_id)
+        if seq is None:
+            return False
+        if seq.handed_off:
+            return False
+        self.engine.resume_session(request_id)
+        return True
+
+    def migrate_in(self, payload: bytes, reason: str) -> Dict[str, Any]:
+        """Install a shipped session under a locally-minted id and
+        register the waiter /await blocks on."""
+        rid = self._mint_id("mig")
+        waiter = _Waiter()
+
+        def _import() -> None:
+            self.engine.import_session(payload, request_id=rid,
+                                       reason=reason)
+            self._waiters[rid] = waiter
+            self._imported[rid] = waiter
+
+        self._op(_import)
+        return {"request_id": rid, "bytes": len(payload)}
+
+    def imported_waiter(self, request_id: str) -> Optional[_Waiter]:
+        return self._imported.get(request_id)
+
+    def forget_imported(self, request_id: str) -> None:
+        self._imported.pop(request_id, None)
+
+    def resume(self, request_id: str) -> FinishedRequest:
+        """Un-park a handed-off session and block until it finishes
+        HERE — the failed-transfer fallback: the caller (router) gets
+        the same /generate-shaped completion the destination would
+        have produced."""
+        waiter = _Waiter()
+
+        def _go() -> None:
+            if request_id in self._waiters:
+                raise MigrationError(
+                    f"session {request_id!r} has a live client and "
+                    f"resumes automatically")
+            self.engine.resume_session(request_id)
+            self._waiters[request_id] = waiter
+
+        self._op(_go)
+        if not waiter.event.wait(self.request_timeout_s):
+            raise TimeoutError(
+                f"{request_id}: no completion within "
+                f"{self.request_timeout_s}s of resume")
+        if waiter.fatal:
+            raise RuntimeError(waiter.error or "engine loop died")
+        if waiter.error is not None:
+            raise MigrationError(waiter.error)
+        assert waiter.result is not None
+        return waiter.result
+
     # ------------------------------------------------------- engine loop
     def _loop(self) -> None:
         try:
@@ -265,6 +566,19 @@ class ServeHTTPServer:
                         item = self._inbox.get_nowait()
                     except queue.Empty:
                         item = None
+                # Migration control ops run between steps, on the
+                # engine's owning thread — export/import/release never
+                # race a tick.
+                while True:
+                    try:
+                        fn, box = self._ops.get_nowait()
+                    except queue.Empty:
+                        break
+                    try:
+                        box.value = fn()
+                    except Exception as e:
+                        box.exc = e
+                    box.event.set()
                 if self.engine.has_work:
                     for done in self.engine.step():
                         waiter = self._waiters.pop(done.request_id, None)
@@ -301,6 +615,13 @@ class ServeHTTPServer:
                 break
             waiter.error, waiter.fatal = msg, True
             waiter.event.set()
+        while True:
+            try:
+                _, box = self._ops.get_nowait()
+            except queue.Empty:
+                break
+            box.exc = RuntimeError(msg)
+            box.event.set()
 
     # ---------------------------------------------------------- lifecycle
     @property

@@ -27,6 +27,7 @@ from .labels import (
 )
 from .jobset import render_headless_service, render_jobset
 from .serving import (
+    render_disaggregated_deployments,
     render_operator_deployment,
     render_operator_service,
     render_router_deployment,
@@ -45,6 +46,7 @@ __all__ = [
     "default_topology",
     "host_labels_for_slice",
     "parse_accelerator",
+    "render_disaggregated_deployments",
     "render_headless_service",
     "render_jobset",
     "render_operator_deployment",

@@ -31,13 +31,23 @@ from ..constants import OPERATOR_PORT, ROUTE_PORT, SERVE_PORT
 APP_LABEL = "serve.tk8s.io/name"
 MODEL_LABEL = "serve.tk8s.io/model"
 ROLE_LABEL = "serve.tk8s.io/role"
+# Disaggregated serving (docs/guide/serving.md §Disaggregation): which
+# phase pool a replica belongs to — "prefill", "decode", or "colocated"
+# (the classic both-phases replica). The router's two rings select
+# endpoints by this label's Deployments.
+POOL_LABEL = "serve.tk8s.io/pool"
+POOLS = ("colocated", "prefill", "decode")
 
 
-def default_serve_command(model: str, port: int = SERVE_PORT) -> List[str]:
+def default_serve_command(model: str, port: int = SERVE_PORT,
+                          pool: str = "colocated") -> List[str]:
     """The container command the image contract expects: the CLI's
     ``serve`` verb, bound to all interfaces for the pod network."""
-    return ["triton-kubernetes-tpu", "serve", "--model", model,
-            "--serve-host", "0.0.0.0", "--port", str(port)]
+    cmd = ["triton-kubernetes-tpu", "serve", "--model", model,
+           "--serve-host", "0.0.0.0", "--port", str(port)]
+    if pool != "colocated":
+        cmd += ["--pool", pool]
+    return cmd
 
 
 def render_serving_deployment(
@@ -50,6 +60,7 @@ def render_serving_deployment(
     namespace: str = "default",
     env: Optional[Dict[str, str]] = None,
     command: Optional[List[str]] = None,
+    pool: str = "colocated",
 ) -> Dict[str, Any]:
     """A Deployment of serving replicas on one labeled TPU pool.
 
@@ -57,13 +68,17 @@ def render_serving_deployment(
     chips (serving scales out in replicas behind the Service, not in
     slice-wide collectives), so the natural pool is a single-host slice
     shape like v5e-8; multi-host specs still render — each pod takes one
-    host's chips.
+    host's chips. ``pool`` stamps the disaggregation phase label
+    ("prefill"/"decode" replicas refuse the other phase's work;
+    "colocated" runs both).
     """
-    labels = {APP_LABEL: name, MODEL_LABEL: model}
+    if pool not in POOLS:
+        raise ValueError(f"pool must be one of {POOLS}, got {pool!r}")
+    labels = {APP_LABEL: name, MODEL_LABEL: model, POOL_LABEL: pool}
     container = {
         "name": "server",
         "image": image,
-        "command": command or default_serve_command(model),
+        "command": command or default_serve_command(model, pool=pool),
         "env": [{"name": k, "value": v} for k, v in sorted(
             (env or {}).items())],
         "ports": [{"containerPort": SERVE_PORT, "name": "http"}],
@@ -99,6 +114,39 @@ def render_serving_deployment(
     }
 
 
+def render_disaggregated_deployments(
+    name: str,
+    spec: SliceSpec,
+    slice_id: str,
+    image: str,
+    model: str,
+    prefill_replicas: int = 1,
+    decode_replicas: int = 1,
+    namespace: str = "default",
+    env: Optional[Dict[str, str]] = None,
+) -> List[Dict[str, Any]]:
+    """The disaggregated pair: ``{name}-prefill`` and ``{name}-decode``
+    Deployments on the same labeled TPU pool, distinguished by the
+    POOL_LABEL their pods carry and the ``--pool`` flag their servers
+    run with. Front them with two headless Services (one per
+    Deployment) and a router built with ``--decode-replica`` endpoints
+    — sessions then prefill on one pool and migrate their KV pages to
+    the other for the decode tail (docs/guide/serving.md
+    §Disaggregation). Scale the pools independently: prefill replicas
+    track *arrival* rate, decode replicas track *resident sessions*.
+    """
+    return [
+        render_serving_deployment(
+            f"{name}-prefill", spec, slice_id, image, model,
+            replicas=prefill_replicas, namespace=namespace, env=env,
+            pool="prefill"),
+        render_serving_deployment(
+            f"{name}-decode", spec, slice_id, image, model,
+            replicas=decode_replicas, namespace=namespace, env=env,
+            pool="decode"),
+    ]
+
+
 def render_serving_service(
     name: str,
     namespace: str = "default",
@@ -132,13 +180,19 @@ def render_serving_service(
 
 
 def default_route_command(replica_urls: List[str],
-                          port: int = ROUTE_PORT) -> List[str]:
+                          port: int = ROUTE_PORT,
+                          decode_urls: Optional[List[str]] = None,
+                          ) -> List[str]:
     """The router container command: the CLI's ``route`` verb bound to
-    all interfaces, one ``--replica`` per serving endpoint."""
+    all interfaces, one ``--replica`` per serving endpoint (and one
+    ``--decode-replica`` per decode-pool endpoint in disaggregated
+    mode, where ``--replica`` names the prefill pool)."""
     cmd = ["triton-kubernetes-tpu", "route",
            "--route-host", "0.0.0.0", "--port", str(port)]
     for url in replica_urls:
         cmd += ["--replica", url]
+    for url in decode_urls or []:
+        cmd += ["--decode-replica", url]
     return cmd
 
 
@@ -150,6 +204,7 @@ def render_router_deployment(
     namespace: str = "default",
     env: Optional[Dict[str, str]] = None,
     command: Optional[List[str]] = None,
+    decode_urls: Optional[List[str]] = None,
 ) -> Dict[str, Any]:
     """The router Deployment beside the replica set.
 
@@ -166,7 +221,8 @@ def render_router_deployment(
     container = {
         "name": "router",
         "image": image,
-        "command": command or default_route_command(replica_urls),
+        "command": command or default_route_command(
+            replica_urls, decode_urls=decode_urls),
         "env": [{"name": k, "value": v} for k, v in sorted(
             (env or {}).items())],
         "ports": [{"containerPort": ROUTE_PORT, "name": "http"}],

@@ -237,6 +237,20 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "gauge", "Tokens emitted per decoding sequence by the most "
         "recent verify step (1.0 = plain-decode pace, up to spec_k + 1 "
         "when every draft accepts)", (), None),
+    "tk8s_serve_migrations_total": (
+        "counter", "KV-page session migrations by direction (out = "
+        "packed and shipped, in = unpacked into the local pool), reason "
+        "(handoff = prefill->decode disaggregation, drain / rebalance = "
+        "operator actuation), and status (ok, torn = digest rejected a "
+        "damaged payload, error = ship/import failed); exemplar-linked "
+        "to the migrated session's trace id", ("direction", "reason",
+        "status"), None),
+    "tk8s_serve_migration_bytes_total": (
+        "counter", "Serialized bytes shipped (direction=out) or "
+        "accepted (direction=in) by KV-page session migration — raw "
+        "quantized pages ship as-is, so int8/fp8 pools move ~4x/~2x "
+        "fewer bytes than bf16/f32; exemplar-linked to the migrated "
+        "session's trace id", ("direction",), None),
     # --------------------------------------------- serve/router.py
     "tk8s_route_requests_total": (
         "counter", "Requests the router placed, by replica and routing "
@@ -274,6 +288,11 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "at-max, at-min, hysteresis, no-signal, repair-first, "
         "nothing-drainable)",
         ("direction", "reason"), None),
+    "tk8s_operator_rebalances_total": (
+        "counter", "KV-pressure rebalance actuations between serving "
+        "replicas (migrate one session from the most- to the "
+        "least-pressured replica), by status (ok / failed)",
+        ("status",), None),
     "tk8s_operator_slo_attainment": (
         "gauge", "Fraction of recent reconcile ticks (sliding window) "
         "whose observed serving signal met the SLO, by slo "
@@ -368,13 +387,35 @@ class Counter(_Metric):
 
     kind = "counter"
 
-    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 lock: threading.RLock,
+                 defaults: Optional[Dict[str, str]] = None):
+        super().__init__(name, help, labelnames, lock, defaults)
+        # series key -> last exemplar (OpenMetrics counter semantics:
+        # at most one exemplar per sample, last-writer-wins).
+        self._exemplars: Dict[Tuple[str, ...], Dict[str, Any]] = {}
+
+    def inc(self, amount: float = 1.0,
+            exemplar: Optional[str] = None, **labels: Any) -> None:
+        """Add ``amount``; an ``exemplar`` (a trace id) is pinned to the
+        series, last-writer-wins — the link from a rate spike back to
+        the concrete request trace that drove it (e.g. a slow KV
+        migration resolves to its handoff trace)."""
         if amount < 0:
             raise ValueError(f"counter {self.name!r} cannot decrease "
                              f"(inc by {amount})")
         key = self._key(labels)
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + amount
+            if exemplar is not None:
+                self._exemplars[key] = {"trace_id": str(exemplar),
+                                        "value": float(amount)}
+
+    def exemplar(self, **labels: Any) -> Optional[Dict[str, Any]]:
+        """The last exemplar recorded for one series (or None)."""
+        with self._lock:
+            ex = self._exemplars.get(self._key(labels))
+            return dict(ex) if ex is not None else None
 
     def value(self, **labels: Any) -> float:
         with self._lock:
@@ -704,9 +745,15 @@ class MetricsRegistry:
                 for s in fam.samples():
                     suffix = fam._label_str(
                         tuple(s["labels"][n] for n in fam.labelnames))
-                    lines.append(
-                        f"{sample_name}{suffix} "
-                        f"{_format_value(s['value'])}")
+                    line = (f"{sample_name}{suffix} "
+                            f"{_format_value(s['value'])}")
+                    if isinstance(fam, Counter):
+                        ex = fam.exemplar(**s["labels"])
+                        if ex is not None:
+                            line += (f' # {{trace_id="'
+                                     f'{_escape_label(ex["trace_id"])}"}} '
+                                     f'{_format_value(ex["value"])}')
+                    lines.append(line)
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 

@@ -87,12 +87,18 @@ SPAN_CATALOG: Dict[str, str] = {
                      "recompute",
     "serve.verify": "one speculative verify pass for this request "
                     "(proposed/accepted fields)",
-    "serve.finish": "request completed (reason field: eos/length)",
+    "serve.finish": "request completed (reason field: "
+                    "eos/length/handoff/migrated)",
+    "serve.migrate_out": "a session's KV pages packed and shipped to "
+                         "another replica (bytes/pages/dest/reason "
+                         "fields)",
+    "serve.migrate_in": "a shipped session unpacked into this replica's "
+                        "pool (bytes/pages/reused_pages/reason fields)",
     "serve.abort": "engine loop died with the request in flight; "
                    "lifecycle flushed post-mortem",
     "serve.phase": "one attributed latency segment (state field: "
-                   "queue/prefill/decode/recompute) — segments tile "
-                   "submit..finish exactly",
+                   "queue/prefill/decode/recompute/migrate_out/"
+                   "migrate_in) — segments tile submit..finish exactly",
     "serve.step": "one engine scheduler tick (finished-count field)",
     "route.place": "router placed a request on a replica (replica, "
                    "reason=affine/spill/eject, status fields)",
@@ -103,6 +109,9 @@ SPAN_CATALOG: Dict[str, str] = {
                      "field)",
     "operator.scale": "autoscaler actuation (direction/reason/pools "
                       "fields)",
+    "operator.rebalance": "KV-pressure rebalance actuation between two "
+                          "serving replicas (source/target/gap/status "
+                          "fields)",
     "serve.goodput": "one process-level chip-time segment (category "
                      "field) — segments tile the engine's recorded "
                      "window exactly",
@@ -127,7 +136,8 @@ SPAN_CATALOG: Dict[str, str] = {
 
 #: Scheduling states a request moves through; phase keys are what the
 #: breakdown dict carries (`<state>_s`).
-PHASE_STATES = ("queue", "prefill", "decode", "recompute")
+PHASE_STATES = ("queue", "prefill", "decode", "recompute",
+                "migrate_out", "migrate_in")
 
 # Lifecycle events that unconditionally move the request to a new
 # scheduling state ("serve.admitted" is handled separately: it lands in
@@ -137,6 +147,8 @@ _EVENT_STATE = {
     "serve.preempt": "queue",
     "serve.first_token": "decode",
     "serve.resume": "decode",
+    "serve.migrate_out": "migrate_out",
+    "serve.migrate_in": "migrate_in",
 }
 
 #: The goodput counter family every accelerator-owning process ticks —
@@ -151,7 +163,8 @@ GOODPUT_FAMILY = "tk8s_goodput_seconds_total"
 #: category table in docs/guide/observability.md agreeing (the TK8S111
 #: pattern applied to the goodput ledger).
 GOODPUT_CATEGORIES: Dict[str, Tuple[str, ...]] = {
-    "serve": ("prefill", "decode", "verify", "recompute", "idle"),
+    "serve": ("prefill", "decode", "verify", "recompute",
+              "migrate_out", "migrate_in", "idle"),
     "train": ("step", "compile", "data_wait", "host_sync", "checkpoint",
               "rollback_replay", "preempted_lost", "idle"),
     "route": ("forward", "idle"),
@@ -344,7 +357,7 @@ class TraceWriter:
 @dataclass
 class RequestTrace:
     """One request's recorded lifecycle. ``phases`` partitions the
-    request's whole lifetime — the four keys sum to ``finished_at -
+    request's whole lifetime — the keys sum to ``finished_at -
     submitted_at`` exactly (each transition closes the previous
     segment at the same timestamp the next one opens)."""
 
@@ -355,7 +368,7 @@ class RequestTrace:
     state_since: float = 0.0
     phases: Dict[str, float] = field(default_factory=lambda: {
         "queue_s": 0.0, "prefill_s": 0.0, "decode_s": 0.0,
-        "recompute_s": 0.0})
+        "recompute_s": 0.0, "migrate_out_s": 0.0, "migrate_in_s": 0.0})
     segments: List[Tuple[str, float, float]] = field(default_factory=list)
     events: List[Dict[str, Any]] = field(default_factory=list)
     events_dropped: int = 0
@@ -431,6 +444,17 @@ class FlightRecorder:
         if rec is None:
             return
         self._record(rec, name, at, fields)
+
+    def migration(self, name: str, at: float, dur_s: float = 0.0, *,
+                  trace: Optional[str] = None,
+                  request: Optional[str] = None, **fields: Any) -> None:
+        """Writer-only migration span. A handed-off session's recorded
+        lifecycle already closed at its ``finish(..., "handoff")``, so
+        the pack/ship that follows cannot ride :meth:`event` (the live
+        record is gone) — it lands directly on the trace file."""
+        if self.writer is not None:
+            self.writer.event(name, at, dur_s, trace=trace,
+                              request=request, **fields)
 
     def finish(self, request_id: str, at: float,
                outcome: str) -> Optional[RequestTrace]:
