@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""Produce the elastic-reshaping evidence artifact: the 8→4→8 storyline
+with the OPERATOR in the driver's seat, journaled to
+docs/ci-evidence/elastic-<tag>.json.
+
+One run, three operator-actuated fleet shapes:
+
+1. **replace** — the train fleet is down (nothing launched yet), full
+   capacity: the reconcile loop's train-fleet policy decides
+   `replace-lost` and its actuator launches 2 processes × 4 virtual
+   devices (the 8-chip fleet). Training checkpoints (manifest format 2:
+   the mesh shape rides INSIDE the checkpoint) and runs to the phase
+   boundary, where the harness declares the slice lost.
+2. **shrink-instead-of-wait** — capacity for only 1 worker survives:
+   the policy decides `shrink` and the actuator relaunches 1 process ×
+   4 devices with `--resume --elastic`. The trainer peeks the newest
+   manifest, negotiates data=1 over the recorded ICI block, re-places
+   every leaf, replays the stream from the step index, and books the
+   restore as the `reshard` goodput category with a `train.reshard`
+   span (8 → 4 devices).
+3. **regrow** — capacity returns while the shrunk job runs degraded
+   and serving is calm: the policy decides `regrow` and the actuator
+   relaunches 2 × 4 with `--resume --elastic` (4 → 8 devices) to the
+   final step target.
+
+Gates: the operator's tick journal must carry exactly the
+replace → shrink → regrow → hold(converged) decision sequence with
+every actuation landed; each elastic phase must report the negotiated
+reshard (8→4 then 4→8) at the expected resume step; both reshard
+windows must appear on the trainers' trace JSONL as `train.reshard`
+events AND as `train.goodput` spans with `category=reshard`; and the
+stitched per-step loss trajectory must match an uninterrupted 8-chip
+reference of the identical workload within LOSS_RTOL — elastic
+recovery changes the fleet, not the math.
+
+Environments that cannot host cross-process CPU collectives skip
+LOUDLY: the journal records the typed reason and the script exits 0.
+
+Usage: JAX_PLATFORMS=cpu python scripts/ci/elastic_evidence.py [tag]
+"""
+
+import glob
+import json
+import os
+import shutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+STEPS_PHASE1 = 4    # 8 chips until the "slice loss"
+STEPS_PHASE2 = 8    # 4 chips, degraded
+STEPS_TOTAL = 12    # back on 8 chips to the target
+DEVICES_PER_PROC = 4
+#: Pinned trajectory tolerance: restores snapshot the state bit-exactly,
+#: so drift only accumulates within a phase from reduction-order changes
+#: across mesh shapes (measured ~1e-6 relative on f32; margin for BLAS).
+LOSS_RTOL = 5e-4
+WORKLOAD = ["--model", "llama-test", "--batch-size", "16",
+            "--seq-len", "32", "--sync-every", "2", "--log-every", "2",
+            "--checkpoint-every", "2", "--prefetch", "2"]
+
+
+def main(argv):
+    tag = argv[1] if len(argv) > 1 else "local"
+    repo = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir))
+    out_path = os.path.join(repo, "docs", "ci-evidence",
+                            f"elastic-{tag}.json")
+    workdir = os.path.join(repo, "docs", "ci-evidence",
+                           f".elastic-work-{tag}")
+    shutil.rmtree(workdir, ignore_errors=True)  # stale runs poison evidence
+
+    from triton_kubernetes_tpu.parallel.multihost import (
+        launch_trainers, support_report)
+
+    journal = {"tag": tag, "workload": WORKLOAD,
+               "storyline": {"phase1_steps": STEPS_PHASE1,
+                             "phase2_steps": STEPS_PHASE2,
+                             "total_steps": STEPS_TOTAL,
+                             "devices_per_process": DEVICES_PER_PROC},
+               "loss_rtol": LOSS_RTOL, "support": support_report()}
+
+    def emit(status):
+        journal["status"] = status
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(journal, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if not journal["support"]["ok"]:
+        emit(f"skipped:{journal['support']['reason']}")
+        shutil.rmtree(workdir, ignore_errors=True)
+        print(f"wrote {out_path} (SKIPPED: {journal['support']['detail']})")
+        return 0
+
+    def gate(ok, label, msg):
+        """A failed gate still writes the journal — the measured
+        numbers that explain the failure ARE the evidence."""
+        if not ok:
+            emit(f"failed:{label}")
+            raise SystemExit(f"gate {label!r} failed "
+                             f"(journal: {out_path}): {msg}")
+
+    from triton_kubernetes_tpu.operator import (
+        TrainFleetConfig, TrainFleetPolicy, file_train_status)
+    from triton_kubernetes_tpu.operator.loop import Reconciler
+    from triton_kubernetes_tpu.backends import MemoryBackend
+    from triton_kubernetes_tpu.executor import LocalExecutor
+    from triton_kubernetes_tpu.executor.dagspec import document_from_spec
+    from triton_kubernetes_tpu.utils.logging import Logger
+    import io
+
+    ckpt = os.path.join(workdir, "ckpt")
+    cache = os.path.join(workdir, "compile-cache")
+    status_path = os.path.join(workdir, "train-status.json")
+
+    def set_status(**doc):
+        os.makedirs(workdir, exist_ok=True)
+        with open(status_path, "w") as f:
+            json.dump(doc, f)
+
+    # ---- the actuation seam: a local launch_trainers relaunch at the
+    # decided worker count. Phase boundaries come from per-phase step
+    # targets (the "slice loss" is the harness's narration; the
+    # trainer's --resume --elastic path neither knows nor cares).
+    phase_plan = iter([
+        ("replace", 2, STEPS_PHASE1, False),
+        ("shrink", 1, STEPS_PHASE2, True),
+        ("regrow", 2, STEPS_TOTAL, True),
+    ])
+    reports = []
+
+    def actuator(decision):
+        expect_dir, workers, steps, elastic = next(phase_plan)
+        if decision.direction != expect_dir or \
+                decision.workers != workers:
+            return {"status": "failed",
+                    "error": f"unexpected decision {decision.to_dict()}, "
+                             f"storyline wanted {expect_dir}@{workers}"}
+        idx = len(reports) + 1
+        run_dir = os.path.join(workdir, f"phase{idx}-{workers}x"
+                                        f"{DEVICES_PER_PROC}")
+        args = WORKLOAD + [
+            "--steps", str(steps), "--checkpoint-dir", ckpt,
+            "--compile-cache-dir", cache,
+            "--trace-jsonl", os.path.join(run_dir, "trace.jsonl")]
+        if elastic:
+            args += ["--resume", "--elastic"]
+        rep = launch_trainers(
+            args, n_processes=workers,
+            devices_per_process=DEVICES_PER_PROC, run_dir=run_dir,
+            tag=f"elastic-{tag}-p{idx}", timeout=300)
+        reports.append((run_dir, rep))
+        if not rep.ok or rep.report is None:
+            tails = "\n".join(f"worker {w.process_id} rc={w.returncode}:\n"
+                              f"{w.tail}" for w in rep.workers)
+            return {"status": "failed", "error": tails[-2000:]}
+        return {"status": "ok", "run_dir": run_dir,
+                "workers": decision.workers}
+
+    topo = {"manager": {"provider": "bare-metal", "name": "m1"},
+            "clusters": []}
+    doc = document_from_spec(topo, f"elastic-{tag}")
+    backend = MemoryBackend()
+    backend.persist(doc)
+    rec = Reconciler(
+        backend,
+        LocalExecutor(log=lambda m: None,
+                      logger=Logger(stream=io.StringIO())),
+        f"elastic-{tag}",
+        clock=(lambda c=iter(range(1, 1000)): float(next(c))),
+        sleep=lambda s: None, log=lambda m: None,
+        train_policy=TrainFleetPolicy(TrainFleetConfig(
+            desired_workers=2, min_workers=1, regrow_cooldown_s=0.0)),
+        train_status=file_train_status(status_path),
+        train_actuator=actuator)
+
+    # Tick 1: fleet down, full capacity -> replace @ 2 (fresh launch).
+    set_status(running_workers=0, capacity_workers=2, step=0,
+               target_step=STEPS_TOTAL)
+    t1 = rec.tick()
+    # Tick 2: slice lost, 1 worker's capacity survives -> shrink @ 1.
+    set_status(running_workers=0, capacity_workers=1, step=STEPS_PHASE1,
+               target_step=STEPS_TOTAL)
+    t2 = rec.tick()
+    # Tick 3: capacity back while the shrunk job runs -> regrow @ 2.
+    set_status(running_workers=1, capacity_workers=2, step=STEPS_PHASE2,
+               target_step=STEPS_TOTAL)
+    t3 = rec.tick()
+    # Tick 4: converged -> hold, no actuation.
+    set_status(running_workers=2, capacity_workers=2, step=STEPS_TOTAL,
+               target_step=STEPS_TOTAL, done=True)
+    t4 = rec.tick()
+
+    journal["operator"] = {"ticks": [t.to_dict() for t in
+                                     (t1, t2, t3, t4)]}
+    decisions = [(t.train_decision or {}).get("direction")
+                 for t in (t1, t2, t3, t4)]
+    reasons = [(t.train_decision or {}).get("reason")
+               for t in (t1, t2, t3, t4)]
+    gate(decisions == ["replace", "shrink", "regrow", "hold"],
+         "decision-sequence", list(zip(decisions, reasons)))
+    gate(reasons[:3] == ["replace-lost", "shrink-instead-of-wait",
+                         "regrow"] and reasons[3] in ("done", "converged"),
+         "decision-reasons", reasons)
+    for t in (t1, t2, t3):
+        acts = [a for a in t.actions if a.get("rule") == "train-resize"]
+        gate(len(acts) == 1 and acts[0]["ok"], "actuation-journaled",
+             (t.tick, t.actions))
+    gate(not [a for a in t4.actions if a.get("rule") == "train-resize"],
+         "hold-does-not-actuate", t4.actions)
+
+    # ---- the trainers' own story: negotiated reshards at the resume
+    # steps, both directions.
+    gate(len(reports) == 3, "three-phases", len(reports))
+    phase_reports = [rep.report for _, rep in reports]
+    journal["phases"] = phase_reports
+    r1, r2, r3 = phase_reports
+    gate(r1["reshard"] is None and not r1["elastic"], "phase1-fresh", r1)
+    gate(r2["elastic"] and r2["reshard"] is not None, "phase2-elastic", r2)
+    gate((r2["reshard"]["from_devices"], r2["reshard"]["to_devices"]) ==
+         (2 * DEVICES_PER_PROC, DEVICES_PER_PROC) and
+         r2["reshard"]["step"] == STEPS_PHASE1,
+         "phase2-reshard-8to4", r2["reshard"])
+    gate(r3["elastic"] and r3["reshard"] is not None, "phase3-elastic", r3)
+    gate((r3["reshard"]["from_devices"], r3["reshard"]["to_devices"]) ==
+         (DEVICES_PER_PROC, 2 * DEVICES_PER_PROC) and
+         r3["reshard"]["step"] == STEPS_PHASE2,
+         "phase3-reshard-4to8", r3["reshard"])
+
+    # ---- the ledger's story: each elastic phase booked a train.reshard
+    # event and a reshard-category goodput segment on its trace JSONL.
+    def trace_lines(run_dir):
+        lines = []
+        # single-process: trace.jsonl; distributed: trace.rank{N}.jsonl
+        for path in sorted(glob.glob(os.path.join(run_dir, "trace*.jsonl"))):
+            with open(path) as f:
+                lines += [json.loads(ln) for ln in f if ln.strip()]
+        return lines
+
+    reshard_ledger = {}
+    for idx, (run_dir, _) in enumerate(reports[1:], start=2):
+        lines = trace_lines(run_dir)
+        events = [ln for ln in lines if ln.get("name") == "train.reshard"]
+        segs = [ln for ln in lines if ln.get("name") == "train.goodput"
+                and ln.get("fields", {}).get("category") == "reshard"]
+        reshard_ledger[f"phase{idx}"] = {
+            "reshard_events": len(events),
+            "reshard_goodput_segments": len(segs),
+            "reshard_seconds": round(sum(float(s.get("dur_s", 0.0))
+                                         for s in segs), 6),
+        }
+        gate(events, f"phase{idx}-reshard-span", f"no train.reshard "
+             f"event on the phase {idx} trace ({len(lines)} spans)")
+        gate(segs and all(float(s.get("dur_s", 0.0)) > 0 for s in segs),
+             f"phase{idx}-reshard-goodput",
+             f"no positive reshard goodput segment on the phase {idx} "
+             f"trace ({len(lines)} spans)")
+    journal["reshard_ledger"] = reshard_ledger
+
+    # ---- the math's story: the stitched trajectory equals an
+    # uninterrupted 8-chip reference of the identical workload.
+    ref = launch_trainers(
+        WORKLOAD + ["--steps", str(STEPS_TOTAL), "--checkpoint-dir",
+                    os.path.join(workdir, "ckpt-ref"),
+                    "--compile-cache-dir", cache],
+        n_processes=2, devices_per_process=DEVICES_PER_PROC,
+        run_dir=os.path.join(workdir, "reference"),
+        tag=f"elastic-{tag}-ref", timeout=300)
+    gate(ref.ok and ref.report is not None, "reference",
+         [w.tail for w in ref.workers])
+    ref_losses = ref.report["losses"]
+    stitched = r1["losses"] + r2["losses"] + r3["losses"]
+    journal["trajectory"] = {"reference": ref_losses,
+                            "stitched": stitched}
+    gate(len(stitched) == len(ref_losses) == STEPS_TOTAL,
+         "trajectory-length", (len(stitched), len(ref_losses)))
+    worst = max(abs(a - b) / max(abs(b), 1e-12)
+                for a, b in zip(stitched, ref_losses))
+    journal["trajectory"]["max_rel_diff"] = worst
+    gate(worst <= LOSS_RTOL, "trajectory-parity",
+         f"stitched 8->4->8 losses diverge from the uninterrupted "
+         f"reference: max rel diff {worst} > {LOSS_RTOL}")
+
+    emit("ok")
+    shutil.rmtree(workdir, ignore_errors=True)  # the journal IS the artifact
+    print(f"wrote {out_path} (operator-driven 8->4->8: decisions "
+          f"{'/'.join(reasons[:3])}, reshards at steps "
+          f"{r2['reshard']['step']} and {r3['reshard']['step']}, "
+          f"trajectory max rel diff {worst:.2e} <= {LOSS_RTOL})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

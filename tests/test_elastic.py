@@ -1,0 +1,430 @@
+"""Elastic mesh reshaping (ISSUE 19): manifest v2 carries the mesh,
+restarts negotiate their shape from what survived, and the operator
+drives the train fleet.
+
+Tier-1 here is deterministic and cheap: manifest round-trips and the
+format-1 compat pin are pure file I/O, shape negotiation is arithmetic,
+re-placement parity moves a real orbax checkpoint across real (virtual
+CPU) meshes with `jax.device_put` only — no train-step compiles. The
+slow-marked test at the bottom runs the whole 8→4 shrink through actual
+trainer subprocesses via `elastic_restart`.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from triton_kubernetes_tpu.train.checkpoint import (
+    MANIFEST_FORMAT,
+    MANIFEST_NAME,
+    CheckpointManager,
+    MeshMismatchError,
+    ReshapeError,
+    _manifest_digest,
+    mesh_spec_of,
+    peek_newest_manifest,
+)
+from triton_kubernetes_tpu.train.resilience import negotiate_mesh_config
+from triton_kubernetes_tpu.utils import metrics as metrics_mod
+
+
+@pytest.fixture()
+def fresh_registry():
+    old = metrics_mod.get_registry()
+    reg = metrics_mod.configure()
+    yield reg
+    metrics_mod.configure(old)
+
+
+SPEC_8 = {"axes": {"data": 2, "stage": 1, "fsdp": 4, "seq": 1,
+                   "expert": 1, "tensor": 1},
+          "n_processes": 2, "n_devices": 8, "global_batch": 16}
+
+
+def _state(step=1, n=16):
+    return {"step": np.asarray(step, np.int32),
+            "w": np.arange(n, dtype=np.float32)}
+
+
+# ------------------------------------------------------- manifest format 2
+
+def test_manifest_v2_records_and_reads_back_the_mesh(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), mesh_spec=dict(SPEC_8))
+    mgr.save(3, _state(3), wait=True)
+    mgr.close()
+    man = mgr.manifest(3)
+    assert man["format"] == MANIFEST_FORMAT == 2
+    assert man["mesh"] == SPEC_8
+    assert mgr.saved_mesh_spec(3) == SPEC_8
+    # The digest covers the mesh section: flipping it must tear the step.
+    mpath = os.path.join(str(tmp_path), "3", MANIFEST_NAME)
+    man["mesh"]["n_devices"] = 4
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+    assert peek_newest_manifest(str(tmp_path)) is None
+
+
+def test_manifest_v2_without_mesh_spec_writes_null_mesh(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(), wait=True)
+    mgr.close()
+    assert mgr.manifest(1)["mesh"] is None
+    assert mgr.saved_mesh_spec(1) is None
+
+
+def test_format1_manifest_still_verifies_restores_and_peeks(tmp_path):
+    """Compat pin: checkpoints from the pre-elastic writer (format 1,
+    no mesh key) verify, restore, and peek unchanged — only the elastic
+    negotiation refuses them (typed, below)."""
+    mgr = CheckpointManager(str(tmp_path), mesh_spec=dict(SPEC_8))
+    mgr.save(2, _state(2), wait=True)
+    mgr.close()
+    # Rewrite the committed manifest as a format-1 writer would have.
+    mpath = os.path.join(str(tmp_path), "2", MANIFEST_NAME)
+    with open(mpath) as f:
+        man = json.load(f)
+    man.pop("mesh")
+    man["format"] = 1
+    man.pop("digest")
+    man["digest"] = _manifest_digest(man)
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+    mgr2 = CheckpointManager(str(tmp_path))
+    mgr2.verify_step(2)  # raises CheckpointIntegrityError if rejected
+    assert mgr2.saved_mesh_spec(2) is None
+    restored = mgr2.restore(_state(0))
+    np.testing.assert_array_equal(restored["w"], _state(2)["w"])
+    step, peeked = peek_newest_manifest(str(tmp_path))
+    assert step == 2 and "mesh" not in peeked
+    mgr2.close()
+
+
+def test_peek_newest_manifest_skips_torn_and_spans_directories(tmp_path):
+    sched, emerg = tmp_path / "sched", tmp_path / "emerg"
+    m1 = CheckpointManager(str(sched), mesh_spec=dict(SPEC_8))
+    m1.save(1, _state(1), wait=True)
+    m1.save(4, _state(4), wait=True)
+    m1.close()
+    m2 = CheckpointManager(str(emerg), mesh_spec=dict(SPEC_8))
+    m2.save(6, _state(6), wait=True)
+    m2.close()
+    step, _ = peek_newest_manifest(str(sched), str(emerg))
+    assert step == 6
+    # Tear the newest: peek falls back across directories, no exception.
+    mpath = os.path.join(str(emerg), "6", MANIFEST_NAME)
+    body = open(mpath).read()
+    with open(mpath, "w") as f:
+        f.write(body[: len(body) // 2])
+    step, man = peek_newest_manifest(str(sched), str(emerg), None)
+    assert step == 4 and man["mesh"] == SPEC_8
+
+
+# ----------------------------------------------------- shape negotiation
+
+def test_negotiate_keeps_ici_block_and_resizes_data():
+    down = negotiate_mesh_config(SPEC_8, n_processes=1, n_devices=4)
+    assert (down.data, down.fsdp, down.stage) == (1, 4, 1)
+    up = negotiate_mesh_config(SPEC_8, n_processes=2, n_devices=8)
+    assert (up.data, up.fsdp) == (2, 4)
+
+
+def test_negotiate_rejects_untileable_fleets_with_typed_error():
+    with pytest.raises(ReshapeError, match="cannot negotiate"):
+        negotiate_mesh_config(SPEC_8, n_processes=1, n_devices=3)
+    # A format-1 manifest carries no axes to negotiate from.
+    with pytest.raises(ReshapeError):
+        negotiate_mesh_config({"n_devices": 8}, n_processes=1,
+                              n_devices=4)
+
+
+# -------------------------------------------- re-placement across meshes
+
+def test_restore_replaces_leaves_onto_negotiated_meshes(cpu_mesh_devices,
+                                                        tmp_path):
+    """The 8→4→8 storyline at the leaf level: a checkpoint saved under
+    data=2×fsdp=4 restores bit-exactly onto the negotiated 4-device
+    mesh, re-saves there, and restores back onto the negotiated
+    8-device mesh — every leaf landing under the target sharding."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from triton_kubernetes_tpu.parallel import create_mesh
+
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    mesh_a = create_mesh(negotiate_mesh_config(SPEC_8, n_processes=1,
+                                               n_devices=8))
+    assert dict(mesh_a.shape)["data"] == 2
+    placed = jax.device_put(w, NamedSharding(mesh_a, P("fsdp", None)))
+    mgr = CheckpointManager(str(tmp_path),
+                            mesh_spec=mesh_spec_of(mesh_a, 1, 16))
+    mgr.save(1, {"w": placed}, wait=True)
+
+    # Shrink: negotiate for the 4 surviving devices from the RECORDED
+    # shape, restore onto the smaller mesh.
+    saved = mgr.saved_mesh_spec(1)
+    cfg_small = negotiate_mesh_config(saved, n_processes=1, n_devices=4)
+    mesh_b = create_mesh(cfg_small, devices=jax.devices()[:4])
+    like_b = jax.device_put(np.zeros_like(w),
+                            NamedSharding(mesh_b, P("fsdp", None)))
+    small = mgr.restore({"w": like_b})
+    assert dict(small["w"].sharding.mesh.shape) == dict(mesh_b.shape)
+    np.testing.assert_array_equal(np.asarray(small["w"]), w)
+
+    # Regrow: a save at the small shape negotiates back up to 8.
+    mgr.mesh_spec = mesh_spec_of(mesh_b, 1, 16)
+    mgr.save(2, small, wait=True)
+    cfg_big = negotiate_mesh_config(mgr.saved_mesh_spec(2),
+                                    n_processes=1, n_devices=8)
+    assert (cfg_big.data, cfg_big.fsdp) == (2, 4)
+    mesh_c = create_mesh(cfg_big)
+    like_c = jax.device_put(np.zeros_like(w),
+                            NamedSharding(mesh_c, P("fsdp", None)))
+    big = mgr.restore({"w": like_c})
+    np.testing.assert_array_equal(np.asarray(big["w"]), w)
+    mgr.close()
+
+
+def test_coordinated_restore_raises_mesh_mismatch_before_barrier(
+        cpu_mesh_devices, tmp_path):
+    """The --elastic-off contract (satellite bugfix): a mesh whose axes
+    cannot divide the saved shapes fails PROACTIVELY with the pinned
+    MeshMismatchError — including through CoordinatedCheckpoint, whose
+    abstract restore tree used to drop the shardings and skip the
+    check entirely."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from triton_kubernetes_tpu.parallel import create_mesh
+    from triton_kubernetes_tpu.parallel.multihost import (
+        CoordinatedCheckpoint)
+
+    w = np.arange(12, dtype=np.float32).reshape(6, 2)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"w": w}, wait=True)
+    mesh = create_mesh(negotiate_mesh_config(SPEC_8, n_processes=1,
+                                             n_devices=8))
+    # device_put itself refuses uneven shards; the restore target can
+    # still carry one as an abstract leaf — exactly what reaches the
+    # managers in production.
+    bad_like = {"w": jax.ShapeDtypeStruct(
+        w.shape, w.dtype,
+        sharding=NamedSharding(mesh, P("fsdp", None)))}
+    with pytest.raises(MeshMismatchError,
+                       match="must divide every sharded dimension"):
+        mgr.restore(bad_like)
+    with pytest.raises(MeshMismatchError,
+                       match="must divide every sharded dimension"):
+        CoordinatedCheckpoint(mgr).restore(bad_like)
+    mgr.close()
+
+
+# ------------------------------------------------------ train-fleet policy
+
+def _status(**kw):
+    from triton_kubernetes_tpu.operator import TrainFleetStatus
+
+    return TrainFleetStatus(**kw)
+
+
+class _Serving:
+    def __init__(self, queue=0.0, ttft=0.0, requests=0, signal=True):
+        self.has_signal = signal
+        self.queue_depth = queue
+        self.ttft_p99_s = ttft
+        self.window_requests = requests
+
+
+def test_train_policy_rule_order(fresh_registry):
+    from triton_kubernetes_tpu.operator import (
+        TrainFleetConfig, TrainFleetPolicy)
+
+    pol = TrainFleetPolicy(TrainFleetConfig(
+        desired_workers=2, min_workers=1, regrow_cooldown_s=60.0,
+        serve_queue_high=8.0, ttft_slo_p99_s=0.5))
+    calm = _Serving()
+    # No signal -> hold; done -> hold; converged -> hold.
+    assert pol.decide(None, calm, 0.0).reason == "no-signal"
+    assert pol.decide(_status(running_workers=2, done=True), calm,
+                      0.0).reason == "done"
+    assert pol.decide(_status(running_workers=2, capacity_workers=2),
+                      calm, 0.0).reason == "converged"
+    # Down + full capacity -> replace at desired, serving veto ignored.
+    d = pol.decide(_status(running_workers=0, capacity_workers=2),
+                   _Serving(queue=99), 0.0)
+    assert (d.direction, d.workers, d.reason) == \
+        ("replace", 2, "replace-lost")
+    # Down + partial capacity -> shrink onto the survivors, NOW.
+    d = pol.decide(_status(running_workers=0, capacity_workers=1),
+                   _Serving(queue=99), 0.0)
+    assert (d.direction, d.workers, d.reason) == \
+        ("shrink", 1, "shrink-instead-of-wait")
+    # Down + below the floor -> hold.
+    assert pol.decide(_status(running_workers=0, capacity_workers=0),
+                      calm, 0.0).reason == "no-capacity"
+    # Degraded + no spare capacity -> hold.
+    assert pol.decide(_status(running_workers=1, capacity_workers=1),
+                      calm, 0.0).reason == "await-capacity"
+    # Degraded + capacity, but serving is burning -> regrow vetoed.
+    d = pol.decide(_status(running_workers=1, capacity_workers=2),
+                   _Serving(queue=9), 0.0)
+    assert d.reason == "serving-pressure"
+    d = pol.decide(_status(running_workers=1, capacity_workers=2),
+                   _Serving(ttft=0.9, requests=5), 0.0)
+    assert d.reason == "serving-pressure"
+    # Calm -> regrow to desired; a landed actuation arms the cooldown.
+    d = pol.decide(_status(running_workers=1, capacity_workers=2),
+                   calm, 100.0)
+    assert (d.direction, d.workers) == ("regrow", 2)
+    pol.record_actuation(True, 100.0)
+    assert pol.decide(_status(running_workers=1, capacity_workers=2),
+                      calm, 130.0).reason == "cooldown"
+    assert pol.decide(_status(running_workers=1, capacity_workers=2),
+                      calm, 161.0).direction == "regrow"
+    # A FAILED actuation must not arm it.
+    pol2 = TrainFleetPolicy(TrainFleetConfig(desired_workers=2))
+    pol2.record_actuation(False, 0.0)
+    assert pol2.decide(_status(running_workers=1, capacity_workers=2),
+                       None, 1.0).direction == "regrow"
+    # record_train_decision (the Reconciler's journal hook) ticks the
+    # counter for every decision, hold included.
+    from triton_kubernetes_tpu.operator.trainfleet import (
+        record_train_decision)
+
+    record_train_decision(d)
+    assert metrics_mod.counter(
+        "tk8s_operator_train_resizes_total").value(
+            direction="regrow", reason="regrow") == 1
+
+
+def test_file_train_status_tolerates_missing_and_torn(tmp_path):
+    from triton_kubernetes_tpu.operator import file_train_status
+
+    read = file_train_status(str(tmp_path / "status.json"))
+    assert read() is None
+    (tmp_path / "status.json").write_text("{not json")
+    assert read() is None
+    (tmp_path / "status.json").write_text(json.dumps(
+        {"running_workers": 1, "capacity_workers": 2, "step": 7,
+         "target_step": 10}))
+    st = read()
+    assert (st.running_workers, st.capacity_workers, st.step,
+            st.target_step) == (1, 2, 7, 10)
+
+
+def test_reconciler_tick_journals_and_actuates_train_resize(
+        fresh_registry, tmp_path):
+    """The operator decides AND actuates: a down train fleet with
+    partial capacity shrinks through the actuator seam, the decision
+    lands on the tick journal, the gauge and span follow, and a hold
+    tick journals without actuating."""
+    import io
+
+    from triton_kubernetes_tpu.backends import MemoryBackend
+    from triton_kubernetes_tpu.executor import LocalExecutor
+    from triton_kubernetes_tpu.executor.dagspec import document_from_spec
+    from triton_kubernetes_tpu.operator import (
+        TrainFleetConfig, TrainFleetPolicy, TrainFleetStatus)
+    from triton_kubernetes_tpu.operator.loop import Reconciler
+    from triton_kubernetes_tpu.utils.logging import Logger
+
+    topo = {"manager": {"provider": "bare-metal", "name": "m1"},
+            "clusters": []}
+    doc = document_from_spec(topo, "op-train")
+    backend = MemoryBackend()
+    backend.persist(doc)
+    ex = LocalExecutor(log=lambda m: None,
+                       logger=Logger(stream=io.StringIO()))
+
+    observed = {"status": TrainFleetStatus(running_workers=0,
+                                           capacity_workers=1, step=4)}
+    actuations = []
+
+    def actuator(decision):
+        actuations.append(decision)
+        return {"status": "ok", "run_dir": str(tmp_path)}
+
+    rec = Reconciler(
+        backend, ex, "op-train",
+        clock=(lambda c=iter(range(1, 100)): float(next(c))),
+        sleep=lambda s: None, log=lambda m: None,
+        train_policy=TrainFleetPolicy(TrainFleetConfig(
+            desired_workers=2, min_workers=1)),
+        train_status=lambda: observed["status"],
+        train_actuator=actuator)
+    t1 = rec.tick()
+    assert t1.train_decision["direction"] == "shrink"
+    assert t1.observed["train"]["capacity_workers"] == 1
+    acts = [a for a in t1.actions if a.get("rule") == "train-resize"]
+    assert acts and acts[0]["ok"] and acts[0]["workers"] == 1
+    assert len(actuations) == 1
+    assert metrics_mod.gauge("tk8s_operator_train_workers").value() == 1
+    # Journal round-trips the decision.
+    assert t1.to_dict()["train_decision"]["reason"] == \
+        "shrink-instead-of-wait"
+    # Converged: hold journals, actuator untouched.
+    observed["status"] = TrainFleetStatus(running_workers=2,
+                                          capacity_workers=2)
+    t2 = rec.tick()
+    assert t2.train_decision["reason"] == "converged"
+    assert len(actuations) == 1
+
+
+def test_jobset_actuator_renders_resized_manifest(tmp_path):
+    from triton_kubernetes_tpu.operator import jobset_actuator
+    from triton_kubernetes_tpu.operator.trainfleet import TrainDecision
+    from triton_kubernetes_tpu.topology import SliceSpec, resize_jobset
+
+    spec = SliceSpec.from_accelerator("v5e-16")
+    doc = resize_jobset("train", spec, 3, image="img:1",
+                        command=["python", "-m", "t"])
+    assert doc["spec"]["completions"] == 3
+    assert doc["spec"]["parallelism"] == 3
+    with pytest.raises(ValueError):
+        resize_jobset("train", spec, 0, image="img:1", command=["t"])
+
+    act = jobset_actuator(str(tmp_path / "out"), "train", spec, "img:1",
+                          ["python", "-m", "t"])
+    res = act(TrainDecision("shrink", 2, "shrink-instead-of-wait"))
+    assert res["status"] == "ok"
+    rendered = json.load(open(res["path"]))
+    assert rendered["spec"]["completions"] == 2
+
+
+# --------------------------------------------- subprocess elastic restart
+
+@pytest.mark.slow  # trainer subprocesses; the 8->4->8 CI evidence covers more
+def test_elastic_restart_resumes_on_fewer_workers(tmp_path):
+    """A 2-process fleet checkpoints, then restarts as ONE process with
+    `--resume --elastic`: the trainer negotiates the smaller mesh from
+    the manifest and reports the reshard."""
+    from triton_kubernetes_tpu.parallel import multihost
+    from triton_kubernetes_tpu.parallel.multihost import (
+        ElasticPhase, MultiHostUnavailable)
+
+    try:
+        multihost.require_multihost()
+    except MultiHostUnavailable as e:
+        pytest.skip(f"multi-host unavailable: {e.reason}")
+
+    ckpt = str(tmp_path / "ckpt")
+    reports = multihost.elastic_restart(
+        ["--model", "llama-test", "--batch-size", "8", "--seq-len", "32",
+         "--steps", "2", "--sync-every", "1", "--checkpoint-dir", ckpt,
+         "--checkpoint-every", "1", "--log-every", "1"],
+        phases=[ElasticPhase(n_processes=2, devices_per_process=2),
+                ElasticPhase(n_processes=1, devices_per_process=2,
+                             extra_args=("--steps", "4"))],
+        run_dir=str(tmp_path), tag="t-elastic", timeout=300)
+    assert len(reports) == 2
+    assert reports[0].ok, [w.tail for w in reports[0].workers]
+    assert reports[1].ok, [w.tail for w in reports[1].workers]
+    rep = reports[1].report
+    assert rep["elastic"] is True
+    assert rep["reshard"] is not None
+    assert rep["reshard"]["from_processes"] == 2
+    assert rep["reshard"]["to_processes"] == 1
+    # Resumed at the saved step 2, trained on to the new target 4.
+    assert rep["reshard"]["step"] == 2
+    assert rep["steps"] == 2

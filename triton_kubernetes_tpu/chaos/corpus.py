@@ -51,6 +51,9 @@ WORKLOAD_FAULT_KINDS = (
     "coordinator-loss",   # rank 0 dies at a step offset
     "sigterm-flush",      # SIGTERM the route process; flush must land
     "kv-migration-torn",  # KV-page transfer torn mid-flight; digest bites
+    "reshard-torn-checkpoint",  # manifest torn mid elastic reshard;
+                                # fallback restores the older intact
+                                # step at ITS recorded shape
 )
 
 #: Per-kind fault-field defaults. A spec's workload dict may override
@@ -71,6 +74,8 @@ WORKLOAD_DEFAULTS = {
     "sigterm-flush": {"process": "route", "after_requests": 1},
     "kv-migration-torn": {"cut": "bitflip", "offset_frac": 0.5,
                           "prompt_len": 12, "max_new_tokens": 6},
+    "reshard-torn-checkpoint": {"offset_frac": 0.5, "torn_step": 2,
+                                "keep_steps": 2},
 }
 
 

@@ -79,11 +79,12 @@ PROFILES: Dict[str, Dict[str, Any]] = {
                  "fault_rules": (0, 1), "latency_weight": 0.1,
                  "kill_weight": 0.1, "operator_weight": 0.0,
                  "workload_weight": 1.0,
-                 "workload_kinds": (("engine-preempt", 0.35),
+                 "workload_kinds": (("engine-preempt", 0.3),
                                     ("torn-checkpoint", 0.2),
                                     ("sigterm-flush", 0.15),
                                     ("kv-migration-torn", 0.15),
-                                    ("replica-death", 0.15))},
+                                    ("replica-death", 0.15),
+                                    ("reshard-torn-checkpoint", 0.05))},
     # Training-plane workload faults (multi-host subprocess launches —
     # seconds per arm, so sweeps keep the run counts small).
     "workload-train": {"clusters": (0, 1), "nodes": (0, 1),
@@ -302,6 +303,14 @@ def _draw_workload(rng: random.Random, prof: Dict[str, Any]
         fault["offset_frac"] = round(rng.uniform(0.0, 1.0), 3)
         fault["prompt_len"] = rng.randint(8, 16)
         fault["max_new_tokens"] = rng.randint(4, 8)
+    elif kind == "reshard-torn-checkpoint":
+        # Anywhere in the manifest: the truncation may cut JSON syntax
+        # (parse failure), the digest line, or — at high fractions —
+        # nothing at all once past the closing brace; the verifier must
+        # catch every prefix that is not the whole file.
+        fault["offset_frac"] = round(rng.uniform(0.0, 0.95), 3)
+        fault["torn_step"] = rng.randint(1, 2)
+        fault["keep_steps"] = rng.randint(2, 3)
     return fault
 
 

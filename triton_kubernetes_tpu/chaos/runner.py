@@ -68,7 +68,7 @@ INVARIANTS = ("parity", "kill-resume", "trace-journal", "metrics-journal",
               # Workload fault arms (ISSUE 16, chaos/workload.py):
               "engine-parity", "reland-parity", "pool-convergence",
               "trace-valid", "ckpt-fallback", "train-resume",
-              "flush-clean", "migration-integrity")
+              "flush-clean", "migration-integrity", "reshard-fallback")
 
 #: Deliberate invariant breakages (mutation testing of the harness
 #: itself): each key names a way run_scenario corrupts its own checking
@@ -81,9 +81,11 @@ INVARIANTS = ("parity", "kill-resume", "trace-journal", "metrics-journal",
 #: release before the convergence check, ``swallowed-abort`` drops the
 #: abort flush so lifecycles end terminal-less, ``accepted-torn``
 #: pretends the destination imported a torn KV payload so
-#: migration-integrity must catch the phantom acceptance.
+#: migration-integrity must catch the phantom acceptance,
+#: ``adopt-torn-step`` pretends restore landed the half-committed
+#: reshard step so reshard-fallback must catch the adoption.
 MUTATIONS = ("unfaulted-reference", "dropped-reland", "leaked-pages",
-             "swallowed-abort", "accepted-torn")
+             "swallowed-abort", "accepted-torn", "adopt-torn-step")
 
 _MAX_APPLY_ATTEMPTS = 6
 

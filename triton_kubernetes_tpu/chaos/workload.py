@@ -535,6 +535,103 @@ def _arm_torn_checkpoint(cfg, spec, res, check, recorder) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# --------------------------------------------- reshard-torn-checkpoint
+def _arm_reshard_torn_checkpoint(cfg, spec, res, check, recorder) -> None:
+    """Tear the manifest mid 8→4 elastic reshard (ISSUE 19): the old
+    8-chip fleet committed ``keep_steps`` checkpoints at its recorded
+    shape, the new 4-chip fleet's first save dies mid-manifest-write at
+    a generated byte offset. The torn step is uncommitted by definition
+    (the manifest IS the commit marker), so restore must fall back to
+    the newest intact step *at its recorded 8-chip shape*: the peek
+    skips the torn manifest, negotiation reproduces the recorded mesh,
+    restore lands the intact step's exact values — the destination
+    never adopts torn state or a torn shape. The ``adopt-torn-step``
+    mutation pretends restore landed the half-written step; the
+    reshard-fallback oracle must catch it."""
+    import numpy as np
+    from ..train.checkpoint import (CheckpointIntegrityError,
+                                    CheckpointManager, MANIFEST_NAME,
+                                    peek_newest_manifest)
+    from ..train.resilience import negotiate_mesh_config
+
+    mutation = spec.get("mutation")
+    keep = int(cfg["keep_steps"])
+    frac = float(cfg["offset_frac"])
+    torn = keep + int(cfg["torn_step"])  # the reshard-side save(s)
+    spec8 = {"axes": {"data": 2, "stage": 1, "fsdp": 4, "seq": 1,
+                      "expert": 1, "tensor": 1},
+             "n_processes": 2, "n_devices": 8, "global_batch": 16}
+    spec4 = {"axes": {"data": 1, "stage": 1, "fsdp": 4, "seq": 1,
+                      "expert": 1, "tensor": 1},
+             "n_processes": 1, "n_devices": 4, "global_batch": 16}
+    tmp = tempfile.mkdtemp(prefix="tk8s-chaos-wl-")
+    try:
+        mgr = CheckpointManager(os.path.join(tmp, "ckpt"),
+                                max_to_keep=torn + 1, mesh_spec=spec8)
+
+        def state(s):
+            return {"step": np.asarray(s, np.int32),
+                    "w": np.asarray(s * 10.0, np.float32)}
+
+        for s in range(1, keep + 1):
+            mgr.save(s, state(s), wait=True)
+        # The 8→4 reshard in progress: the smaller fleet's saves record
+        # ITS shape — and the one at `torn` dies mid-manifest-write.
+        mgr.mesh_spec = spec4
+        for s in range(keep + 1, torn + 1):
+            mgr.save(s, state(s), wait=True)
+        manifest = os.path.join(tmp, "ckpt", str(torn), MANIFEST_NAME)
+        size = os.path.getsize(manifest)
+        with open(manifest, "r+b") as f:
+            f.truncate(max(int(size * frac), 1))
+        detected = False
+        try:
+            mgr.verify_step(torn)
+        except CheckpointIntegrityError:
+            detected = True
+        expect = torn - 1
+        peeked = peek_newest_manifest(os.path.join(tmp, "ckpt"))
+        peek_step = peeked[0] if peeked else None
+        recorded = peeked[1].get("mesh") if peeked else None
+        expect_axes = spec4["axes"] if expect > keep else spec8["axes"]
+        expect_fleet = (spec4 if expect > keep else spec8)
+        shape_ok = (recorded is not None
+                    and recorded.get("axes") == expect_axes)
+        negotiated_ok = False
+        if recorded is not None:
+            try:
+                neg = negotiate_mesh_config(
+                    recorded,
+                    n_processes=int(expect_fleet["n_processes"]),
+                    n_devices=int(expect_fleet["n_devices"]))
+                negotiated_ok = (
+                    neg.data * neg.stage * neg.fsdp * neg.seq
+                    * neg.expert * neg.tensor
+                    == int(expect_fleet["n_devices"]))
+            except Exception:
+                negotiated_ok = False
+        restored = mgr.restore(state(0))
+        landed = mgr.last_restored_step
+        intact = float(restored["w"]) == expect * 10.0
+        if mutation == "adopt-torn-step":
+            # Harness self-test: model a restore that adopted the
+            # half-committed reshard step — the oracle below must bite.
+            landed = torn
+            intact = False
+        check(res, "reshard-fallback",
+              detected and landed == expect and peek_step == expect
+              and shape_ok and negotiated_ok and intact,
+              f"torn manifest at step {torn} (offset_frac {frac}): "
+              f"detected={detected}, restore landed on {landed} "
+              f"(want {expect}), peek saw step {peek_step}, "
+              f"recorded-shape ok={shape_ok}, "
+              f"negotiated ok={negotiated_ok}, "
+              f"w={float(restored['w'])}")
+        mgr.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ------------------------------------------- rank-death/coordinator-loss
 def _train_args(steps: int, ckpt_dir: str,
                 trace_jsonl: Optional[str] = None) -> List[str]:
@@ -797,6 +894,7 @@ _ARMS = {
     "coordinator-loss": _arm_coordinator_loss,
     "sigterm-flush": _arm_sigterm_flush,
     "kv-migration-torn": _arm_kv_migration_torn,
+    "reshard-torn-checkpoint": _arm_reshard_torn_checkpoint,
 }
 
 

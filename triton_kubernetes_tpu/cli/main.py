@@ -494,6 +494,49 @@ def build_parser() -> argparse.ArgumentParser:
                          help=f"port for the operator endpoint "
                               f"(default: no endpoint; manifests use "
                               f"{OPERATOR_PORT}; 0 = ephemeral)")
+    operate.add_argument("--train-desired", type=int, default=0,
+                         metavar="N",
+                         help="train-fleet worker count the operator "
+                              "defends (default: 0 = no train fleet; "
+                              "with it, the replace/shrink-instead-of-"
+                              "wait/regrow rules run each tick — "
+                              "docs/guide/operator.md §Train fleet)")
+    operate.add_argument("--train-min", type=int, default=1, metavar="N",
+                         help="smallest worker count worth an elastic "
+                              "restart; below it the policy holds for "
+                              "capacity instead of shrinking "
+                              "(default: 1)")
+    operate.add_argument("--train-status", default=None, metavar="FILE",
+                         help="JSON file the operator reads each tick "
+                              "for the train fleet's observed state "
+                              "({\"running_workers\": N, "
+                              "\"capacity_workers\": M, ...}); missing "
+                              "or torn = no signal, the policy holds")
+    operate.add_argument("--train-regrow-cooldown", type=float,
+                         default=60.0, metavar="SECONDS",
+                         help="hold between a landed train resize and "
+                              "the next regrow; replace/shrink recovery "
+                              "is never throttled (default: 60)")
+    operate.add_argument("--train-jobset-dir", default=None,
+                         metavar="DIR",
+                         help="actuate train resizes by rendering the "
+                              "resized Job manifest into DIR "
+                              "(topology resize_jobset; default: "
+                              "decisions journal but nothing actuates)")
+    operate.add_argument("--train-jobset-name", default="train",
+                         metavar="NAME",
+                         help="Job/Service name for --train-jobset-dir "
+                              "renders (default: train)")
+    operate.add_argument("--train-accelerator", default="v5e-16",
+                         metavar="TYPE",
+                         help="accelerator of the train slice backing "
+                              "--train-jobset-dir renders "
+                              "(default: v5e-16)")
+    operate.add_argument("--train-image",
+                         default="tk8s/jax-tpu-runtime:0.1.0",
+                         metavar="IMAGE",
+                         help="container image for --train-jobset-dir "
+                              "renders (default: the runtime image)")
     operate.add_argument("--journal-out", default=None, metavar="FILE",
                          help="append every reconcile tick's journal "
                               "record as a JSON line (the decision "
@@ -1009,6 +1052,36 @@ def main(argv: Optional[List[str]] = None,
                         "/metrics", kind="ValueError")
                     return 2
                 rebalancer = http_rebalancer(list(args.scrape_urls))
+            train_policy = None
+            train_status = None
+            train_actuator = None
+            if args.train_desired > 0:
+                from ..operator import (
+                    TrainFleetConfig, TrainFleetPolicy, file_train_status,
+                    jobset_actuator)
+
+                if not args.train_status:
+                    logger.error(
+                        "--train-desired needs --train-status: the "
+                        "policy is blind without the train fleet's "
+                        "observed state", kind="ValueError")
+                    return 2
+                train_policy = TrainFleetPolicy(TrainFleetConfig(
+                    desired_workers=args.train_desired,
+                    min_workers=args.train_min,
+                    regrow_cooldown_s=args.train_regrow_cooldown,
+                    serve_queue_high=args.queue_high,
+                    ttft_slo_p99_s=args.ttft_slo))
+                train_status = file_train_status(args.train_status)
+                if args.train_jobset_dir:
+                    from ..topology.slices import SliceSpec
+
+                    train_actuator = jobset_actuator(
+                        args.train_jobset_dir, args.train_jobset_name,
+                        SliceSpec.from_accelerator(args.train_accelerator),
+                        args.train_image,
+                        ["python", "-m", "triton_kubernetes_tpu.train",
+                         "--resume", "--elastic"])
             reconciler = Reconciler(
                 be, ex, manager,
                 autoscaler=autoscaler,
@@ -1020,6 +1093,9 @@ def main(argv: Optional[List[str]] = None,
                 rebalancer=rebalancer,
                 rebalance_gap=args.rebalance_gap,
                 rebalance_high=args.rebalance_high,
+                train_policy=train_policy,
+                train_status=train_status,
+                train_actuator=train_actuator,
                 log=logger.info)
             server = None
             if args.operator_port is not None:

@@ -26,6 +26,14 @@ from .observe import (
 from .rebalance import RebalanceDecision, http_rebalancer, plan_rebalance
 from .reconcile import RULES, ReconcileDelta, act, compute_delta
 from .server import OperatorHTTPServer
+from .trainfleet import (
+    TrainDecision,
+    TrainFleetConfig,
+    TrainFleetPolicy,
+    TrainFleetStatus,
+    file_train_status,
+    jobset_actuator,
+)
 
 __all__ = [
     "Autoscaler",
@@ -41,6 +49,12 @@ __all__ = [
     "RULES",
     "ScaleDecision",
     "ServingSample",
+    "TrainDecision",
+    "TrainFleetConfig",
+    "TrainFleetPolicy",
+    "TrainFleetStatus",
+    "file_train_status",
+    "jobset_actuator",
     "act",
     "apply_decision",
     "compute_delta",

@@ -36,6 +36,8 @@ from .autoscaler import Autoscaler, ScaleDecision, apply_decision, \
 from .observe import MetricsWatcher, MetricsSource, ObservedState, observe
 from .rebalance import RebalanceDecision, plan_rebalance
 from .reconcile import act, compute_delta
+from .trainfleet import TrainDecision, TrainFleetPolicy, TrainFleetStatus, \
+    record_train_decision
 
 #: Tick outcomes (journal/metrics vocabulary).
 OUTCOMES = ("noop", "acted", "failed")
@@ -61,6 +63,7 @@ class ReconcileTick:
     duration_s: float = 0.0
     observed: Dict[str, Any] = field(default_factory=dict)
     decision: Optional[Dict[str, Any]] = None
+    train_decision: Optional[Dict[str, Any]] = None
     delta: Dict[str, Any] = field(default_factory=dict)
     actions: List[Dict[str, Any]] = field(default_factory=list)
     error: str = ""
@@ -75,6 +78,8 @@ class ReconcileTick:
         }
         if self.decision is not None:
             out["decision"] = self.decision
+        if self.train_decision is not None:
+            out["train_decision"] = self.train_decision
         if self.error:
             out["error"] = self.error
         return out
@@ -105,6 +110,11 @@ class Reconciler:
                                                Dict[str, Any]]] = None,
                  rebalance_gap: float = 0.0,
                  rebalance_high: float = 0.75,
+                 train_policy: Optional[TrainFleetPolicy] = None,
+                 train_status: Optional[
+                     Callable[[], Optional[TrainFleetStatus]]] = None,
+                 train_actuator: Optional[
+                     Callable[[TrainDecision], Dict[str, Any]]] = None,
                  between_observe_and_act: Optional[
                      Callable[[ObservedState], None]] = None):
         from ..utils import get_logger
@@ -133,6 +143,15 @@ class Reconciler:
         self.rebalancer = rebalancer
         self.rebalance_gap = float(rebalance_gap)
         self.rebalance_high = float(rebalance_high)
+        # Train-fleet arbitration (operator/trainfleet.py): the policy
+        # decides replace / shrink-instead-of-wait / regrow from the
+        # observed train status; the actuator is the resize seam
+        # (JobSet re-render in production, launch_trainers relaunch in
+        # the evidence harness, a lambda in tests). All three optional:
+        # a serving-only operator never observes a train fleet.
+        self.train_policy = train_policy
+        self.train_status = train_status
+        self.train_actuator = train_actuator
         self.journal: List[ReconcileTick] = []
         self.log = log or (lambda m: get_logger().info(m))
         self._between = between_observe_and_act
@@ -263,6 +282,7 @@ class Reconciler:
                 self.log(f"reconcile tick {self._ticks}: rule "
                          f"{failed[0].rule} failed: {failed[0].error}")
         self._maybe_rebalance(record, serving, decision)
+        self._maybe_train_resize(record, serving, t0)
         if decision is not None:
             landed = True
             if decision.direction in ("grow", "drain"):
@@ -364,6 +384,56 @@ class Reconciler:
                              self.clock() - t0, source=plan.source,
                              target=plan.target, gap=round(plan.gap, 6),
                              status=status)
+
+    # ---------------------------------------------------------- train fleet
+    def _maybe_train_resize(self, record: ReconcileTick, serving: Any,
+                            t0: float) -> None:
+        """Observe -> decide -> actuate for the train fleet, on every
+        tick the seams are wired. Decisions (hold included) journal and
+        count; only non-hold decisions reach the actuator, at most one
+        per tick — the next tick re-observes what the resize actually
+        did before deciding anything else."""
+        if self.train_policy is None or self.train_status is None:
+            return
+        status = self.train_status()
+        if status is not None:
+            record.observed["train"] = status.to_dict()
+        decision = self.train_policy.decide(status, serving, t0)
+        record.train_decision = decision.to_dict()
+        record_train_decision(decision)
+        if decision.direction == "hold" or self.train_actuator is None:
+            return
+        try:
+            result = self.train_actuator(decision)
+            status_str = str(result.get("status", "ok"))
+        except Exception as e:  # the seam reaches processes/network
+            result, status_str = {"error": str(e)}, "failed"
+        ok = status_str != "failed"
+        self.train_policy.record_actuation(ok, t0)
+        action: Dict[str, Any] = {"rule": "train-resize", "ok": ok,
+                                  "status": status_str,
+                                  **decision.to_dict()}
+        for key in ("error", "path", "run_dir"):
+            if result.get(key):
+                action[key] = str(result[key])
+        record.actions.append(action)
+        if not ok:
+            record.outcome = "failed"
+            record.error = action.get("error", "train resize failed")
+            self.log(f"train resize failed: {record.error}")
+        else:
+            record.outcome = "acted"
+            self.log(f"train fleet: {decision.direction} -> "
+                     f"{decision.workers} workers ({decision.reason})")
+        metrics.gauge("tk8s_operator_train_workers").set(
+            decision.workers if ok and status is not None
+            else (status.running_workers if status is not None else 0))
+        if self.trace is not None:
+            self.trace.event("operator.train_resize", t0,
+                             self.clock() - t0,
+                             direction=decision.direction,
+                             workers=decision.workers,
+                             reason=decision.reason, status=status_str)
 
     # ------------------------------------------------------------ journal
     def _journal(self, record: ReconcileTick) -> None:

@@ -675,9 +675,27 @@ class CoordinatedCheckpoint:
 
     def restore(self, state_like: Any, step: Optional[int] = None,
                 verify: bool = True) -> Any:
+        # Proactive mesh-fit check on the PLACEMENT target: the abstract
+        # tree handed to the inner manager deliberately drops shardings
+        # (the host read is unsharded), which also used to skip the
+        # manager's own divisibility check entirely — a wrong-shape
+        # coordinated restore surfaced as a raw XLA partition error from
+        # _place instead of the pinned MeshMismatchError. Check
+        # state_like (which carries the live shardings) BEFORE the enter
+        # barrier: every rank holds the same mesh, so every rank reaches
+        # the same verdict and raises together — no stranded barrier.
+        from ..train.checkpoint import CheckpointManager as _Mgr
+
+        _Mgr._check_mesh_fits(state_like)
+        # Concrete numpy templates, not ShapeDtypeStructs: a sharding-less
+        # abstract leaf makes orbax fall back to the sharding recorded at
+        # SAVE time, which references devices other ranks don't have when
+        # the writer ran at a different world size (the elastic 4->8
+        # regrow: a 1-process save restored by 2 processes). A numpy
+        # template forces the host read this path is built around.
         abstract = jax.tree.map(
-            lambda l: jax.ShapeDtypeStruct(
-                tuple(getattr(l, "shape", ())), getattr(l, "dtype", None)),
+            lambda l: np.zeros(tuple(getattr(l, "shape", ())),
+                               getattr(l, "dtype", None)),
             state_like)
         self.barrier(f"ckpt-restore-enter-{step}")
         if self._rank0:
@@ -967,6 +985,67 @@ def launch_trainers(
     return LaunchReport(
         returncodes=[p.returncode for p in procs], workers=workers,
         wall_seconds=wall, killed=killed, report=report)
+
+
+@dataclass
+class ElasticPhase:
+    """One fleet shape in an elastic restart storyline: how many worker
+    processes and virtual devices each gets, plus per-phase trainer-arg
+    overrides (e.g. a larger ``--steps`` target) and an optional
+    slice-wide preemption marker ending the phase early."""
+
+    n_processes: int
+    devices_per_process: int = 1
+    extra_args: Sequence[str] = ()
+    preempt_after_marker: Optional[str] = None
+    preempt_grace: float = 120.0
+
+
+def elastic_restart(
+    trainer_args: Sequence[str],
+    *,
+    phases: Sequence[ElasticPhase],
+    run_dir: str,
+    tag: str = "",
+    timeout: float = 600.0,
+    env_extra: Optional[Dict[str, str]] = None,
+    pin_cores: bool = True,
+) -> List[LaunchReport]:
+    """Run the trainer through a sequence of differently-sized fleets —
+    the 8→4→8 storyline as one call.
+
+    Phase 0 launches fresh; every later phase appends ``--resume
+    --elastic`` so the restart negotiates its mesh from the newest
+    manifest's recorded shape instead of its flags (the trainer args
+    must therefore carry ``--checkpoint-dir``/``--emergency-dir``).
+    Each phase gets its own ``run_dir/phase-N-PxD`` directory and
+    coordinator port. Stops early when a phase neither finished nor
+    exited for resume (rc 75) — a fleet with no durable state to hand
+    forward would just burn the remaining phases' timeouts.
+    """
+    from ..train.resilience import EXIT_RESUME
+
+    reports: List[LaunchReport] = []
+    for idx, ph in enumerate(phases):
+        args = list(trainer_args) + list(ph.extra_args)
+        if idx and "--resume" not in args:
+            args.append("--resume")
+        if idx and "--elastic" not in args:
+            args.append("--elastic")
+        phase_dir = os.path.join(
+            run_dir,
+            f"phase-{idx}-{ph.n_processes}x{ph.devices_per_process}")
+        rep = launch_trainers(
+            args, n_processes=ph.n_processes,
+            devices_per_process=ph.devices_per_process,
+            run_dir=phase_dir, tag=f"{tag or run_dir}-p{idx}",
+            env_extra=env_extra, timeout=timeout, pin_cores=pin_cores,
+            preempt_after_marker=ph.preempt_after_marker,
+            preempt_grace=ph.preempt_grace)
+        reports.append(rep)
+        if not all(rc in (0, EXIT_RESUME) for rc in rep.returncodes):
+            break
+    return reports
 
 
 # ------------------------------------------------------------------ goodput

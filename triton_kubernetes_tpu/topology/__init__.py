@@ -25,7 +25,7 @@ from .labels import (
     selector_for_slice,
     verify_slice_labels,
 )
-from .jobset import render_headless_service, render_jobset
+from .jobset import render_headless_service, render_jobset, resize_jobset
 from .serving import (
     render_disaggregated_deployments,
     render_operator_deployment,
@@ -49,6 +49,7 @@ __all__ = [
     "render_disaggregated_deployments",
     "render_headless_service",
     "render_jobset",
+    "resize_jobset",
     "render_operator_deployment",
     "render_operator_service",
     "render_router_deployment",

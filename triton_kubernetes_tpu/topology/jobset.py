@@ -39,6 +39,36 @@ def render_headless_service(name: str, namespace: str = "default") -> Dict[str, 
     }
 
 
+def resize_jobset(
+    name: str,
+    spec: SliceSpec,
+    workers: int,
+    *,
+    image: str,
+    command: List[str],
+    namespace: str = "default",
+    env: Optional[Dict[str, str]] = None,
+    trace_dir: Optional[str] = None,
+    slice_id: Optional[str] = None,
+) -> Dict[str, Any]:
+    """The Job manifest for an elastically-resized train fleet: the same
+    render as :func:`render_jobset` with ``completions`` forced to
+    ``workers`` — hostnames, coordinator address and ``NUM_TPU_WORKERS``
+    all re-derive from the new count, so the restarted workers negotiate
+    their mesh (``--elastic``) against a consistent world size. The
+    operator's train-fleet actuator
+    (:func:`~..operator.trainfleet.jobset_actuator`) renders through
+    this; applying it replaces the old Job (indexed Jobs have immutable
+    completions, so a resize IS a replace — the checkpoint carries the
+    progress across)."""
+    if workers < 1:
+        raise ValueError(f"resize_jobset: workers={workers} must be >= 1")
+    return render_jobset(
+        name, spec, slice_id if slice_id is not None else f"{name}-elastic",
+        image, command, namespace=namespace, env=env,
+        completions=workers, trace_dir=trace_dir)
+
+
 def render_jobset(
     name: str,
     spec: SliceSpec,

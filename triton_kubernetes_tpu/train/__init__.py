@@ -10,6 +10,9 @@ from .checkpoint import (
     CheckpointIntegrityError,
     CheckpointManager,
     MeshMismatchError,
+    ReshapeError,
+    mesh_spec_of,
+    peek_newest_manifest,
     restore_newest_verified,
 )
 from .data import DevicePrefetch, PrefetchProducerError
@@ -21,6 +24,7 @@ from .resilience import (
     LossAnomalyGuard,
     PreemptionGuard,
     ResilienceReport,
+    negotiate_mesh_config,
     run_resilient,
 )
 from .precision import (
@@ -55,6 +59,10 @@ __all__ = [
     "CheckpointManager",
     "CheckpointIntegrityError",
     "MeshMismatchError",
+    "ReshapeError",
+    "mesh_spec_of",
+    "peek_newest_manifest",
+    "negotiate_mesh_config",
     "restore_newest_verified",
     "DevicePrefetch",
     "PrefetchProducerError",

@@ -149,6 +149,17 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="steps between saves (0 = only at the end)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--elastic", action="store_true",
+                   help="negotiate the mesh shape from the newest "
+                        "checkpoint manifest (schema v2 records the "
+                        "saved mesh) instead of requiring the flags to "
+                        "match it: a restart on a different device/"
+                        "process count keeps the recorded ICI axes and "
+                        "re-derives the DCN data axis from the "
+                        "surviving fleet, re-placing every leaf under "
+                        "the new sharding and replaying the data "
+                        "stream from the step index; requires --resume "
+                        "to have any effect")
     p.add_argument("--emergency-dir", default="",
                    help="directory for preemption emergency checkpoints "
                         "(default: the checkpoint dir); --resume considers "
@@ -422,6 +433,57 @@ def main(argv=None) -> int:
         data=args.data or (0 if n_processes > 1 else 1),
         stage=args.stage, fsdp=args.fsdp, seq=args.seq,
         expert=args.expert, tensor=args.tensor)
+    # --elastic: the mesh shape is negotiated from the newest manifest's
+    # recorded mesh section (schema v2), not taken from the flags — the
+    # fleet that survived a slice loss decides the restore shape. The
+    # peek is pure file I/O on the shared checkpoint dirs, so every rank
+    # derives the same answer without a collective. A format-1 manifest
+    # (no recorded shape) falls back to the flags with a warning; a
+    # fleet the saved shapes cannot divide is a typed ReshapeError and
+    # the same loud rc-2 as every config error.
+    elastic_reshard = None
+    elastic_batch = 0
+    if args.elastic and args.resume and (
+            args.checkpoint_dir or args.emergency_dir):
+        from .checkpoint import ReshapeError, peek_newest_manifest
+        from .resilience import negotiate_mesh_config
+
+        peeked = peek_newest_manifest(
+            args.checkpoint_dir or None, args.emergency_dir or None)
+        saved_mesh = peeked[1].get("mesh") if peeked else None
+        if saved_mesh is None:
+            log.log("warn", "--elastic: no recorded mesh to negotiate "
+                    "from (no checkpoint yet, or a format-1 manifest); "
+                    "using the flag-derived mesh")
+        else:
+            try:
+                mesh_cfg = negotiate_mesh_config(
+                    saved_mesh, n_processes=n_processes,
+                    n_devices=jax.device_count())
+            except ReshapeError as e:
+                log.log("error", "elastic shape negotiation failed",
+                        error=str(e))
+                _distributed_shutdown(n_processes)
+                return EXIT_CONFIG
+            elastic_batch = int(saved_mesh.get("global_batch") or 0)
+            saved_shape = dict(saved_mesh.get("axes") or {})
+            reshaped = (
+                int(saved_mesh.get("n_devices") or 0) != jax.device_count()
+                or int(saved_mesh.get("n_processes") or 0) != n_processes)
+            if reshaped:
+                elastic_reshard = {
+                    "step": int(peeked[0]),
+                    "from_axes": saved_shape,
+                    "from_devices": int(saved_mesh.get("n_devices") or 0),
+                    "from_processes": int(
+                        saved_mesh.get("n_processes") or 0),
+                    "to_devices": jax.device_count(),
+                    "to_processes": n_processes,
+                }
+            log.log("info", "elastic mesh negotiated",
+                    saved_axes=saved_shape,
+                    negotiated=repr(mesh_cfg), reshaped=reshaped,
+                    step=int(peeked[0]))
     if n_processes > 1:
         # Hybrid DCN×ICI placement: the data axis spans processes (one
         # DCN shard per host by default), ICI axes stay within each
@@ -448,7 +510,10 @@ def main(argv=None) -> int:
         mesh = create_mesh(mesh_cfg)
     n_devices = mesh.size
     batch_shards = max(mesh.shape["data"] * mesh.shape["fsdp"], 1)
-    batch_size = args.batch_size or 4 * batch_shards
+    # The recorded global batch wins over the shard-derived default under
+    # --elastic: replay skips `step` whole batches, so the stream only
+    # lines up when the global batch survives the reshape unchanged.
+    batch_size = args.batch_size or elastic_batch or 4 * batch_shards
     log.log("info", "trainer starting", model=config.name,
             mesh=describe_mesh(mesh), devices=n_devices,
             processes=n_processes, batch=batch_size,
@@ -550,23 +615,30 @@ def main(argv=None) -> int:
     if n_processes > 1:
         log.log("info", "dcn gradient sync", mode=dcn_sync)
 
-    from .checkpoint import CheckpointManager
+    from .checkpoint import CheckpointManager, mesh_spec_of
     from .resilience import (
         EXIT_RESUME, AnomalyAbortedError, LossAnomalyGuard, PreemptionGuard,
         run_resilient)
 
+    # Every save from here on records the live shape in the manifest
+    # (schema v2): the NEXT restart — elastic or not — knows what mesh
+    # the bytes were placed under without trusting its own flags.
+    live_spec = mesh_spec_of(mesh, n_processes=n_processes,
+                             global_batch=batch_size)
     ckpt = None
     em_ckpt = None
     if args.checkpoint_dir:
         ckpt = CheckpointManager(args.checkpoint_dir,
-                                 single_controller=n_processes > 1)
+                                 single_controller=n_processes > 1,
+                                 mesh_spec=live_spec)
     if args.emergency_dir and (
             ckpt is None
             or os.path.abspath(args.emergency_dir) != ckpt.directory):
         # Path-normalized: two orbax managers on one directory would race
         # each other's GC/finalize and double-list every resume candidate.
         em_ckpt = CheckpointManager(args.emergency_dir,
-                                    single_controller=n_processes > 1)
+                                    single_controller=n_processes > 1,
+                                    mesh_spec=live_spec)
     if n_processes > 1:
         # Single-writer-per-shard coordination: process 0 writes (the DCN
         # axis carries only replicated state, so rank 0 holds every
@@ -589,8 +661,15 @@ def main(argv=None) -> int:
         # A resume restore is recovery work re-establishing state a
         # fault interrupted — the ledger books it rollback_replay, so
         # the kill->resume storyline never shows recovery as `step`.
+        # When --elastic changed the shape, the window is a reshard
+        # instead: re-placing every leaf under the new sharding is
+        # neutral capacity-adaptation work (like migrate_*), not waste,
+        # and the goodput report must show it honestly.
+        restore_cat = ("reshard" if elastic_reshard is not None
+                       else "rollback_replay")
+        reshard_t0 = time.perf_counter()
         if goodput is not None:
-            goodput.transition("rollback_replay")
+            goodput.transition(restore_cat)
         try:
             state, best, best_step = restore_newest_verified(
                 state, ckpt, em_ckpt)
@@ -601,6 +680,19 @@ def main(argv=None) -> int:
             # lives in the scheduled dir, the guard's baseline check can
             # skip re-hashing it.
             start_is_checkpointed = best is ckpt
+            if elastic_reshard is not None:
+                elastic_reshard["seconds"] = round(
+                    time.perf_counter() - reshard_t0, 6)
+                if tracer is not None:
+                    tracer.event("train.reshard", goodput.clock(),
+                                 step=int(state.step), **{
+                                     k: v for k, v in
+                                     elastic_reshard.items()
+                                     if k not in ("from_axes", "step")})
+                log.log("info", "elastic reshard restore",
+                        step=int(state.step), **{
+                            k: v for k, v in elastic_reshard.items()
+                            if k not in ("from_axes", "step")})
             if tracer is not None:
                 tracer.event("train.restore", goodput.clock(),
                              step=int(state.step), rollback=False)
@@ -821,6 +913,8 @@ def main(argv=None) -> int:
             "tokens_per_sec": round(
                 steps_done * tokens_per_step / wall, 1),
             "outcome": outcome,
+            "elastic": bool(args.elastic),
+            "reshard": elastic_reshard,
         }
         steady = sync_windows[1:]
         if steady:

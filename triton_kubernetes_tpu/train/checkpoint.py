@@ -29,6 +29,17 @@ truncated, or bit-rotted step, **quarantines** it (rename into
 to the newest earlier step that verifies, automatically. Verification
 failures and fallbacks are counted in the ``tk8s_train_checkpoint_*``
 metric families (utils/metrics.py CATALOG).
+
+Manifest **format 2** additionally versions the *mesh* into the
+checkpoint: a ``mesh`` section recording the axis sizes the writer
+trained under, its process/device counts, and the global batch — so a
+restart can *negotiate* its shape from what survived instead of trusting
+CLI flags (train/resilience.py ``negotiate_mesh_config``, the trainer's
+``--elastic``). Format-1 manifests (no ``mesh`` section) stay fully
+readable: verification and restore are format-agnostic, and
+:func:`peek_newest_manifest` simply reports no recorded shape, which the
+elastic path treats as "fall back to the flags" (documented in
+docs/guide/fault-tolerance.md §Elastic reshaping).
 """
 
 from __future__ import annotations
@@ -47,6 +58,11 @@ from ..utils import metrics as _metrics
 
 MANIFEST_NAME = "manifest.json"
 QUARANTINE_DIR = "quarantine"
+#: Current manifest schema; format 2 added the ``mesh`` section.
+MANIFEST_FORMAT = 2
+#: Formats this reader accepts (restore/verify are format-agnostic; the
+#: only format-2 addition is *extra* data older readers ignore).
+MANIFEST_FORMATS = (1, 2)
 
 
 class CheckpointError(RuntimeError):
@@ -64,11 +80,23 @@ class CheckpointIntegrityError(CheckpointError):
         self.reason = reason
 
 
-class MeshMismatchError(CheckpointError):
+class ReshapeError(CheckpointError):
+    """Elastic shape negotiation failed: the surviving fleet cannot hold
+    the recorded mesh (axes don't divide the device count, the ICI block
+    no longer fits one process, or the manifest predates format 2 and
+    carries no shape at all when one is required). The message names the
+    recorded shape and the surviving fleet — the operator's actionable
+    alternative to a blind mesh-mismatch crash deep inside restore."""
+
+
+class MeshMismatchError(ReshapeError):
     """The restore-target mesh cannot hold the saved arrays: some mesh
     axis product does not divide a sharded dimension. Raised *before*
     touching orbax so the operator gets an actionable message instead of
-    a raw Orbax/XLA partitioning traceback."""
+    a raw Orbax/XLA partitioning traceback. A :class:`ReshapeError`
+    subtype: with ``--elastic`` this is what negotiation exists to
+    avoid; without it, it must still fire (the non-elastic path never
+    silently adopts a wrong shape)."""
 
 
 def _leaf_meta(tree: Any) -> List[Dict[str, Any]]:
@@ -109,12 +137,76 @@ def _to_abstract(leaf: Any) -> Any:
     return ocp.utils.to_shape_dtype_struct(leaf)
 
 
+def _restore_args(state_like: Any, abstract: Any) -> Any:
+    """Orbax restore args for a template tree. An all-numpy template
+    pins ``restore_type=np.ndarray`` explicitly: a sharding-less
+    abstract leaf otherwise falls back to the sharding recorded at SAVE
+    time, whose devices other ranks don't have when the writer ran at a
+    different world size (the elastic regrow: a 1-process save restored
+    by a 2-process fleet's host-read path)."""
+    import numpy as _np
+
+    leaves = jax.tree_util.tree_leaves(state_like)
+    if leaves and all(isinstance(l, _np.ndarray) for l in leaves):
+        # Pass the numpy leaves through verbatim: orbax maps np.ndarray
+        # template leaves to restore_type=np.ndarray, while an erased
+        # (sharding-less) abstract leaf would fall back to save-time
+        # sharding and explode on ranks without those devices.
+        return ocp.args.StandardRestore(state_like)
+    return ocp.args.StandardRestore(abstract)
+
+
 def _manifest_digest(manifest: Dict[str, Any]) -> str:
     """Whole-checkpoint digest over the manifest body (everything but the
     digest field itself) — the last thing written, i.e. the commit bit."""
     body = {k: v for k, v in manifest.items() if k != "digest"}
     return hashlib.sha256(
         json.dumps(body, sort_keys=True).encode()).hexdigest()
+
+
+def mesh_spec_of(mesh: Any, n_processes: int = 1,
+                 global_batch: int = 0) -> Dict[str, Any]:
+    """The manifest-v2 ``mesh`` section for a live jax mesh: axis sizes
+    (every axis, unit or not — the negotiator must see the full layout),
+    fleet size, and the global batch the data stream was cut for (kept
+    constant across resizes so the loss trajectory is fleet-shape-
+    independent)."""
+    return {
+        "axes": {str(name): int(size) for name, size in mesh.shape.items()},
+        "n_processes": int(n_processes),
+        "n_devices": int(mesh.devices.size),
+        "global_batch": int(global_batch),
+    }
+
+
+def peek_newest_manifest(*directories: Optional[str],
+                         ) -> Optional[Tuple[int, Dict[str, Any]]]:
+    """``(step, manifest)`` of the newest digest-intact manifest across
+    checkpoint directories — pure file I/O, no orbax, no mesh. This is
+    what elastic startup reads BEFORE building any mesh: the recorded
+    shape decides the mesh the restore target is built on. A torn or
+    digest-broken manifest is skipped (restore proper will quarantine
+    it); deterministic, so every rank peeking the same shared filesystem
+    negotiates the same shape with no collective needed."""
+    candidates: List[Tuple[int, str]] = []
+    for directory in directories:
+        if not directory or not os.path.isdir(directory):
+            continue
+        for name in os.listdir(directory):
+            if name.isdigit():
+                candidates.append((int(name),
+                                   os.path.join(directory, name)))
+    for step, sdir in sorted(candidates, reverse=True):
+        mpath = os.path.join(sdir, MANIFEST_NAME)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if manifest.get("digest") != _manifest_digest(manifest):
+            continue
+        return step, manifest
+    return None
 
 
 class CheckpointManager:
@@ -130,8 +222,14 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 single_controller: bool = False):
+                 single_controller: bool = False,
+                 mesh_spec: Optional[Dict[str, Any]] = None):
         self.directory = os.path.abspath(directory)
+        # The manifest-v2 mesh section (mesh_spec_of); assignable after
+        # construction too — the trainer sets it once the mesh exists.
+        # None keeps a format-2 manifest with "mesh": null, which the
+        # elastic path treats exactly like a format-1 manifest.
+        self.mesh_spec = mesh_spec
         options_kwargs: Dict[str, Any] = {}
         if single_controller:
             # Multi-process runs coordinate checkpoints OUTSIDE orbax
@@ -201,7 +299,8 @@ class CheckpointManager:
             if step in self._known_steps():
                 self.quarantine(step, "superseded-by-resave")
             self._pending[step] = {"t0": time.perf_counter(), "kind": kind,
-                                   "tree": _leaf_meta(state)}
+                                   "tree": _leaf_meta(state),
+                                   "mesh": self.mesh_spec}
             self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._finalize()
@@ -228,10 +327,11 @@ class CheckpointManager:
                 continue
             files = _scan_files(sdir)
             manifest: Dict[str, Any] = {
-                "format": 1,
+                "format": MANIFEST_FORMAT,
                 "step": step,
                 "kind": info["kind"],
                 "tree": info["tree"],
+                "mesh": info.get("mesh"),
                 "files": {rel: {"bytes": size, "sha256": digest}
                           for rel, (size, digest) in sorted(files.items())},
             }
@@ -330,6 +430,23 @@ class CheckpointManager:
                 continue
         return None
 
+    def manifest(self, step: int) -> Optional[Dict[str, Any]]:
+        """The committed manifest of ``step`` (None when the step or its
+        manifest is missing/torn — callers wanting a typed failure use
+        :meth:`verify_step`)."""
+        mpath = os.path.join(self._step_dir(step), MANIFEST_NAME)
+        try:
+            with open(mpath) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def saved_mesh_spec(self, step: int) -> Optional[Dict[str, Any]]:
+        """The ``mesh`` section ``step`` was saved under, or None for a
+        format-1 manifest (pre-elastic writer) / missing step."""
+        manifest = self.manifest(step)
+        return manifest.get("mesh") if manifest else None
+
     @staticmethod
     def _check_mesh_fits(abstract: Any) -> None:
         """Typed, actionable error when the target mesh cannot partition
@@ -391,7 +508,7 @@ class CheckpointManager:
                     failures.append(f"{e} -> quarantined to {where}")
                     continue
             restored = self._mgr.restore(
-                s, args=ocp.args.StandardRestore(abstract))
+                s, args=_restore_args(state_like, abstract))
             if failures:
                 _metrics.counter(
                     "tk8s_train_checkpoint_fallback_restores_total").inc()

@@ -68,6 +68,69 @@ class AnomalyAbortedError(RuntimeError):
         self.anomaly = anomaly
 
 
+#: Mesh axes whose product must fit within one process's local devices
+#: (ICI), vs the DCN axes (data, stage) that span processes — mirrors
+#: parallel/mesh.create_hybrid_mesh's split.
+_ICI_AXES = ("fsdp", "seq", "expert", "tensor")
+
+
+def negotiate_mesh_config(saved: Optional[Dict[str, Any]], *,
+                          n_processes: int, n_devices: int):
+    """Elastic shape negotiation: the mesh a restart should build, from
+    the manifest-v2 ``mesh`` section of the newest surviving checkpoint
+    and the fleet that actually came up.
+
+    The recorded ICI block (fsdp × seq × expert × tensor) and the stage
+    axis are kept — they partition *model* dimensions, so changing them
+    would re-split saved leaves — and the data axis absorbs the fleet
+    delta: ``data = n_devices / (ici × stage)``. An 8-device
+    ``data=2×fsdp=4`` checkpoint restarting on 4 devices negotiates
+    ``data=1×fsdp=4``; back on 8, ``data=2×fsdp=4`` again. Raises
+    :class:`~.checkpoint.ReshapeError` (typed, actionable — never a raw
+    partitioning traceback) when no such mesh exists on the survivors.
+    """
+    from ..parallel.mesh import MeshConfig
+    from .checkpoint import ReshapeError
+
+    fleet = f"{n_devices} devices / {n_processes} processes"
+    if not saved or not saved.get("axes"):
+        raise ReshapeError(
+            f"cannot negotiate a mesh for {fleet}: the checkpoint "
+            f"manifest records no mesh (format-1 manifest from a "
+            f"pre-elastic writer) — pass the mesh flags explicitly")
+    axes = {str(k): int(v) for k, v in saved["axes"].items()}
+    stage = axes.get("stage", 1)
+    ici = 1
+    for name in _ICI_AXES:
+        ici *= axes.get(name, 1)
+    saved_shape = "x".join(f"{k}={v}" for k, v in sorted(axes.items())
+                           if v != 1) or "single-device"
+    if ici * stage <= 0 or n_devices % (ici * stage):
+        raise ReshapeError(
+            f"cannot negotiate a mesh for {fleet}: the recorded ICI "
+            f"block (ici={ici}, stage={stage}) of saved mesh "
+            f"[{saved_shape}] does not divide {n_devices} devices — "
+            f"resume on a multiple of {ici * stage} devices or reshard "
+            f"offline")
+    data = n_devices // (ici * stage)
+    if (data * stage) % max(n_processes, 1):
+        raise ReshapeError(
+            f"cannot negotiate a mesh for {fleet}: DCN axes "
+            f"(data={data}, stage={stage}) cannot span {n_processes} "
+            f"processes evenly (saved mesh [{saved_shape}])")
+    if n_processes > 1:
+        local = n_devices // n_processes
+        if local <= 0 or local % ici:
+            raise ReshapeError(
+                f"cannot negotiate a mesh for {fleet}: the recorded "
+                f"ICI block (ici={ici}) no longer fits one process's "
+                f"{local} local devices (saved mesh [{saved_shape}])")
+    return MeshConfig(data=data, stage=stage,
+                      fsdp=axes.get("fsdp", 1), seq=axes.get("seq", 1),
+                      expert=axes.get("expert", 1),
+                      tensor=axes.get("tensor", 1))
+
+
 class PreemptionGuard:
     """SIGTERM/SIGINT -> a flag the training loop polls.
 

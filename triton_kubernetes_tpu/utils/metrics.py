@@ -301,6 +301,17 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "gauge", "TPU slice node pools currently desired for the "
         "autoscaled cluster (the autoscaler's scaling unit)",
         ("cluster",), None),
+    "tk8s_operator_train_resizes_total": (
+        "counter", "Train-fleet policy decisions per reconcile tick, "
+        "by direction (replace/shrink/regrow/hold) and the rule reason "
+        "that drove it (replace-lost, shrink-instead-of-wait, regrow, "
+        "converged, no-signal, no-capacity, await-capacity, "
+        "serving-pressure, cooldown, done)",
+        ("direction", "reason"), None),
+    "tk8s_operator_train_workers": (
+        "gauge", "Train worker processes the operator last decided the "
+        "fleet should run (the elastic trainer's negotiated world "
+        "size)", (), None),
     # ----------------------------------------- goodput ledger (fleet-wide)
     "tk8s_goodput_seconds_total": (
         "counter", "Chip-seconds attributed by the goodput ledger, by "

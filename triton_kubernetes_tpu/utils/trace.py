@@ -128,6 +128,12 @@ SPAN_CATALOG: Dict[str, str] = {
     "train.checkpoint": "one checkpoint save (step/kind fields)",
     "train.restore": "checkpoint restore (step field; rollback=True "
                      "after an anomaly trip)",
+    "train.reshard": "elastic restore re-placed the state onto a "
+                     "differently-sized fleet (step/from_devices/"
+                     "from_processes/to_devices/to_processes/seconds "
+                     "fields)",
+    "operator.train_resize": "train-fleet actuation (direction/workers/"
+                             "reason/status fields)",
     "train.rollback": "anomaly rollback decision (window_end/target "
                       "fields)",
     "train.preempt": "preemption honored — partial window synced, "
@@ -166,7 +172,7 @@ GOODPUT_CATEGORIES: Dict[str, Tuple[str, ...]] = {
     "serve": ("prefill", "decode", "verify", "recompute",
               "migrate_out", "migrate_in", "idle"),
     "train": ("step", "compile", "data_wait", "host_sync", "checkpoint",
-              "rollback_replay", "preempted_lost", "idle"),
+              "rollback_replay", "preempted_lost", "reshard", "idle"),
     "route": ("forward", "idle"),
 }
 
