@@ -227,6 +227,9 @@ def _child() -> None:
     log("phase=spec_probe")
     spec_fields = _spec_probe()
 
+    log("phase=serve_kernel_probe")
+    serve_fields = _serve_kernel_probe()
+
     print(json.dumps({
         "metric": f"{config.name}_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
@@ -261,6 +264,14 @@ def _child() -> None:
         # (scripts/ci/spec_decode_evidence.py); these fields record the
         # accept economics alongside the training headline.
         **spec_fields,
+        # Serving-kernel evidence (BENCH_r08+): does each paged-attention
+        # kernel — decode, chunked prefill, verify — actually lower to a
+        # Mosaic custom call for TPU, and what arithmetic dtype do the
+        # serving matmuls resolve to on this backend. The fused-kernel
+        # and quantized-arithmetic A/Bs are gated separately
+        # (scripts/ci/*_evidence.py); these booleans make a silent
+        # dense fallback visible in the headline JSON.
+        **serve_fields,
         **mem_fields,
         # Compile-vs-step split (persistent cache makes the warm-attempt
         # compile collapse toward zero) + loop-overlap evidence.
@@ -349,6 +360,78 @@ def _spec_probe(spec_k: int = 3) -> dict:
               f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
         return {"spec_k": spec_k, "accept_rate": None,
                 "tokens_per_verify": None}
+
+
+def _serve_kernel_probe() -> dict:
+    """Per-kernel Mosaic-lowering evidence for the serving surface
+    (BENCH_r08+ fields). Each paged-attention kernel — single-query
+    decode, fused chunked prefill, fused multi-row verify — is lowered
+    FOR TPU via ``jax.export`` (cross-lowering, so the evidence is
+    collectable even from the CPU-fallback child) and its stablehlo
+    checked for the Mosaic custom call. True = the fused kernel is in
+    the lowered program; False = inspected and absent (a dense fallback
+    would masquerade as a slow kernel otherwise); None = lowering or
+    inspection itself failed. ``matmul_dtype`` records what ``tk8s
+    serve --matmul-dtype auto`` resolves to on THIS backend with
+    int8-stored weights — the arithmetic the serving matmuls actually
+    run. Best-effort per kernel: one failure must not null the rest or
+    cost the bench its training headline."""
+    out: dict = {"matmul_dtype": None, "decode_kernel_in_hlo": None,
+                 "prefill_kernel_in_hlo": None, "verify_kernel_in_hlo": None}
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax import export as jexport
+
+        from triton_kubernetes_tpu.ops.paged_attention import (
+            paged_prefill_attention,
+            ragged_paged_attention,
+            ragged_verify_attention,
+        )
+        from triton_kubernetes_tpu.ops.quantization import (
+            resolve_matmul_dtype)
+    except Exception as e:  # noqa: BLE001 — the probe is best-effort
+        print(f"[bench-child] serve kernel probe failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        return out
+    try:
+        out["matmul_dtype"] = resolve_matmul_dtype("auto", "int8")
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench-child] matmul_dtype resolve failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+
+    def _mosaic_in_lowered(fn, *xs) -> bool:
+        txt = jexport.export(jax.jit(fn), platforms=["tpu"])(
+            *xs).mlir_module()
+        return "tpu_custom_call" in txt or "mosaic" in txt.lower()
+
+    # Real TPU tiling (lane=128 head dim, sublane-aligned page size):
+    # the same shapes the kernel lowering tests pin.
+    b, t, nb, bs, hq, hkv, d, c, s = 2, 4, 8, 16, 4, 2, 128, 32, 3
+    kp = jnp.zeros((nb, hkv, bs, d), jnp.float32)
+    vp = jnp.zeros_like(kp)
+    tables = jnp.zeros((b, t), jnp.int32)
+    lens = jnp.ones((b,), jnp.int32)
+    for field, fn, args in (
+        ("decode_kernel_in_hlo",
+         lambda q, k, v: ragged_paged_attention(
+             q, k, v, tables, lens, impl="pallas"),
+         (jnp.zeros((b, 1, hq, d), jnp.float32), kp, vp)),
+        ("prefill_kernel_in_hlo",
+         lambda q, k, v: paged_prefill_attention(
+             q, k, v, tables[0], jnp.int32(0), impl="pallas"),
+         (jnp.zeros((1, c, hq, d), jnp.float32), kp, vp)),
+        ("verify_kernel_in_hlo",
+         lambda q, k, v: ragged_verify_attention(
+             q, k, v, tables, lens, impl="pallas"),
+         (jnp.zeros((b, s, hq, d), jnp.float32), kp, vp)),
+    ):
+        try:
+            out[field] = _mosaic_in_lowered(fn, *args)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench-child] {field} probe failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+    return out
 
 
 def _probe() -> None:

@@ -468,3 +468,33 @@ def test_router_deployment_renders_decode_replicas():
     cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
     assert cmd.count("--decode-replica") == 2
     assert "http://d1:8000" in cmd
+
+
+def test_http_migration_charges_dcn_transfer_and_metric(model):
+    """The wire is not free: with a DcnTransferModel attached the ship
+    charges rtt + bytes/bandwidth through the injectable sleeper (the
+    handler thread, so decode steps keep running), the
+    transfer-seconds histogram observes at least the modeled latency,
+    and the migration itself stays bitwise (the model delays bytes, it
+    never touches them)."""
+    from triton_kubernetes_tpu.serve import DcnTransferModel
+
+    want = solo_tokens(model, [5, 7, 9, 11, 2], 6, seed=4)
+    slept = []
+    dcn = DcnTransferModel(bytes_per_s=1e9, rtt_s=0.002,
+                           sleep=slept.append)
+    with ServeHTTPServer(make_engine(model), dcn=dcn) as src, \
+            ServeHTTPServer(make_engine(model)) as dst:
+        out = _post(src.url, "/generate",
+                    {"tokens": [5, 7, 9, 11, 2], "max_new_tokens": 6,
+                     "seed": 4, "handoff": True})
+        mig = _post(src.url, "/migrate/out",
+                    {"request_id": out["request_id"], "dest": dst.url,
+                     "reason": "handoff"})
+        awaited = _post(dst.url, "/await",
+                        {"request_id": mig["dest_request_id"]})
+        assert awaited["tokens"] == want
+    assert len(slept) == 1
+    assert slept[0] >= 0.002 + mig["bytes"] / 1e9
+    h = metrics.histogram("tk8s_serve_migration_transfer_seconds")
+    assert h.count() == 1

@@ -32,7 +32,9 @@ from triton_kubernetes_tpu.ops.paged_attention import (
     TRASH_PAGE,
     blocks_for,
     gather_pages,
+    paged_prefill_attention,
     ragged_paged_attention,
+    ragged_verify_attention,
     resolve_paged_impl,
     scatter_token,
 )
@@ -552,3 +554,144 @@ def test_paged_prefill_chunk_validates_shapes():
                             jnp.asarray(8, jnp.int32), cfg, cache,
                             jnp.asarray([1, 2], jnp.int32),
                             with_quant_error=True)
+
+
+# ------------------------------------- fused chunked-prefill kernel
+def _prefill_case(seed, total, offset, c, bs=8, hq=4, hkv=2, d=16,
+                  num_pages=16):
+    """One sequence mid-chunked-prefill: ``total`` tokens written to the
+    pool (this chunk's included), the chunk's C queries at absolute
+    positions offset..offset+C-1, garbage in every unwritten slot."""
+    s = -(-total // bs) * bs  # helper wants block-multiple padding;
+    key = jax.random.PRNGKey(seed)  # the pad slots are causally masked
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (1, c, hq, d))
+    k = jax.random.normal(ks[1], (1, s, hkv, d))
+    v = jax.random.normal(ks[2], (1, s, hkv, d))
+    kp, tables = _paged_from_contiguous(k, np.asarray([total]), bs,
+                                        num_pages, seed=seed + 1)
+    vp, _ = _paged_from_contiguous(v, np.asarray([total]), bs,
+                                   num_pages, seed=seed + 1)
+    return q, kp, vp, tables[0], k[:, :total], v[:, :total]
+
+
+@pytest.mark.parametrize("total,offset,c", [
+    (21, 16, 5),   # ragged final chunk, mid-block boundary
+    (16, 8, 8),    # exact block-aligned window
+    (5, 0, 5),     # first (and only) chunk, shorter than a block
+])
+def test_fused_prefill_kernel_matches_dense(total, offset, c):
+    """The fused chunked-prefill kernel (interpret mode — the identical
+    code path that lowers on TPU) vs the dense gather+attend reference
+    AND the contiguous ground truth, across window geometries."""
+    q, kp, vp, table, k, v = _prefill_case(7, total, offset, c)
+    off = jnp.int32(offset)
+    want = paged_prefill_attention(q, kp, vp, table, off, impl="dense")
+    got = paged_prefill_attention(q, kp, vp, table, off,
+                                  impl="pallas-interpret")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    positions = (offset + jnp.arange(c, dtype=jnp.int32))[None]
+    kpos = jnp.arange(total, dtype=jnp.int32)[None]
+    ref = causal_attention(q, k, v, positions, kpos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_fused_prefill_kernel_quantized_and_trash_poisoned():
+    """Int8 pools through the fused prefill kernel, with the trash page
+    saturated: in-kernel dequant must match the dense gather-dequant
+    chain, and the poison must contribute exactly nothing (unwritten
+    blocks are NEG_INF-masked before softmax)."""
+    q, kp, vp, table, k, v = _prefill_case(9, total=13, offset=8, c=5)
+    qk, ksc = quantize_kv_pages(kp)
+    qv, vsc = quantize_kv_pages(vp)
+    qk = qk.at[TRASH_PAGE].set(127)
+    qv = qv.at[TRASH_PAGE].set(127)
+    ksc = ksc.at[TRASH_PAGE].set(1e6)
+    vsc = vsc.at[TRASH_PAGE].set(1e6)
+    off = jnp.int32(8)
+    want = paged_prefill_attention(q, qk, qv, table, off, ksc, vsc,
+                                   impl="dense")
+    got = paged_prefill_attention(q, qk, qv, table, off, ksc, vsc,
+                                  impl="pallas-interpret")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+
+
+# ------------------------------------------------ fused verify kernel
+def test_fused_verify_kernel_bitwise_matches_sequential_decode():
+    """The spec-ON==OFF keystone on the fused path: each of the S verify
+    rows must be BITWISE the single-query decode kernel's output for
+    that row at its staggered length — not allclose, array_equal. Runs
+    per impl so the pin covers both the dense flattening and the fused
+    Pallas grid."""
+    q4, kp, vp, tables, lengths, _, _ = _ragged_case(
+        11, lengths=[6, 14, 1], bs=4, num_pages=48)  # 14+2 drafts
+        # fills block 3 exactly -- the extension must stay inside the table
+    b, s = len(lengths), 3
+    # S consecutive rotary-free queries per sequence; row 0 replaces the
+    # decode query, rows 1.. are the draft positions.
+    qs = jax.random.normal(jax.random.PRNGKey(12), (b, s, 4, 16))
+    ln = jnp.asarray(lengths, jnp.int32)
+    # K/V for the staggered rows must be scattered in already (the
+    # scatter_span contract): extend each sequence by s - 1 tokens.
+    for j in range(1, s):
+        kj = jax.random.normal(jax.random.PRNGKey(100 + j), (b, 1, 2, 16))
+        vj = jax.random.normal(jax.random.PRNGKey(200 + j), (b, 1, 2, 16))
+        kp, vp = scatter_token(kp, vp, kj, vj, tables, ln + (j - 1))
+    for impl in ("dense", "pallas-interpret"):
+        fused = ragged_verify_attention(qs, kp, vp, tables, ln,
+                                        impl=impl)
+        for j in range(s):
+            row = ragged_paged_attention(
+                qs[:, j:j + 1], kp, vp, tables, ln + j, impl=impl)
+            assert np.array_equal(np.asarray(fused[:, j:j + 1]),
+                                  np.asarray(row)), (impl, j)
+
+
+def test_fused_verify_kernel_quantized_matches_dense():
+    q, kp, vp, tables, lengths, _, _ = _ragged_case(13, lengths=[7, 12])
+    qk, ksc = quantize_kv_pages(kp)
+    qv, vsc = quantize_kv_pages(vp)
+    qs = jax.random.normal(jax.random.PRNGKey(14), (2, 2, 4, 16))
+    ln = jnp.asarray(lengths, jnp.int32)
+    want = ragged_verify_attention(qs, qk, qv, tables, ln, ksc, vsc,
+                                   impl="dense")
+    got = ragged_verify_attention(qs, qk, qv, tables, ln, ksc, vsc,
+                                  impl="pallas-interpret")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_fused_prefill_and_verify_lower_to_mosaic_custom_call():
+    """Both new kernels survive cross-platform export for the tpu
+    target (Mosaic custom call present), at real TPU shapes (D=128,
+    bs=16) so the tiling checks run for real — the bench's
+    prefill/verify_kernel_in_hlo booleans, pinned without hardware."""
+    from jax import export as jexport
+
+    kp = jnp.zeros((8, 2, 16, 128), jnp.float32)
+    vp = jnp.zeros((8, 2, 16, 128), jnp.float32)
+
+    qc = jnp.zeros((1, 32, 4, 128), jnp.float32)
+    table = jnp.zeros((4,), jnp.int32)
+
+    def f(q, kp, vp, table):
+        return paged_prefill_attention(q, kp, vp, table, jnp.int32(0),
+                                       impl="pallas")
+
+    txt = jexport.export(jax.jit(f), platforms=["tpu"])(
+        qc, kp, vp, table).mlir_module()
+    assert "tpu_custom_call" in txt or "mosaic" in txt.lower()
+
+    qv_ = jnp.zeros((2, 3, 4, 128), jnp.float32)
+    bt = jnp.zeros((2, 4), jnp.int32)
+    ln = jnp.zeros((2,), jnp.int32)
+
+    def g(q, kp, vp, bt, ln):
+        return ragged_verify_attention(q, kp, vp, bt, ln, impl="pallas")
+
+    txt = jexport.export(jax.jit(g), platforms=["tpu"])(
+        qv_, kp, vp, bt, ln).mlir_module()
+    assert "tpu_custom_call" in txt or "mosaic" in txt.lower()

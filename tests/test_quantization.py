@@ -288,3 +288,114 @@ def test_quantize_weights_rejects_cross_dtype_requant():
         quantize_weights(qparams, qcfg, "fp8")
     with pytest.raises(ValueError, match="int8"):
         quantize_weights(params, cfg, "fp16")
+
+
+# ----------------------------------- quantized ARITHMETIC (matmul_dtype)
+# The serving matmuls' einsum specs exactly as models/llama.py contracts
+# them (per-layer slices; lm_head is unembed's spec).
+_ARITH_SPECS = {
+    "wq": "bsd,dhk->bshk", "wo": "bshk,hkd->bsd",
+    "w1": "bsd,df->bsf", "w2": "bsf,fd->bsd",
+    "lm_head": "bsd,dv->bsv",
+}
+
+
+def _layer0_leaf(qparams, name):
+    if name == "lm_head":
+        return qparams["lm_head"]
+    leaf = qparams["layers"][name]
+    return {"q": leaf["q"][0], "scale": leaf["scale"][0]}
+
+
+def _arith_case(name, dtype="int8"):
+    from triton_kubernetes_tpu.ops.quantization import quantized_einsum
+
+    cfg = get_config("llama-test")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams, _ = quantize_weights(params, cfg, dtype)
+    spec = _ARITH_SPECS[name]
+    leaf = _layer0_leaf(qparams, name)
+    w_sub = spec.replace(" ", "").split(",")[1].split("->")[0]
+    dims = {"b": 2, "s": 8, **dict(zip(w_sub, leaf["q"].shape))}
+    x_sub = spec.split(",")[0]
+    x = jax.random.normal(jax.random.PRNGKey(3),
+                          tuple(dims[c] for c in x_sub), dtype=jnp.float32)
+    deq = leaf["q"].astype(jnp.float32) * leaf["scale"]
+    ref = jnp.einsum(spec, x, deq)
+    got = quantized_einsum(spec, x, leaf["q"], leaf["scale"])
+    return got, ref
+
+
+@pytest.mark.parametrize("name", sorted(_ARITH_SPECS))
+def test_quantized_einsum_per_matmul_parity(name):
+    """int8 ARITHMETIC (int8 dot, int32 accumulate, scales folded into
+    the epilogue) vs the dequant-then-f32 einsum on the same stored
+    weights: < 2% relative output error. Weight rounding is shared, so
+    this isolates the per-token activation quantization + fold."""
+    got, ref = _arith_case(name)
+    assert got.dtype == ref.dtype
+    rel = float(jnp.linalg.norm(got - ref) / (jnp.linalg.norm(ref) + 1e-9))
+    assert rel < 0.02, f"{name}: rel err {rel}"
+
+
+@pytest.mark.parametrize("name", ["wq", "lm_head"])
+def test_quantized_einsum_fp8_parity(name):
+    """fp8 arithmetic rides the identical path with an f32-accumulating
+    fp8 dot: < 6% (e4m3's 3 mantissa bits now round the activations
+    too, not just the stored weights)."""
+    _need_fp8()
+    got, ref = _arith_case(name, "fp8")
+    rel = float(jnp.linalg.norm(got - ref) / (jnp.linalg.norm(ref) + 1e-9))
+    assert rel < 0.06, f"{name}: rel err {rel}"
+
+
+def test_quantized_einsum_epilogue_fold_exact():
+    """The scale fold is algebra, not approximation: on inputs where
+    every intermediate is exactly representable (small-int operands,
+    power-of-two scales, per-token amax anchored so the activation
+    scale is exactly 2^-2), the int8-dot + f32-epilogue output is
+    BITWISE the dequantize-then-f32 einsum."""
+    from triton_kubernetes_tpu.ops.quantization import quantized_einsum
+
+    rng = np.random.default_rng(0)
+    d, f = 16, 8
+    q = jnp.asarray(rng.integers(-8, 8, (d, f)), jnp.int8)
+    scale = jnp.asarray(2.0 ** rng.integers(-3, 1, (1, f)), jnp.float32)
+    xi = rng.integers(-127, 128, (2, 4, d))
+    xi[:, :, 0] = 127  # anchor per-token amax -> x_scale = 2^-2 exactly
+    x = jnp.asarray(xi, jnp.float32) * (2.0 ** -2)
+    ref = jnp.einsum("bsd,df->bsf", x, q.astype(jnp.float32) * scale)
+    got = quantized_einsum("bsd,df->bsf", x, q, scale)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_quantized_einsum_validates_spec_and_scale():
+    from triton_kubernetes_tpu.ops.quantization import quantized_einsum
+
+    x = jnp.ones((2, 4), jnp.float32)
+    q = jnp.ones((4, 8), jnp.int8)
+    ok = jnp.ones((1, 8), jnp.float32)
+    with pytest.raises(ValueError, match="contraction"):
+        quantized_einsum("ab,cd->abcd", x, q, ok)
+    with pytest.raises(ValueError, match="scale"):
+        quantized_einsum("ab,bc->ac", x, q, jnp.ones((4, 8), jnp.float32))
+
+
+def test_resolve_matmul_dtype_table():
+    """auto = quantized arithmetic only on TPU over quantized storage
+    (bitwise-f32 everywhere else); explicit int8/fp8 require matching
+    storage — a silent dequant behind an explicit request is the bug
+    class this refuses to have."""
+    from triton_kubernetes_tpu.ops.quantization import resolve_matmul_dtype
+
+    assert resolve_matmul_dtype("f32", "int8", "tpu") == "f32"
+    assert resolve_matmul_dtype("auto", "int8", "tpu") == "int8"
+    assert resolve_matmul_dtype("auto", "int8", "cpu") == "f32"
+    assert resolve_matmul_dtype("auto", "none", "tpu") == "f32"
+    assert resolve_matmul_dtype("int8", "int8", "cpu") == "int8"
+    with pytest.raises(ValueError, match="weight"):
+        resolve_matmul_dtype("int8", "none", "tpu")
+    with pytest.raises(ValueError, match="weight"):
+        resolve_matmul_dtype("fp8", "int8", "tpu")
+    with pytest.raises(ValueError, match="matmul_dtype"):
+        resolve_matmul_dtype("bf16", "none", "cpu")

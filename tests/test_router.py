@@ -439,3 +439,50 @@ def test_router_total_failure_terminates_the_placement(tmp_path):
     assert names[-1] == "route.abort"
     assert validate_chaos_trace([path]) == []
     assert all(not r.healthy for r in router.replicas.values())
+
+
+def test_pick_decode_least_pressure_deterministic():
+    """Migration-aware decode placement: the handoff target is the
+    replica with the LEAST windowed kv_pressure; ties break first to
+    the consistent-hash owner (prefix-cache locality for repeat turns),
+    then by name; a replica whose /stats is unreachable reports inf —
+    last resort, never dropped. All pinned with a stubbed /stats so the
+    policy is tested as a pure function of the answers."""
+    router = Router(["http://p:1"],
+                    decode_urls=[f"http://d{i}:1" for i in range(3)])
+    key = "session-42"
+    affinity = router.decode_ring.owner(key, frozenset())
+    answers = {}
+    router._get_json = lambda url: answers.get(url, (503, {}))
+
+    def set_pressure(p):
+        answers.clear()
+        for name, val in p.items():
+            url = router.decode_replicas[name].url + "/stats"
+            answers[url] = (200, {"kv_pressure": val})
+
+    # Strictly least pressure wins, affinity or not.
+    loser = affinity
+    winner = sorted(set(router.decode_replicas) - {affinity})[0]
+    set_pressure({loser: 0.9, winner: 0.2,
+                  **{n: 0.5 for n in router.decode_replicas
+                     if n not in (loser, winner)}})
+    assert router.pick_decode(key).name == winner
+    # All-idle tie: the hash owner gets it (repeat turns co-locate).
+    set_pressure({n: 0.0 for n in router.decode_replicas})
+    assert router.pick_decode(key).name == affinity
+    # Tie among non-owners: lexicographic name, fully deterministic.
+    others = sorted(set(router.decode_replicas) - {affinity})
+    set_pressure({affinity: 0.9, **{n: 0.1 for n in others}})
+    assert router.pick_decode(key).name == others[0]
+    # Unreachable /stats -> inf: placeable only after every replica
+    # that answered; all-unreachable degrades to the affinity owner.
+    set_pressure({n: 0.1 for n in router.decode_replicas})
+    del answers[router.decode_replicas[others[0]].url + "/stats"]
+    assert router.pick_decode(key).name != others[0]
+    answers.clear()
+    assert router.pick_decode(key).name == affinity
+    # Unhealthy replicas never receive a placement.
+    set_pressure({n: 0.0 for n in router.decode_replicas})
+    router.decode_replicas[affinity].healthy = False
+    assert router.pick_decode(key).name != affinity

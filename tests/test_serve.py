@@ -842,3 +842,98 @@ def test_http_request_timeout_is_504_not_503(model):
         # The engine loop is alive and well: liveness stays 200.
         with urllib.request.urlopen(srv.url + "/healthz") as r:
             assert r.status == 200
+
+
+# ---------------------------------- quantized arithmetic (matmul_dtype)
+def test_matmul_dtype_auto_is_f32_bitwise_on_cpu(model):
+    """The `auto` contract on a non-TPU backend: quantized STORAGE with
+    auto (or explicit f32) ARITHMETIC must produce the identical token
+    stream — auto only switches the dot dtype on TPU, so on CPU these
+    three engines run the exact same lowered program."""
+    prompt, n = [5, 7, 9, 11, 2, 4], 6
+    base = solo_run(model, prompt, n, engine=dict(weight_dtype="int8"))
+    assert base == solo_run(
+        model, prompt, n,
+        engine=dict(weight_dtype="int8", matmul_dtype="auto"))
+    assert base == solo_run(
+        model, prompt, n,
+        engine=dict(weight_dtype="int8", matmul_dtype="f32"))
+
+
+def test_int8_arithmetic_engine_composes_with_serving_features(model):
+    """--matmul-dtype int8 end to end, composed with the features it
+    must not perturb: chunked prefill + prefix cache + spec decode all
+    ON, int8 storage AND int8 dots. The engine is deterministic
+    (identical reruns), drains its pool, and reports the arithmetic
+    mode in /stats alongside kv_pressure."""
+    kw = dict(weight_dtype="int8", matmul_dtype="int8", spec_k=2,
+              prefill_chunk=4, prefix_cache=True)
+    prompt, n = [5, 7, 9, 11, 2, 4, 6, 8, 1, 3], 8
+
+    def run():
+        eng = make_engine(model, **kw)
+        eng.submit(Request("solo", list(prompt), n))
+        (done,) = eng.run_until_idle()
+        eng.release_prefix_cache()  # cached chains hold their pages
+        assert eng.allocator.in_use == 0, "leaked KV pages"
+        return eng, done.tokens
+
+    eng, a = run()
+    _, b = run()
+    assert a == b and len(a) == n
+    st = eng.stats()
+    assert st["matmul_dtype"] == "int8"
+    assert "kv_pressure" in st and st["kv_pressure"] >= 0.0
+
+
+def test_matmul_dtype_requires_matching_weights(model):
+    """Explicit quantized arithmetic without quantized storage is a
+    LOUD init-time error — never a silently-dequantizing engine."""
+    with pytest.raises(ValueError, match="matmul_dtype"):
+        make_engine(model, matmul_dtype="int8")
+    with pytest.raises(ValueError, match="matmul_dtype"):
+        make_engine(model, matmul_dtype="bf16")
+    # f32 and auto are always legal, quantized weights or not.
+    assert make_engine(model, matmul_dtype="auto").matmul_dtype == "auto"
+
+
+# --------------------------------------------- simulated DCN transfer
+def test_dcn_transfer_model_accounting_and_replay():
+    """The cloudsim op_latency idiom on the migration wire: the model
+    charges rtt + bytes/bandwidth + seeded jitter through an injectable
+    sleeper (latency accounting, not wall clock), round-trips through
+    to_dict, and replays the same jitter draw under the same seed."""
+    from triton_kubernetes_tpu.serve import DcnTransferModel
+
+    slept = []
+    m = DcnTransferModel(bytes_per_s=1e6, rtt_s=0.01, jitter_s=0.0,
+                         sleep=slept.append)
+    assert m.apply(500_000) == pytest.approx(0.51)
+    assert slept == [pytest.approx(0.51)]
+    # Zero-config model is free and serializes to nothing.
+    free = DcnTransferModel(sleep=slept.append)
+    assert free.apply(10**9) == 0.0 and len(slept) == 1
+    assert free.to_dict() == {}
+    # Seeded jitter replays identically through the wire format.
+    j1 = DcnTransferModel(jitter_s=0.5, seed=7, sleep=lambda s: None)
+    j2 = DcnTransferModel.from_dict(j1.to_dict(), sleep=lambda s: None)
+    assert j1.transfer_s(0) == j2.transfer_s(0) > 0.0
+    with pytest.raises(ValueError, match=">= 0"):
+        DcnTransferModel(bytes_per_s=-1.0)
+
+
+def test_cli_serve_matmul_and_dcn_flags():
+    from triton_kubernetes_tpu.cli.main import build_parser
+
+    args = build_parser().parse_args(
+        ["serve", "--matmul-dtype", "int8", "--dcn-gbps", "12.5",
+         "--dcn-rtt-ms", "1.5", "--dcn-jitter-ms", "0.2"])
+    assert args.matmul_dtype == "int8"
+    assert args.dcn_gbps == 12.5 and args.dcn_rtt_ms == 1.5
+    assert args.dcn_jitter_ms == 0.2
+    # Defaults: f32-safe arithmetic resolution, free loopback wire.
+    d = build_parser().parse_args(["serve"])
+    assert d.matmul_dtype == "auto"
+    assert d.dcn_gbps == 0.0 == d.dcn_rtt_ms == d.dcn_jitter_ms
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["serve", "--matmul-dtype", "bf16"])

@@ -22,7 +22,8 @@ from typing import Any, List, Optional
 
 from .. import __version__
 from ..backends import Backend, LocalBackend, ObjectStoreBackend
-from ..constants import KV_DTYPES, OPERATOR_PORT, ROUTE_PORT, WEIGHT_DTYPES
+from ..constants import (KV_DTYPES, MATMUL_DTYPES, OPERATOR_PORT,
+                         ROUTE_PORT, WEIGHT_DTYPES)
 from ..backends.objectstore import DirObjectStore
 from ..backends.base import StateLockedError, StateNotFoundError
 from ..backends.gcs import GcsConfigError
@@ -295,6 +296,20 @@ def build_parser() -> argparse.ArgumentParser:
                             "caller's f32 master tree is untouched; fp8 "
                             "fails loudly where this jax build lacks "
                             "the dtype)")
+    serve.add_argument("--matmul-dtype", default="auto",
+                       choices=list(MATMUL_DTYPES), metavar="DTYPE",
+                       help="ARITHMETIC dtype for the big serving "
+                            "matmuls (storage is --weight-dtype): f32 = "
+                            "dequantize then full-precision einsum (the "
+                            "pinned reference), int8/fp8 = contract the "
+                            "stored quantized weights directly (low-"
+                            "precision dot, f32/int32 accumulate, "
+                            "scales folded into the epilogue — requires "
+                            "the matching --weight-dtype), auto = "
+                            "quantized arithmetic on TPU when weights "
+                            "are quantized, bitwise-f32 elsewhere "
+                            "(docs/guide/performance.md §Quantized "
+                            "arithmetic)")
     serve.add_argument("--sequential", action="store_true",
                        help="serve one request at a time (the continuous-"
                             "batching A/B baseline; scripts/ci/"
@@ -347,6 +362,24 @@ def build_parser() -> argparse.ArgumentParser:
                             "router drives the handoff, the engine "
                             "behaves identically either way "
                             "(docs/guide/serving.md §Disaggregation)")
+    serve.add_argument("--dcn-gbps", type=float, default=0.0,
+                       metavar="GBPS",
+                       help="simulated datacenter-network bandwidth "
+                            "(gigabits/s) charged per outbound migration "
+                            "payload — 0 (default) disables the model; "
+                            "single-host disaggregation A/Bs otherwise "
+                            "ship KV sessions over loopback for free "
+                            "(docs/guide/serving.md §Disaggregation)")
+    serve.add_argument("--dcn-rtt-ms", type=float, default=0.0,
+                       metavar="MS",
+                       help="simulated per-transfer round-trip latency "
+                            "(milliseconds) added on top of --dcn-gbps "
+                            "(default: 0)")
+    serve.add_argument("--dcn-jitter-ms", type=float, default=0.0,
+                       metavar="MS",
+                       help="uniform [0, MS) jitter added per transfer, "
+                            "drawn from a generator seeded by --seed so "
+                            "runs replay identically (default: 0)")
     serve.add_argument("--trace-jsonl", default=None, metavar="FILE",
                        help="append this replica's request-lifecycle "
                             "spans (admit/prefill/first-token/preempt/"
@@ -848,10 +881,19 @@ def main(argv: Optional[List[str]] = None,
             max_batch=args.max_batch, max_model_len=args.max_model_len,
             sequential=args.sequential,
             kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+            matmul_dtype=args.matmul_dtype,
             prefill_chunk=prefill_chunk,
             prefix_cache=prefix_cache, spec_k=args.spec_k)
+        dcn = None
+        if args.dcn_gbps or args.dcn_rtt_ms or args.dcn_jitter_ms:
+            from ..serve.server import DcnTransferModel
+
+            dcn = DcnTransferModel(
+                bytes_per_s=args.dcn_gbps * 1e9 / 8,
+                rtt_s=args.dcn_rtt_ms / 1e3,
+                jitter_s=args.dcn_jitter_ms / 1e3, seed=args.seed)
         server = ServeHTTPServer(engine, host=args.serve_host,
-                                 port=args.port)
+                                 port=args.port, dcn=dcn)
         host, port = server.address
         if args.trace_jsonl:
             from ..utils.trace import GoodputRecorder, TraceWriter
@@ -871,6 +913,7 @@ def main(argv: Optional[List[str]] = None,
                     model=args.model, block_size=args.block_size,
                     num_blocks=args.num_blocks, max_batch=args.max_batch,
                     kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+                    matmul_dtype=args.matmul_dtype,
                     prefill_chunk=prefill_chunk,
                     prefix_cache=prefix_cache, spec_k=args.spec_k,
                     pool=args.pool)

@@ -73,3 +73,13 @@ EXIT_RESUME = 75
 # failure, never a silent fallback.
 KV_DTYPES = ("auto", "bf16", "int8", "fp8")
 WEIGHT_DTYPES = ("auto", "int8", "fp8")
+# Arithmetic dtype for the big serving matmuls (`tk8s serve
+# --matmul-dtype`). Storage quantization (WEIGHT_DTYPES) says how the
+# weights LIVE; this knob says how they CONTRACT. "f32" is the pinned
+# reference (dequantize, then full-precision einsum); "int8"/"fp8" run
+# the quantized-arithmetic path (ops.quantization.quantized_einsum:
+# low-precision dot, f32/int32 accumulate, scales folded into the
+# epilogue) and require the matching --weight-dtype; "auto" resolves at
+# engine init — quantized arithmetic on TPU MXUs when the weights are
+# quantized, the bitwise-f32 reference elsewhere.
+MATMUL_DTYPES = ("auto", "f32", "int8", "fp8")

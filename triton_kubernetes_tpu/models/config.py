@@ -66,6 +66,18 @@ class ModelConfig:
     # train/precision.py's apply-policy shape, so a config/params
     # half-applied state cannot exist.
     weight_quant: str = "none"
+    # Arithmetic dtype for the big matmuls (models.llama.weight_einsum):
+    # "f32"  — dequantize quantized leaves, contract in full precision
+    #          (the pinned reference path, bitwise-stable across PRs);
+    # "int8" — contract the stored int8 weights directly (int8 dot,
+    #          int32 accumulate, per-channel scales folded into the
+    #          epilogue; requires weight_quant == "int8");
+    # "fp8"  — analogous fp8 dot with f32 accumulate (requires
+    #          weight_quant == "fp8" and a runtime jax with the dtype);
+    # "auto" — quantized arithmetic on TPU when the weights are
+    #          quantized, the f32 reference elsewhere — so CPU runs stay
+    #          bitwise-identical to matmul_dtype="f32".
+    matmul_dtype: str = "auto"
 
     def __post_init__(self):
         if self.remat_policy not in ("none", "full", "dots"):
@@ -85,6 +97,16 @@ class ModelConfig:
             raise ValueError(
                 f"weight_quant must be 'none', 'int8', or 'fp8', got "
                 f"{self.weight_quant!r}")
+        if self.matmul_dtype not in ("auto", "f32", "int8", "fp8"):
+            raise ValueError(
+                f"matmul_dtype must be 'auto', 'f32', 'int8', or 'fp8', "
+                f"got {self.matmul_dtype!r}")
+        if self.matmul_dtype in ("int8", "fp8") \
+                and self.weight_quant != self.matmul_dtype:
+            raise ValueError(
+                f"matmul_dtype {self.matmul_dtype!r} needs weights stored "
+                f"in the same dtype (weight_quant is {self.weight_quant!r});"
+                f" quantize_weights first, or use --weight-dtype")
     scan_layers: bool = True  # lax.scan over the layer stack
     # Fused cross-entropy head (ops/fused_ce.py): compute the loss in vocab
     # chunks without materializing [B,S,V] f32 logits — at Llama vocab

@@ -26,6 +26,7 @@ from jax import lax
 from ..ops.attention import auto_attention, causal_attention
 from ..ops.moe import moe_layer
 from ..ops.norms import rms_norm
+from ..ops.quantization import quantized_einsum, resolve_matmul_dtype
 from ..ops.rotary import apply_rotary, rotary_tables
 from .config import ModelConfig
 
@@ -80,6 +81,36 @@ def resolve_weight(w: Any, ad: jnp.dtype) -> jnp.ndarray:
     if isinstance(w, dict):
         return (w["q"].astype(jnp.float32) * w["scale"]).astype(ad)
     return w.astype(ad)
+
+
+def weight_einsum(spec: str, x: jnp.ndarray, leaf: Any,
+                  config: ModelConfig,
+                  out_dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+    """One weight matmul, honoring ``config.matmul_dtype``.
+
+    The single chokepoint for every big serving/training einsum: on the
+    resolved ``"f32"`` path this IS the historical call —
+    ``einsum(spec, x, resolve_weight(leaf))`` — bitwise unchanged. On
+    the ``"int8"``/``"fp8"`` paths a quantized leaf contracts through
+    :func:`ops.quantization.quantized_einsum` instead: the stored
+    low-precision tensor is the dot operand (int8 dot, int32
+    accumulate; scales folded into the epilogue), and no dequantized
+    full-precision weight is materialized. Unquantized leaves always
+    take the f32 path — ``matmul_dtype`` selects arithmetic for
+    quantized storage, it does not quantize anything itself.
+    """
+    ad = config.activation_dtype
+    if isinstance(leaf, dict):
+        mode = resolve_matmul_dtype(config.matmul_dtype,
+                                    config.weight_quant)
+        if mode != "f32":
+            return quantized_einsum(
+                spec, x, leaf["q"], leaf["scale"],
+                out_dtype=out_dtype if out_dtype is not None else ad)
+    w = resolve_weight(leaf, ad)
+    if out_dtype is not None:
+        return jnp.einsum(spec, x, w, preferred_element_type=out_dtype)
+    return jnp.einsum(spec, x, w)
 
 
 # Weight leaf -> axes its matmul contracts over (per-channel int8 scales
@@ -237,11 +268,10 @@ def logical_axes(config: ModelConfig) -> Params:
 def _qkv(x: jnp.ndarray, layer: Params, config: ModelConfig,
          cos: jnp.ndarray, sin: jnp.ndarray, positions: jnp.ndarray):
     """Projected + rotary-encoded q/k/v for a block input ([B, S, ...])."""
-    ad = config.activation_dtype
     h = rms_norm(x, layer["attn_norm"], config.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, resolve_weight(layer["wq"], ad))
-    k = jnp.einsum("bsd,dhk->bshk", h, resolve_weight(layer["wk"], ad))
-    v = jnp.einsum("bsd,dhk->bshk", h, resolve_weight(layer["wv"], ad))
+    q = weight_einsum("bsd,dhk->bshk", h, layer["wq"], config)
+    k = weight_einsum("bsd,dhk->bshk", h, layer["wk"], config)
+    v = weight_einsum("bsd,dhk->bshk", h, layer["wv"], config)
     q = apply_rotary(q, cos, sin, positions)
     k = apply_rotary(k, cos, sin, positions)
     return q, k, v
@@ -266,10 +296,11 @@ def _mlp(x: jnp.ndarray, layer: Params, config: ModelConfig,
             h, moe_params, config.num_selected, config.capacity_factor,
             dispatch_mode=config.moe_dispatch)
     gate = jax.nn.silu(
-        jnp.einsum("bsd,df->bsf", h, w("w3")).astype(jnp.float32)
+        weight_einsum("bsd,df->bsf", h, layer["w3"], config)
+        .astype(jnp.float32)
     ).astype(ad)
-    up = jnp.einsum("bsd,df->bsf", h, w("w1"))
-    y = jnp.einsum("bsf,fd->bsd", gate * up, w("w2"))
+    up = weight_einsum("bsd,df->bsf", h, layer["w1"], config)
+    y = weight_einsum("bsf,fd->bsd", gate * up, layer["w2"], config)
     return y, jnp.zeros((), dtype=jnp.float32)
 
 
@@ -366,14 +397,11 @@ def head_weights(params: Params, config: ModelConfig) -> jnp.ndarray:
 def unembed(x: jnp.ndarray, params: Params, config: ModelConfig):
     """Final norm + lm_head: [B, S, D] -> f32 logits [B, S, V]."""
     x = final_norm_hidden(x, params, config)
-    return jnp.einsum(
-        "bsd,dv->bsv", x, head_weights(params, config),
-        preferred_element_type=jnp.float32)
+    return weight_einsum("bsd,dv->bsv", x, params["lm_head"], config,
+                         out_dtype=jnp.float32)
 
 
 def project_out(x: jnp.ndarray, attn: jnp.ndarray, layer: Params,
                 config: ModelConfig) -> jnp.ndarray:
     """Attention output projection + residual add."""
-    return x + jnp.einsum(
-        "bshk,hkd->bsd", attn,
-        resolve_weight(layer["wo"], config.activation_dtype))
+    return x + weight_einsum("bshk,hkd->bsd", attn, layer["wo"], config)

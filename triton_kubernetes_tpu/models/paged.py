@@ -51,10 +51,9 @@ from jax import lax
 # pages + scales. Pinned in constants.py (the CLI registers the choices
 # on jax-less machines; this module validates them at runtime).
 from ..constants import KV_DTYPES
-from ..ops.attention import causal_attention
 from ..ops.paged_attention import (
     TRASH_PAGE,
-    gather_pages,
+    paged_prefill_attention,
     ragged_paged_attention,
     ragged_verify_attention,
     resolve_paged_impl,
@@ -239,6 +238,7 @@ def paged_prefill_chunk(
     cache: PagedKVCache,
     block_table: jnp.ndarray,  # [T] int32 — the sequence's FULL table
     with_quant_error: bool = False,
+    attention_impl: Optional[str] = None,
 ) -> Union[Tuple[jnp.ndarray, PagedKVCache],
            Tuple[jnp.ndarray, PagedKVCache, Tuple[jnp.ndarray,
                                                   jnp.ndarray]]]:
@@ -273,6 +273,10 @@ def paged_prefill_chunk(
     dense forward; attention keys are gathered at the table's fixed
     ``T * block_size`` width with explicit positions, so masked slots
     (future tokens, pad garbage, trash pages) contribute exactly zero.
+    ``attention_impl`` picks the chunk-attention implementation (the
+    ``paged_decode_step`` contract): ``None`` resolves
+    ``config.attention`` for the current backend — the fused Pallas
+    chunk kernel on TPU, the dense gather+attention reference elsewhere.
     """
     _, c = tokens.shape
     bs = cache.block_size
@@ -289,8 +293,9 @@ def paged_prefill_chunk(
     w = c // bs
     ad = config.activation_dtype
     quantized = cache.quantized
+    if attention_impl is None:
+        attention_impl = resolve_paged_impl(config.attention)
     positions = (offset + jnp.arange(c, dtype=jnp.int32))[None]  # [1, C]
-    k_positions = jnp.arange(t * bs, dtype=jnp.int32)[None]  # [1, T*bs]
     cos, sin = rotary_tables(
         config.head_dim, config.max_seq_len, config.rope_theta)
     x = params["embed"].astype(ad)[tokens]  # [1, C, D]
@@ -309,9 +314,8 @@ def paged_prefill_chunk(
             kp, vp, ks, vs = written
         else:
             kp, vp = written
-        kk = gather_pages(kp, block_table[None], ks, q.dtype)
-        vv = gather_pages(vp, block_table[None], vs, q.dtype)
-        attn = causal_attention(q, kk, vv, positions, k_positions)
+        attn = paged_prefill_attention(
+            q, kp, vp, block_table, offset, ks, vs, impl=attention_impl)
         x = llama.project_out(x, attn, layer, config)
         y, _ = llama._mlp(x, layer, config)
         ys = (kp, vp, ks, vs) if quantized else (kp, vp)

@@ -198,13 +198,27 @@ def ragged_verify_attention(
     given it, which is why an accepted row's logits reproduce the
     non-speculative step bitwise.
 
-    Implementation: the S queries flatten into S independent batch rows
-    sharing the sequence's block table at staggered lengths, then run
-    the UNCHANGED single-query kernel — so the dense reference, the
-    fused Pallas kernel's explicit-position masking, and the int8/fp8
-    dequantization all compose with verification without a second code
-    path to keep in parity.
+    Implementation: the dense reference flattens the S queries into S
+    independent batch rows sharing the sequence's block table at
+    staggered lengths and runs the UNCHANGED single-query path. The
+    Pallas impls run the fused verify kernel instead: ONE grid pass per
+    (sequence, KV head) scores all S staggered rows against the paged
+    pool — the pages are fetched once per block, not S times. Parity is
+    BITWISE, not approximate: each row's online-softmax updates are the
+    exact f32 op sequence the single-query decode kernel runs for that
+    row (rows of a dot_general are independent reductions, and a block
+    fully masked for a shorter row is an exact no-op — ``p = exp(NEG_INF
+    - m)`` underflows to 0.0, ``corr = exp(0) = 1.0``), which is what
+    keeps spec ON==OFF and ``paged_rewind``'s byte-exact guarantees
+    intact on the fused path.
     """
+    if impl not in PAGED_IMPLS:
+        raise ValueError(
+            f"impl must be one of {PAGED_IMPLS}, got {impl!r}")
+    if impl != "dense":
+        return _ragged_verify_attention_pallas(
+            q, k_pages, v_pages, block_tables, lengths, k_scale, v_scale,
+            interpret=(impl == "pallas-interpret"))
     b, s, hq, d = q.shape
     t = block_tables.shape[1]
     qf = q.reshape(b * s, 1, hq, d)
@@ -214,6 +228,54 @@ def ragged_verify_attention(
     out = ragged_paged_attention(qf, k_pages, v_pages, tables_f, lens_f,
                                  k_scale, v_scale, impl=impl)
     return out.reshape(b, s, hq, d)
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,  # [1, C, Hq, D] — one chunk's rotary-applied queries
+    k_pages: jnp.ndarray,  # [N, Hkv, bs, D] (activation dtype or quantized)
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,  # [T] int32 — the sequence's full table
+    offset: jnp.ndarray,  # scalar int32 — absolute position of q's row 0
+    k_scale: Optional[jnp.ndarray] = None,  # [N, Hkv] f32
+    v_scale: Optional[jnp.ndarray] = None,
+    impl: str = "dense",
+) -> jnp.ndarray:
+    """Chunked-prefill attention straight out of the paged pool:
+    [1, C, Hq, D] for C queries at absolute positions ``offset ..
+    offset + C - 1``, attending every written slot of the sequence's
+    pages (this chunk's K/V included — the ``scatter_chunk``-first
+    contract of ``models.paged.paged_prefill_chunk``).
+
+    The dense impl is the reference and is exactly the historical
+    chain: full-width :func:`gather_pages` + explicit-position
+    ``causal_attention``. The Pallas impls fuse that gather and the
+    attention into one grid — the block table steers each (KV head,
+    block) step's page DMA, blocks past the chunk's last written token
+    are predicated out and steered to the trash page, and quantized
+    pools dequantize per (page, head) inside the kernel — so the
+    ``[1, T*bs, Hkv, D]`` gathered intermediate never exists in HBM.
+    The per-window *scatter* stays a separate XLA op by design: it
+    writes O(C) tokens while the gather reads O(T*bs), and fusing it
+    would turn the kernel's read-only page pipeline into a
+    read-modify-write over the whole pool.
+    """
+    if impl not in PAGED_IMPLS:
+        raise ValueError(
+            f"impl must be one of {PAGED_IMPLS}, got {impl!r}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if impl != "dense":
+        return _paged_prefill_attention_pallas(
+            q, k_pages, v_pages, block_table, offset, k_scale, v_scale,
+            interpret=(impl == "pallas-interpret"))
+    t = block_table.shape[0]
+    bs = k_pages.shape[2]
+    c = q.shape[1]
+    kk = gather_pages(k_pages, block_table[None], k_scale, q.dtype)
+    vv = gather_pages(v_pages, block_table[None], v_scale, q.dtype)
+    positions = (offset + jnp.arange(c, dtype=jnp.int32))[None]  # [1, C]
+    k_positions = jnp.arange(t * bs, dtype=jnp.int32)[None]  # [1, T*bs]
+    return causal_attention(q, kk, vv, positions, k_positions)
 
 
 def table_slots(
@@ -551,3 +613,270 @@ def _ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
       *operands)
     return out[:, :, :group, :].reshape(b, hq, d)[:, None]
+
+
+def _fold_heads(q: jnp.ndarray, hkv: int, group: int, rows8: int
+                ) -> jnp.ndarray:
+    """[B, S, Hq, D] -> [B, Hkv, S*group (padded to rows8), D]: head
+    ``h = kv_head * group + g`` lands at row ``s * group + g`` of its KV
+    head's plane — the multi-query generalization of the decode kernel's
+    sublane fold. Padded rows are zero queries: finite softmax, garbage
+    output, sliced off by the caller."""
+    b, s, hq, d = q.shape
+    qf = q.reshape(b, s, hkv, group, d)
+    qf = jnp.transpose(qf, (0, 2, 1, 3, 4)).reshape(b, hkv, s * group, d)
+    if rows8 != s * group:
+        qf = jnp.pad(qf, ((0, 0), (0, 0), (0, rows8 - s * group), (0, 0)))
+    return qf
+
+
+def _prefill_chunk_kernel(bt_ref, off_ref, *rest,
+                          bs: int, num_blocks: int, chunk: int,
+                          group: int, sm_scale: float, quantized: bool):
+    """Grid (Hkv, T), T innermost/arbitrary: fused gather + causal
+    attention for one prefill chunk's C queries against the sequence's
+    whole paged prefix. Query row ``r`` is (token ``r // group``, group
+    member ``r % group``) at absolute position ``offset + r // group``;
+    blocks past the chunk's last written token (``offset + C``) are
+    predicated out and their fetches steered to the trash page."""
+    pl, _, _ = _pallas_ns()
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, \
+            acc_ref = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
+    t = pl.program_id(1)
+    offset = off_ref[0]
+
+    @pl.when(t == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(t * bs < offset + chunk)
+    def _compute():
+        q = q_ref[0]          # [CG8, D]
+        k = k_ref[0, 0]       # [bs, D]
+        s = jax.lax.dot_general(
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if quantized:
+            s = s * ks_ref[0, 0, 0, 0]
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        q_pos = offset + row // group  # padded rows: past-the-end, sliced
+        k_pos = t * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, :1])
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        vf = v_ref[0, 0].astype(jnp.float32 if quantized else q.dtype)
+        pv = jax.lax.dot_general(
+            p.astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if quantized:
+            pv = pv * vs_ref[0, 0, 0, 0]
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + pv
+        m_ref[:] = m_new
+
+    @pl.when(t == num_blocks - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0] = jnp.where(
+            l > 0, acc_ref[:] / l, 0.0).astype(o_ref.dtype)
+
+
+def _paged_prefill_attention_pallas(q, k_pages, v_pages, block_table,
+                                    offset, k_scale, v_scale,
+                                    interpret: bool) -> jnp.ndarray:
+    pl, pltpu, CompilerParams = _pallas_ns()
+    _, c, hq, d = q.shape
+    n, hkv, bs, _ = k_pages.shape
+    t = block_table.shape[0]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    cg8 = _round_up(c * group, 8)
+    qf = _fold_heads(q, hkv, group, cg8)[0]  # [Hkv, CG8, D]
+
+    quantized = k_scale is not None
+    kernel = functools.partial(
+        _prefill_chunk_kernel, bs=bs, num_blocks=t, chunk=c, group=group,
+        sm_scale=d ** -0.5, quantized=quantized)
+
+    # The chunk attends nothing past its own last written token
+    # (offset + C - 1): later table entries are future/unwritten pages,
+    # steered to the trash page and predicated out — same trick, chunk
+    # edition, of the decode kernel's past-length elision.
+    def kv_index(h, t, *refs):
+        live = t * bs < refs[1][0] + c
+        return (jnp.where(live, refs[0][t], TRASH_PAGE), h, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, cg8, d), lambda h, t, *refs: (h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [qf, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, 1, 1), kv_index),
+            pl.BlockSpec((1, 1, 1, 1), kv_index),
+        ]
+        operands += [k_scale[:, :, None, None], v_scale[:, :, None, None]]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(hkv, t),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, cg8, d), lambda h, t, *refs: (h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((cg8, 128), jnp.float32),  # m, lane-replicated
+            pltpu.VMEM((cg8, 128), jnp.float32),  # l
+            pltpu.VMEM((cg8, d), jnp.float32),    # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((hkv, cg8, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32),
+      jnp.asarray(offset, jnp.int32).reshape(1), *operands)
+    out = out[:, :c * group].reshape(hkv, c, group, d)
+    return jnp.transpose(out, (1, 0, 2, 3)).reshape(1, c, hq, d)
+
+
+def _verify_kernel(bt_ref, len_ref, *rest,
+                   bs: int, num_blocks: int, spec_rows: int, group: int,
+                   sm_scale: float, quantized: bool):
+    """Grid (B, Hkv, T), T innermost/arbitrary: ALL ``spec_rows``
+    staggered verify queries of one sequence's KV head group in one
+    pass. Query row ``r`` is (stagger ``r // group``, group member
+    ``r % group``) at position ``lengths[b] - 1 + r // group``; a block
+    is computed if ANY row attends it (``t*bs < lengths[b] +
+    spec_rows - 1``), and rows it is fully masked for see an exact
+    online-softmax no-op — which is what makes each row bitwise equal to
+    the single-query decode kernel at that row's length (the rewind
+    contract)."""
+    pl, _, _ = _pallas_ns()
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, \
+            acc_ref = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b]
+
+    @pl.when(t * bs < length + spec_rows - 1)
+    def _compute():
+        q = q_ref[0, 0]       # [SG8, D]
+        k = k_ref[0, 0]       # [bs, D]
+        s = jax.lax.dot_general(
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if quantized:
+            s = s * ks_ref[0, 0, 0, 0]
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        q_pos = length - 1 + row // group
+        k_pos = t * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, :1])
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        vf = v_ref[0, 0].astype(jnp.float32 if quantized else q.dtype)
+        pv = jax.lax.dot_general(
+            p.astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if quantized:
+            pv = pv * vs_ref[0, 0, 0, 0]
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + pv
+        m_ref[:] = m_new
+
+    @pl.when(t == num_blocks - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = jnp.where(
+            l > 0, acc_ref[:] / l, 0.0).astype(o_ref.dtype)
+
+
+def _ragged_verify_attention_pallas(q, k_pages, v_pages, block_tables,
+                                    lengths, k_scale, v_scale,
+                                    interpret: bool) -> jnp.ndarray:
+    pl, pltpu, CompilerParams = _pallas_ns()
+    b, s, hq, d = q.shape
+    n, hkv, bs, _ = k_pages.shape
+    t = block_tables.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    sg8 = _round_up(s * group, 8)
+    qf = _fold_heads(q, hkv, group, sg8)  # [B, Hkv, SG8, D]
+
+    quantized = k_scale is not None
+    kernel = functools.partial(
+        _verify_kernel, bs=bs, num_blocks=t, spec_rows=s, group=group,
+        sm_scale=d ** -0.5, quantized=quantized)
+
+    # A block is fetched if the LONGEST row (stagger S-1, at length
+    # lengths[b] + S - 1 keys) attends it; shorter rows experience an
+    # exact no-op for the trailing blocks. Everything past that steers
+    # to the trash page, decode-kernel style.
+    def kv_index(b, h, t, *refs):
+        live = t * bs < refs[1][b] + (s - 1)
+        return (jnp.where(live, refs[0][b, t], TRASH_PAGE), h, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, sg8, d), lambda b, h, t, *refs: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [qf, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, 1, 1), kv_index),
+            pl.BlockSpec((1, 1, 1, 1), kv_index),
+        ]
+        operands += [k_scale[:, :, None, None], v_scale[:, :, None, None]]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, t),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, sg8, d), lambda b, h, t, *refs: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((sg8, 128), jnp.float32),  # m, lane-replicated
+            pltpu.VMEM((sg8, 128), jnp.float32),  # l
+            pltpu.VMEM((sg8, d), jnp.float32),    # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, sg8, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      *operands)
+    out = out[:, :, :s * group].reshape(b, hkv, s, group, d)
+    return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(b, s, hq, d)

@@ -192,3 +192,114 @@ def kv_quant_error(q: jnp.ndarray, scale: jnp.ndarray,
     mask = mask.astype(jnp.float32)
     return (jnp.sum(jnp.abs(dq - exact) * mask)
             / (jnp.sum(jnp.abs(exact) * mask) + 1e-12))
+
+
+# --------------------------------------------------------------------------
+# Quantized ARITHMETIC (matmul_dtype): storage quantization above says how
+# weights live; this section makes them CONTRACT in low precision. The
+# int8 path is W8A8: activations are quantized per-token (dynamic amax
+# over the contraction axes), the dot runs int8 x int8 with int32
+# accumulation (`preferred_element_type` — the MXU-native form), and both
+# scales fold into a rank-1 f32 epilogue. No dequantized full-precision
+# weight operand is ever materialized — the stored int8/fp8 tensor IS the
+# dot operand, which is the whole memory/bandwidth point.
+
+
+def resolve_matmul_dtype(mode: str, weight_quant: str,
+                         platform: Optional[str] = None) -> str:
+    """Resolve a ``--matmul-dtype`` knob to a concrete arithmetic path:
+    ``"f32"`` (dequantize-then-full-precision einsum — the pinned
+    reference) or ``"int8"``/``"fp8"`` (quantized arithmetic).
+
+    ``"auto"`` picks quantized arithmetic only on TPU (where the MXU has
+    native low-precision throughput) AND only when the weights are
+    already stored quantized — so off-TPU, ``auto`` is bitwise-identical
+    to ``f32``. Explicit ``int8``/``fp8`` demand matching storage and
+    raise loudly otherwise (never a silent fallback).
+    """
+    if platform is None:
+        import jax
+        platform = jax.default_backend()
+    if mode == "f32":
+        return "f32"
+    if mode in ("int8", "fp8"):
+        if weight_quant != mode:
+            raise ValueError(
+                f"matmul_dtype {mode!r} needs weights stored in the same "
+                f"dtype (weight_quant is {weight_quant!r}); quantize the "
+                f"weights first (--weight-dtype {mode})")
+        if mode == "fp8":
+            fp8_dtype()  # loud Fp8UnavailableError on builds without it
+        return mode
+    if mode == "auto":
+        if platform == "tpu" and weight_quant in ("int8", "fp8"):
+            return weight_quant
+        return "f32"
+    raise ValueError(f"unknown matmul_dtype {mode!r}; "
+                     f"know ('auto', 'f32', 'int8', 'fp8')")
+
+
+def _parse_weight_spec(spec: str):
+    """Split a two-operand einsum spec ``"x,w->out"`` into (x letters,
+    w letters, out letters, contraction letters). The quantized path
+    supports exactly the weight-matmul shape: every letter unique per
+    operand, contraction letters shared by x and w, and the output =
+    x's batch letters (in x order) + w's output letters (in w order) —
+    which is what all the model's weight einsums look like."""
+    lhs, out = spec.replace(" ", "").split("->")
+    x_sub, w_sub = lhs.split(",")
+    contract = tuple(c for c in x_sub if c in w_sub)
+    if not contract:
+        raise ValueError(f"spec {spec!r} has no contraction")
+    x_batch = tuple(c for c in x_sub if c not in contract)
+    w_out = tuple(c for c in w_sub if c not in contract)
+    if out != "".join(x_batch) + "".join(w_out):
+        raise ValueError(
+            f"spec {spec!r} is not a weight matmul (want out = x-batch "
+            f"letters then w-output letters)")
+    if len(set(x_sub)) != len(x_sub) or len(set(w_sub)) != len(w_sub):
+        raise ValueError(f"spec {spec!r} repeats a letter within an operand")
+    return x_sub, w_sub, contract, x_batch, w_out
+
+
+def quantized_einsum(spec: str, x: jnp.ndarray, q: jnp.ndarray,
+                     scale: jnp.ndarray,
+                     out_dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+    """``einsum(spec, x, dequant(q, scale))`` without the dequant.
+
+    ``q``/``scale`` are a :func:`quantize_channelwise` pair (scale keeps
+    the contraction axes as size-1 dims). The activation is quantized
+    per-token to ``q.dtype`` — amax over its contraction axes — then the
+    dot runs in low precision (int8 x int8 -> int32 accumulate; fp8 x
+    fp8 -> f32 accumulate) and the epilogue multiplies by
+    ``x_scale (x) w_scale`` in f32. The scale fold is EXACT (scales are
+    constant along the contraction axes by construction); the only new
+    error vs the dequant reference is the activation rounding.
+    """
+    x_sub, w_sub, contract, x_batch, w_out = _parse_weight_spec(spec)
+    dtype = jnp.dtype(q.dtype)
+    x_c_axes = tuple(x_sub.index(c) for c in contract)
+    w_c_axes = tuple(w_sub.index(c) for c in contract)
+    for a in w_c_axes:
+        if scale.shape[a] != 1:
+            raise ValueError(
+                f"scale shape {scale.shape} is not per-output-channel for "
+                f"spec {spec!r} (contraction axis {a} must be size 1)")
+    xf = x.astype(jnp.float32)
+    x_amax = jnp.max(jnp.abs(xf), axis=x_c_axes, keepdims=True)
+    x_scale = jnp.maximum(x_amax / qmax_for(dtype), MIN_SCALE)
+    xq = quantize_with_scale(xf, x_scale, dtype)
+    acc_dtype = jnp.int32 if dtype == jnp.dtype(jnp.int8) else jnp.float32
+    acc = jnp.einsum(spec, xq, q, preferred_element_type=acc_dtype)
+    # Epilogue: x_scale broadcast over w's output dims, w_scale over x's
+    # batch dims — both rank-expanded to the out layout (x batch letters
+    # then w output letters).
+    x_scale_out = jnp.squeeze(x_scale, axis=x_c_axes).reshape(
+        tuple(x.shape[x_sub.index(c)] for c in x_batch)
+        + (1,) * len(w_out))
+    w_scale_out = jnp.squeeze(
+        scale.astype(jnp.float32), axis=w_c_axes).reshape(
+        (1,) * len(x_batch)
+        + tuple(q.shape[w_sub.index(c)] for c in w_out))
+    y = acc.astype(jnp.float32) * x_scale_out * w_scale_out
+    return y.astype(out_dtype if out_dtype is not None else x.dtype)

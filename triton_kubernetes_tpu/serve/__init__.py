@@ -53,6 +53,7 @@ _LAZY = {
     "ManualClock": ".engine",
     "Request": ".engine",
     "ServeEngine": ".engine",
+    "DcnTransferModel": ".server",
     "ServeHTTPServer": ".server",
     # KV-page migration wire protocol (numpy-only, but it rides the
     # lazy slice with the engine it serializes for).
@@ -77,6 +78,7 @@ __all__ = [
     "SERVE_PORT",
     "ServeHTTPServer",
     "BlockAllocator",
+    "DcnTransferModel",
     "DiurnalSchedule",
     "FinishedRequest",
     "HashRing",

@@ -71,7 +71,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Deque, Dict, List, Optional
 
 import jax
@@ -89,6 +89,7 @@ from ..models.paged import (
     paged_rewind,
     paged_verify_step,
 )
+from ..constants import MATMUL_DTYPES
 from ..ops.paged_attention import TRASH_PAGE, blocks_for
 from ..train.precision import quantize_for_decode
 from ..utils import metrics
@@ -102,6 +103,13 @@ from .migration import (
     unpack_session,
 )
 from .speculation import draft_ngram, longest_agreeing_prefix
+
+# Ticks of pool-utilization history behind the stats() kv_pressure
+# signal: long enough to remember a just-drained burst, short enough
+# that a genuinely idle replica sheds its spike within ~a scheduler
+# breath. The router reads the resulting scalar over /stats — keep the
+# window here, engine-side, so every consumer sees one definition.
+_PRESSURE_WINDOW = 32
 
 
 class ManualClock:
@@ -239,6 +247,7 @@ class ServeEngine:
         sequential: bool = False,
         kv_dtype: str = "auto",
         weight_dtype: str = "auto",
+        matmul_dtype: str = "auto",
         prefill_chunk: Optional[int] = None,
         prefix_cache: bool = False,
         spec_k: int = 0,
@@ -265,12 +274,23 @@ class ServeEngine:
                 "prefix_cache requires prefill_chunk: prefix reuse skips "
                 "whole chunk windows (the absolute-window alignment is "
                 "what keeps sharing ON/OFF outputs identical)")
+        if matmul_dtype not in MATMUL_DTYPES:
+            raise ValueError(
+                f"matmul_dtype must be one of {MATMUL_DTYPES}, got "
+                f"{matmul_dtype!r}")
         # Decode weight policy first: params and config are rewritten as
         # one (the apply-policy shape) BEFORE the jit closures below
         # capture either, so a half-quantized engine cannot exist.
         params, config = quantize_for_decode(params, config, weight_dtype)
+        # Arithmetic dtype AFTER storage: ModelConfig.__post_init__
+        # cross-validates it against the weight_quant the line above
+        # just set (an explicit int8/fp8 without matching storage is a
+        # loud init-time error, never a silently-dequantizing engine),
+        # and the jit closures below capture the combined config.
+        config = replace(config, matmul_dtype=matmul_dtype)
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
+        self.matmul_dtype = matmul_dtype
         self.config = config
         self.params = params
         self.block_size = block_size
@@ -319,6 +339,10 @@ class ServeEngine:
         self.parked: Dict[str, _Sequence] = {}
         self._admit_counter = 0
         self._steps = 0
+        # Per-tick pool-utilization samples for the windowed kv_pressure
+        # stat (the router's migration-aware placement signal).
+        self._pressure_samples: Deque[float] = deque(
+            maxlen=_PRESSURE_WINDOW)
         cfg = config
         quantized = self.cache.quantized
         # Pool-byte accounting: what --kv-dtype actually buys. int8
@@ -1033,7 +1057,18 @@ class ServeEngine:
         return True
 
     # ------------------------------------------------------------ metrics
+    def _kv_pressure(self) -> float:
+        """Windowed KV pressure: max pool utilization over the last
+        :data:`_PRESSURE_WINDOW` ticks (falling back to the instantaneous
+        value before the first tick). Deterministic in the tick sequence
+        — no wall clock — so the router's least-pressure placement pick
+        is reproducible in tests."""
+        now = self.allocator.in_use / max(1, self.allocator.capacity)
+        return max([now] + list(self._pressure_samples))
+
     def _update_gauges(self) -> None:
+        self._pressure_samples.append(
+            self.allocator.in_use / max(1, self.allocator.capacity))
         metrics.gauge("tk8s_serve_queue_depth").set(len(self.waiting))
         metrics.gauge("tk8s_serve_sequences").set(
             self.num_running, state="running")
@@ -1061,7 +1096,15 @@ class ServeEngine:
             "sequential": self.sequential,
             "kv_dtype": self.kv_dtype,
             "weight_dtype": self.weight_dtype,
+            "matmul_dtype": self.matmul_dtype,
             "kv_pool_bytes": self.cache.pool_bytes + self.cache.scale_bytes,
+            # KV pressure: fraction of the pool a newly placed sequence
+            # would be contending with — the router's migration-aware
+            # decode placement signal (Router._decode_pressure). A
+            # windowed max (not the instantaneous gauge): a replica that
+            # spiked this window is a bad handoff target even if a
+            # completion just freed its pages.
+            "kv_pressure": self._kv_pressure(),
             "prefill_chunk": self.prefill_chunk,
             "spec_k": self.spec_k,
             "prefix_cache": self.prefix is not None,

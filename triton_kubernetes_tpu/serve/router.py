@@ -355,18 +355,54 @@ class Router:
         return last
 
     # ------------------------------------------------- disaggregation
+    def decode_pressure(self, exclude: frozenset = frozenset()
+                        ) -> Dict[str, float]:
+        """Windowed KV pressure per healthy decode replica, read from
+        each replica's ``/stats`` (the engine's ``kv_pressure`` field: a
+        windowed max of pool utilization, deterministic in its tick
+        sequence). A replica whose ``/stats`` is unreachable or missing
+        the field reports ``inf`` — still placeable, but only after
+        every replica that answered (the handoff ladder's degrade-never-
+        drop rule). All network happens OUTSIDE the router lock
+        (TK8S103); the snapshot is taken under it."""
+        with self._lock:
+            candidates = [(n, r.url)
+                          for n, r in sorted(self.decode_replicas.items())
+                          if r.healthy and n not in exclude]
+        pressure: Dict[str, float] = {}
+        for name, url in candidates:
+            status, st = self._get_json(url + "/stats")
+            p = st.get("kv_pressure") if isinstance(st, dict) else None
+            pressure[name] = (float(p)
+                              if status == 200 and isinstance(p, (int, float))
+                              else float("inf"))
+        return pressure
+
     def pick_decode(self, key: str,
                     exclude: frozenset = frozenset()) -> ReplicaState:
-        """The decode-pool owner for a session key: same consistent-
-        hash affinity as :meth:`pick` (repeat turns of a session land
-        their migrations on the SAME decode replica, whose prefix cache
-        then absorbs the shipped pages by refcount instead of copy)."""
+        """The decode-pool target for a session key: LEAST windowed KV
+        pressure (:meth:`decode_pressure`) — a handoff lands where its
+        pages will contend least, instead of wherever the failure
+        round-robin happened to stop. Ties (the common all-idle case)
+        break FIRST to the consistent-hash owner — repeat turns of a
+        session still land their migrations on the SAME decode replica,
+        whose prefix cache absorbs the shipped pages by refcount
+        instead of copy — then by name, so the pick is deterministic
+        for any fixed set of ``/stats`` answers (pinned in
+        tests/test_router.py)."""
         with self._lock:
             down = frozenset(n for n, r in self.decode_replicas.items()
                              if not r.healthy) | exclude
             if len(down) >= len(self.decode_replicas):
                 raise LookupError("no healthy decode replica")
-            return self.decode_replicas[self.decode_ring.owner(key, down)]
+            affinity = self.decode_ring.owner(key, down)
+        pressure = self.decode_pressure(exclude=down)
+        if not pressure:
+            raise LookupError("no healthy decode replica")
+        best = min(pressure,
+                   key=lambda n: (pressure[n], n != affinity, n))
+        with self._lock:
+            return self.decode_replicas[best]
 
     def _handoff(self, key: str, payload: Dict[str, Any],
                  source: ReplicaState, out: Dict[str, Any],

@@ -46,7 +46,9 @@ from __future__ import annotations
 
 import json
 import queue
+import random
 import threading
+import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
@@ -327,6 +329,84 @@ def _finished_body(done: FinishedRequest) -> Dict[str, Any]:
     return body
 
 
+class DcnTransferModel:
+    """Deterministic datacenter-network cost model for migration
+    transfers — the serving analog of cloudsim's ``op_latency`` knob.
+
+    Loopback tests and single-host A/Bs ship KV sessions over the
+    kernel's loopback at effectively infinite bandwidth, so a
+    disaggregated prefill→decode handoff looks free when the real
+    deployment pays a cross-rack (or cross-DC) wire for every packed
+    page. The model charges ``rtt_s + nbytes / bytes_per_s`` (plus an
+    optional seeded uniform jitter in ``[0, jitter_s)``) per transfer,
+    slept on the HANDLER thread around the ``/migrate/in`` POST — never
+    on the engine loop and never under a lock, so a simulated slow wire
+    stalls only that transfer, exactly like a real one.
+
+    The sleeper is injectable (the cloudsim/executor pattern): tests
+    assert latency *accounting* against a recorder instead of
+    wall-clock thresholds that flake under load. The jitter RNG is
+    seeded and private, so a fixed seed yields the same latency
+    sequence run-to-run — chaos timelines that include migrations stay
+    reproducible. ``to_dict``/``from_dict`` round-trip the model (sans
+    sleeper) so a scenario spec can carry it."""
+
+    def __init__(self, bytes_per_s: float = 0.0, rtt_s: float = 0.0,
+                 jitter_s: float = 0.0, seed: int = 0,
+                 sleep: Callable[[float], None] = time.sleep):
+        if bytes_per_s < 0 or rtt_s < 0 or jitter_s < 0:
+            raise ValueError(
+                f"DCN model parameters must be >= 0 (bytes_per_s="
+                f"{bytes_per_s}, rtt_s={rtt_s}, jitter_s={jitter_s})")
+        self.bytes_per_s = float(bytes_per_s)
+        self.rtt_s = float(rtt_s)
+        self.jitter_s = float(jitter_s)
+        self.seed = int(seed)
+        self._sleep = sleep
+        self._rng = random.Random(self.seed)
+        # Concurrent handler threads share the RNG; the lock keeps the
+        # draw sequence deterministic per (seed, transfer index).
+        self._rng_lock = threading.Lock()
+
+    def transfer_s(self, nbytes: int) -> float:
+        """Modeled seconds for one ``nbytes`` payload (draws jitter)."""
+        s = self.rtt_s
+        if self.bytes_per_s > 0:
+            s += nbytes / self.bytes_per_s
+        if self.jitter_s > 0:
+            with self._rng_lock:
+                s += self._rng.uniform(0.0, self.jitter_s)
+        return s
+
+    def apply(self, nbytes: int) -> float:
+        """Charge one transfer: sleep the modeled latency (on the
+        calling thread) and return it."""
+        latency = self.transfer_s(int(nbytes))
+        if latency > 0:
+            self._sleep(latency)
+        return latency
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.bytes_per_s:
+            out["bytes_per_s"] = self.bytes_per_s
+        if self.rtt_s:
+            out["rtt_s"] = self.rtt_s
+        if self.jitter_s:
+            out["jitter_s"] = self.jitter_s
+            out["seed"] = self.seed
+        return out
+
+    @classmethod
+    def from_dict(cls, spec: Dict[str, Any],
+                  sleep: Callable[[float], None] = time.sleep,
+                  ) -> "DcnTransferModel":
+        return cls(bytes_per_s=spec.get("bytes_per_s", 0.0),
+                   rtt_s=spec.get("rtt_s", 0.0),
+                   jitter_s=spec.get("jitter_s", 0.0),
+                   seed=spec.get("seed", 0), sleep=sleep)
+
+
 class ServeHTTPServer:
     """Embeddable serving endpoint:
     ``with ServeHTTPServer(engine) as url: ...`` in tests;
@@ -334,7 +414,8 @@ class ServeHTTPServer:
 
     def __init__(self, engine: ServeEngine, host: str = "127.0.0.1",
                  port: int = 0, request_timeout_s: float = 120.0,
-                 tracing: bool = True):
+                 tracing: bool = True,
+                 dcn: Optional[DcnTransferModel] = None):
         self.engine = engine
         if tracing and engine.flight is None:
             # Served engines trace by default (a bounded in-memory
@@ -343,6 +424,9 @@ class ServeHTTPServer:
             # breakdown. tracing=False is the overhead-A/B off arm.
             engine.flight = FlightRecorder()
         self.request_timeout_s = request_timeout_s
+        # Optional simulated DCN cost charged per outbound migration
+        # payload (handler thread, around the /migrate/in POST).
+        self.dcn = dcn
         self._inbox: "queue.Queue[Tuple[Request, _Waiter]]" = queue.Queue()
         self._waiters: Dict[str, _Waiter] = {}
         # Migration control closures for the engine loop, and the
@@ -443,6 +527,13 @@ class ServeHTTPServer:
             dest.rstrip("/") + "/migrate/in", data=blob,
             headers=headers, method="POST")
         dest_rid, err = None, None
+        ship_started = time.monotonic()
+        if self.dcn is not None:
+            # The simulated wire cost of shipping len(blob) — charged
+            # here on the handler thread (the same thread the real POST
+            # blocks), so concurrent migrations overlap their latency
+            # and the engine loop never notices.
+            self.dcn.apply(len(blob))
         try:
             with urllib.request.urlopen(
                     req, timeout=self.request_timeout_s) as resp:
@@ -462,6 +553,8 @@ class ServeHTTPServer:
             resumed = self._op(lambda: self._recover(request_id))
             return {"type": "error", "error": err,
                     "request_id": request_id, "resumed": resumed}
+        metrics.histogram("tk8s_serve_migration_transfer_seconds").observe(
+            time.monotonic() - ship_started, exemplar=trace_id)
 
         def _release() -> int:
             done = self.engine.release_session(request_id)

@@ -251,6 +251,13 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...], Optional[Tuple[float, ...]]]
         "quantized pages ship as-is, so int8/fp8 pools move ~4x/~2x "
         "fewer bytes than bf16/f32; exemplar-linked to the migrated "
         "session's trace id", ("direction",), None),
+    "tk8s_serve_migration_transfer_seconds": (
+        "histogram", "Wall seconds a migration payload spent on the "
+        "wire (the outbound /migrate/in POST, including any simulated "
+        "DCN bytes/sec + RTT cost when a transfer model is configured "
+        "— loopback tests otherwise pretend the ship is free); "
+        "exemplar-linked to the migrated session's trace id", (),
+        (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0)),
     # --------------------------------------------- serve/router.py
     "tk8s_route_requests_total": (
         "counter", "Requests the router placed, by replica and routing "
